@@ -1,0 +1,48 @@
+"""HydraGNN-TPU: a TPU-native multi-task graph neural network framework.
+
+A ground-up JAX/XLA/pjit re-design with the capabilities of HydraGNN
+(reference: /root/reference — ORNL HydraGNN, mirrored by
+Utah-Math-Data-Science/HydraGNN): one shared message-passing encoder,
+N decoder heads predicting graph-level and/or node-level properties,
+trained data-parallel over a TPU device mesh.
+
+Key design departures from the torch/CUDA reference (see SURVEY.md §7):
+  - ragged PyG ``Data``/``Batch``  ->  statically-padded ``GraphBatch`` pytrees
+  - torch-scatter aggregation      ->  XLA segment ops on sorted edge ids
+  - DDP/NCCL data parallelism      ->  ``jit`` over a ``jax.sharding.Mesh``
+  - torch BatchNorm                ->  mask-aware BatchNorm with optional
+                                       cross-device ``psum`` (SyncBN parity)
+
+Public entry points mirror the reference API surface
+(reference: hydragnn/__init__.py:1-3, run_training.py:42, run_prediction.py:27):
+
+    import hydragnn_tpu
+    hydragnn_tpu.run_training("config.json")
+    hydragnn_tpu.run_prediction("config.json")
+"""
+
+from hydragnn_tpu import graph  # noqa: F401
+from hydragnn_tpu import models  # noqa: F401
+from hydragnn_tpu import utils  # noqa: F401
+
+__version__ = "0.1.0"
+
+
+def run_training(config, **kwargs):
+    try:
+        from hydragnn_tpu.run_training import run_training as _rt
+    except ModuleNotFoundError as e:  # pragma: no cover
+        raise NotImplementedError(
+            "hydragnn_tpu.run_training is not available in this build"
+        ) from e
+    return _rt(config, **kwargs)
+
+
+def run_prediction(config, **kwargs):
+    try:
+        from hydragnn_tpu.run_prediction import run_prediction as _rp
+    except ModuleNotFoundError as e:  # pragma: no cover
+        raise NotImplementedError(
+            "hydragnn_tpu.run_prediction is not available in this build"
+        ) from e
+    return _rp(config, **kwargs)

@@ -1,0 +1,23 @@
+from hydragnn_tpu.graph.batch import GraphBatch, batch_graphs, pad_batch
+from hydragnn_tpu.graph.segment import (
+    segment_sum,
+    segment_mean,
+    segment_max,
+    segment_min,
+    segment_std,
+    segment_softmax,
+    node_degree,
+)
+
+__all__ = [
+    "GraphBatch",
+    "batch_graphs",
+    "pad_batch",
+    "segment_sum",
+    "segment_mean",
+    "segment_max",
+    "segment_min",
+    "segment_std",
+    "segment_softmax",
+    "node_degree",
+]

@@ -1,0 +1,277 @@
+"""Statically-padded graph batches — the TPU-native PyG ``Batch`` equivalent.
+
+The reference feeds ragged PyG ``Data`` objects through a collate that
+concatenates nodes/edges and keeps a ``batch`` vector (torch_geometric
+collate, consumed at reference hydragnn/models/Base.py:244-275). Ragged
+shapes recompile under ``jit``, so here a batch is padded to static
+``(num_nodes, num_edges, num_graphs)`` with explicit masks:
+
+  - one *padding graph* slot absorbs all padding nodes/edges (jraph-style),
+  - padding edges point at a padding node, so segment reductions stay clean,
+  - targets are a dict-of-heads ``{head_name: values}`` replacing the
+    reference's ragged ``data.y`` + ``y_loc`` offset table
+    (reference: hydragnn/preprocess/serialized_dataset_loader.py:262-303,
+    hydragnn/train/train_validate_test.py:218-281) — per-head values carry
+    their own masks, which eliminates the index gymnastics while keeping
+    loss parity.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Sequence
+
+import jax
+import numpy as np
+import jax.numpy as jnp
+
+
+def _round_up(x: int, multiple: int) -> int:
+    return ((x + multiple - 1) // multiple) * multiple
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class GraphBatch:
+    """A fixed-shape batch of graphs.
+
+    Attributes:
+      nodes: [N, F] node features.
+      senders / receivers: [E] int32 edge endpoints (message flows
+        sender -> receiver, matching PyG's edge_index[0] -> edge_index[1]).
+      edge_attr: [E, De] edge features, or None.
+      pos: [N, 3] node positions, or None.
+      node_graph: [N] int32 graph id of each node (PyG ``batch`` vector).
+      n_node / n_edge: [G] int32 per-graph counts (padding slots are 0).
+      node_mask: [N] bool, True for real nodes.
+      edge_mask: [E] bool, True for real edges.
+      graph_mask: [G] bool, True for real graphs.
+      graph_targets: {name: [G, d]} graph-level targets.
+      node_targets: {name: [N, d]} node-level targets.
+    """
+
+    nodes: jnp.ndarray
+    senders: jnp.ndarray
+    receivers: jnp.ndarray
+    node_graph: jnp.ndarray
+    n_node: jnp.ndarray
+    n_edge: jnp.ndarray
+    node_mask: jnp.ndarray
+    edge_mask: jnp.ndarray
+    graph_mask: jnp.ndarray
+    edge_attr: Optional[jnp.ndarray] = None
+    pos: Optional[jnp.ndarray] = None
+    graph_targets: Dict[str, jnp.ndarray] = dataclasses.field(default_factory=dict)
+    node_targets: Dict[str, jnp.ndarray] = dataclasses.field(default_factory=dict)
+
+    @property
+    def num_nodes(self) -> int:
+        return self.nodes.shape[0]
+
+    @property
+    def num_edges(self) -> int:
+        return self.senders.shape[0]
+
+    @property
+    def num_graphs(self) -> int:
+        return self.n_node.shape[0]
+
+    def replace(self, **kwargs) -> "GraphBatch":
+        return dataclasses.replace(self, **kwargs)
+
+
+def batch_graphs(
+    graphs: Sequence[Dict[str, Any]],
+    n_node_pad: Optional[int] = None,
+    n_edge_pad: Optional[int] = None,
+    n_graph_pad: Optional[int] = None,
+    node_multiple: int = 8,
+    edge_multiple: int = 8,
+) -> GraphBatch:
+    """Concatenate a list of single graphs and pad to static shapes.
+
+    Each graph is a dict with keys ``x`` [n, F], ``senders``/``receivers``
+    [e] (or ``edge_index`` [2, e]), optional ``edge_attr``, ``pos``,
+    ``graph_targets`` {name: [d]}, ``node_targets`` {name: [n, d]}.
+    All numpy; this runs on host in the input pipeline.
+    """
+    if not graphs:
+        raise ValueError("graphs must be non-empty")
+    n_graphs = len(graphs)
+    tot_nodes = sum(int(np.asarray(g["x"]).shape[0]) for g in graphs)
+    tot_edges = sum(_num_edges(g) for g in graphs)
+
+    # Field presence must be homogeneous — a silently dropped optional field
+    # is worse than an error here.
+    for key in ("edge_attr", "pos"):
+        present = [g.get(key) is not None for g in graphs]
+        if any(present) and not all(present):
+            raise ValueError(f"field '{key}' present on some graphs but not others")
+    gt_names = sorted(graphs[0].get("graph_targets", {}).keys())
+    nt_names = sorted(graphs[0].get("node_targets", {}).keys())
+    for g in graphs:
+        if sorted(g.get("graph_targets", {}).keys()) != gt_names:
+            raise ValueError("graph_targets keys differ across graphs")
+        if sorted(g.get("node_targets", {}).keys()) != nt_names:
+            raise ValueError("node_targets keys differ across graphs")
+
+    # One extra padding graph absorbs padding nodes/edges; at least one
+    # padding node/edge must exist for them to point at.
+    if n_graph_pad is None:
+        n_graph_pad = n_graphs + 1
+    if n_node_pad is None:
+        n_node_pad = _round_up(tot_nodes + 1, node_multiple)
+    if n_edge_pad is None:
+        n_edge_pad = max(_round_up(tot_edges + 1, edge_multiple), 1)
+    if n_graph_pad <= n_graphs:
+        raise ValueError(
+            f"n_graph_pad={n_graph_pad} must exceed num real graphs {n_graphs} "
+            "(one slot is reserved for the padding graph)"
+        )
+    if n_node_pad <= tot_nodes or n_edge_pad <= tot_edges:
+        raise ValueError(
+            f"padded sizes (nodes {n_node_pad}, edges {n_edge_pad}) must exceed "
+            f"real totals (nodes {tot_nodes}, edges {tot_edges})"
+        )
+
+    feat_dim = _as_2d(graphs[0]["x"]).shape[1]
+    nodes = np.zeros((n_node_pad, feat_dim), dtype=np.float32)
+    senders = np.full((n_edge_pad,), tot_nodes, dtype=np.int32)
+    receivers = np.full((n_edge_pad,), tot_nodes, dtype=np.int32)
+    node_graph = np.full((n_node_pad,), n_graphs, dtype=np.int32)
+    n_node = np.zeros((n_graph_pad,), dtype=np.int32)
+    n_edge = np.zeros((n_graph_pad,), dtype=np.int32)
+    node_mask = np.zeros((n_node_pad,), dtype=bool)
+    edge_mask = np.zeros((n_edge_pad,), dtype=bool)
+    graph_mask = np.zeros((n_graph_pad,), dtype=bool)
+
+    has_edge_attr = graphs[0].get("edge_attr") is not None
+    has_pos = graphs[0].get("pos") is not None
+    edge_attr = None
+    pos = None
+    if has_edge_attr:
+        de = _as_2d(graphs[0]["edge_attr"]).shape[1]
+        edge_attr = np.zeros((n_edge_pad, de), dtype=np.float32)
+    if has_pos:
+        pos = np.zeros((n_node_pad, np.asarray(graphs[0]["pos"]).shape[-1]), dtype=np.float32)
+
+    g_targets: Dict[str, list] = {}
+    n_targets: Dict[str, Any] = {}
+    for name in nt_names:
+        d = _as_2d(graphs[0]["node_targets"][name]).shape[1]
+        n_targets[name] = np.zeros((n_node_pad, d), dtype=np.float32)
+
+    node_off, edge_off = 0, 0
+    for gi, g in enumerate(graphs):
+        x = _as_2d(g["x"])
+        n, e = x.shape[0], _num_edges(g)
+        s, r = _edge_endpoints(g)
+        nodes[node_off : node_off + n] = x
+        senders[edge_off : edge_off + e] = s + node_off
+        receivers[edge_off : edge_off + e] = r + node_off
+        node_graph[node_off : node_off + n] = gi
+        n_node[gi], n_edge[gi] = n, e
+        node_mask[node_off : node_off + n] = True
+        edge_mask[edge_off : edge_off + e] = True
+        graph_mask[gi] = True
+        if has_edge_attr:
+            edge_attr[edge_off : edge_off + e] = _as_2d(g["edge_attr"])
+        if has_pos:
+            pos[node_off : node_off + n] = np.asarray(g["pos"], dtype=np.float32)
+        for name in gt_names:
+            g_targets.setdefault(name, []).append(
+                np.asarray(g["graph_targets"][name], dtype=np.float32).reshape(-1)
+            )
+        for name in nt_names:
+            n_targets[name][node_off : node_off + n] = _as_2d(g["node_targets"][name])
+        node_off += n
+        edge_off += e
+
+    graph_targets = {}
+    for name, rows in g_targets.items():
+        d = rows[0].shape[0]
+        arr = np.zeros((n_graph_pad, d), dtype=np.float32)
+        arr[:n_graphs] = np.stack(rows)
+        graph_targets[name] = arr
+
+    return GraphBatch(
+        nodes=jnp.asarray(nodes),
+        senders=jnp.asarray(senders),
+        receivers=jnp.asarray(receivers),
+        node_graph=jnp.asarray(node_graph),
+        n_node=jnp.asarray(n_node),
+        n_edge=jnp.asarray(n_edge),
+        node_mask=jnp.asarray(node_mask),
+        edge_mask=jnp.asarray(edge_mask),
+        graph_mask=jnp.asarray(graph_mask),
+        edge_attr=jnp.asarray(edge_attr) if edge_attr is not None else None,
+        pos=jnp.asarray(pos) if pos is not None else None,
+        graph_targets={k: jnp.asarray(v) for k, v in graph_targets.items()},
+        node_targets={k: jnp.asarray(v) for k, v in n_targets.items()},
+    )
+
+
+def pad_batch(batch: GraphBatch, n_node: int, n_edge: int, n_graph: int) -> GraphBatch:
+    """Pad an existing GraphBatch up to larger static shapes."""
+    dn = n_node - batch.num_nodes
+    de = n_edge - batch.num_edges
+    dg = n_graph - batch.num_graphs
+    if dn < 0 or de < 0 or dg < 0:
+        raise ValueError("target shape smaller than current batch")
+    if dn == de == dg == 0:
+        return batch
+
+    def pad0(a, amount, value=0):
+        if a is None:
+            return None
+        widths = [(0, amount)] + [(0, 0)] * (a.ndim - 1)
+        return jnp.pad(a, widths, constant_values=value)
+
+    # New padding nodes/edges must point at a *padding* slot. If this
+    # dimension grows, the first new slot is one; otherwise reuse the
+    # existing padding slot at the end (batch_graphs always reserves one).
+    if dg > 0:
+        pad_graph_id = batch.num_graphs
+    else:
+        if bool(batch.graph_mask[-1]):
+            raise ValueError("cannot pad nodes: batch has no padding graph slot")
+        pad_graph_id = batch.num_graphs - 1
+    if dn > 0:
+        pad_node_id = batch.num_nodes
+    else:
+        if bool(batch.node_mask[-1]):
+            raise ValueError("cannot pad edges: batch has no padding node slot")
+        pad_node_id = batch.num_nodes - 1
+    return batch.replace(
+        nodes=pad0(batch.nodes, dn),
+        senders=pad0(batch.senders, de, pad_node_id),
+        receivers=pad0(batch.receivers, de, pad_node_id),
+        node_graph=pad0(batch.node_graph, dn, pad_graph_id),
+        n_node=pad0(batch.n_node, dg),
+        n_edge=pad0(batch.n_edge, dg),
+        node_mask=pad0(batch.node_mask, dn, False),
+        edge_mask=pad0(batch.edge_mask, de, False),
+        graph_mask=pad0(batch.graph_mask, dg, False),
+        edge_attr=pad0(batch.edge_attr, de),
+        pos=pad0(batch.pos, dn),
+        graph_targets={k: pad0(v, dg) for k, v in batch.graph_targets.items()},
+        node_targets={k: pad0(v, dn) for k, v in batch.node_targets.items()},
+    )
+
+
+def _as_2d(a) -> np.ndarray:
+    a = np.asarray(a, dtype=np.float32)
+    return a[:, None] if a.ndim == 1 else a
+
+
+def _num_edges(g: Dict[str, Any]) -> int:
+    if "senders" in g:
+        return int(np.asarray(g["senders"]).shape[0])
+    return int(np.asarray(g["edge_index"]).shape[1])
+
+
+def _edge_endpoints(g: Dict[str, Any]):
+    if "senders" in g:
+        return np.asarray(g["senders"]), np.asarray(g["receivers"])
+    ei = np.asarray(g["edge_index"])
+    return ei[0], ei[1]

@@ -1,0 +1,19 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+Mirrors the reference's CI strategy of testing distributed behavior without
+a cluster (reference: .github/workflows/CI.yml runs pytest serial + under
+``mpirun -n 2``). Here multi-device paths are exercised on 8 virtual XLA
+CPU devices so sharding/collective code compiles and runs in CI.
+
+Must run before jax is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")

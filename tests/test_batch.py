@@ -1,0 +1,118 @@
+"""Unit tests for GraphBatch construction and padding invariants."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from hydragnn_tpu.graph import GraphBatch, batch_graphs, pad_batch, segment_mean
+
+
+def tiny_graph(n, e_pairs, feat_offset=0.0):
+    x = np.arange(n, dtype=np.float32)[:, None] + feat_offset
+    s = np.array([p[0] for p in e_pairs], dtype=np.int32)
+    r = np.array([p[1] for p in e_pairs], dtype=np.int32)
+    return {
+        "x": x,
+        "senders": s,
+        "receivers": r,
+        "pos": np.random.RandomState(0).rand(n, 3).astype(np.float32),
+        "graph_targets": {"energy": np.array([x.sum()])},
+        "node_targets": {"charge": x * 2},
+    }
+
+
+def test_batch_graphs_basic():
+    g1 = tiny_graph(3, [(0, 1), (1, 2)])
+    g2 = tiny_graph(2, [(0, 1)], feat_offset=10.0)
+    b = batch_graphs([g1, g2])
+
+    assert b.num_graphs == 3  # 2 real + 1 padding slot
+    assert bool(b.graph_mask[0]) and bool(b.graph_mask[1]) and not bool(b.graph_mask[2])
+    np.testing.assert_array_equal(np.asarray(b.n_node[:2]), [3, 2])
+    np.testing.assert_array_equal(np.asarray(b.n_edge[:2]), [2, 1])
+    # second graph's edges are offset by 3 nodes
+    assert int(b.senders[2]) == 3 and int(b.receivers[2]) == 4
+    # padding nodes belong to padding graph
+    assert int(b.node_graph[5]) == 2
+    assert not bool(b.node_mask[5])
+    # targets land in the right slots
+    np.testing.assert_allclose(np.asarray(b.graph_targets["energy"][0]), [3.0])
+    np.testing.assert_allclose(np.asarray(b.graph_targets["energy"][2]), [0.0])
+    np.testing.assert_allclose(np.asarray(b.node_targets["charge"][3]), [20.0])
+
+
+def test_padding_does_not_pollute_pooling():
+    g1 = tiny_graph(3, [(0, 1), (1, 2)])
+    g2 = tiny_graph(2, [(0, 1)], feat_offset=10.0)
+    b = batch_graphs([g1, g2], n_node_pad=64, n_edge_pad=64, n_graph_pad=8)
+    pooled = segment_mean(b.nodes, b.node_graph, b.num_graphs, mask=b.node_mask)
+    np.testing.assert_allclose(np.asarray(pooled[0]), [1.0])  # mean(0,1,2)
+    np.testing.assert_allclose(np.asarray(pooled[1]), [10.5])  # mean(10,11)
+
+
+def test_pad_batch_roundtrip():
+    g1 = tiny_graph(3, [(0, 1), (1, 2)])
+    b = batch_graphs([g1])
+    big = pad_batch(b, 32, 32, 4)
+    assert big.num_nodes == 32 and big.num_edges == 32 and big.num_graphs == 4
+    # real data unchanged
+    np.testing.assert_allclose(np.asarray(big.nodes[:3, 0]), [0.0, 1.0, 2.0])
+    # new padding edges point at a safe node, masked out
+    assert not bool(big.edge_mask[-1])
+    pooled = segment_mean(big.nodes, big.node_graph, 4, mask=big.node_mask)
+    np.testing.assert_allclose(np.asarray(pooled[0]), [1.0])
+
+
+def test_1d_targets_and_edge_attr_normalized():
+    # 1-D node targets / edge_attr must become [n,1] columns, not broadcast.
+    g = {
+        "x": np.ones((3,), np.float32),
+        "senders": np.array([0, 1], np.int32),
+        "receivers": np.array([1, 2], np.int32),
+        "edge_attr": np.array([5.0, 6.0], np.float32),
+        "graph_targets": {"e": np.array([1.0])},
+        "node_targets": {"q": np.array([1.0, 2.0, 3.0], np.float32)},
+    }
+    b = batch_graphs([g])
+    assert b.node_targets["q"].shape[1] == 1
+    np.testing.assert_allclose(np.asarray(b.node_targets["q"][:3, 0]), [1, 2, 3])
+    assert b.edge_attr.shape[1] == 1
+    np.testing.assert_allclose(np.asarray(b.edge_attr[:2, 0]), [5, 6])
+
+
+def test_pad_batch_partial_growth_keeps_indices_in_range():
+    g1 = tiny_graph(3, [(0, 1), (1, 2)])
+    b = batch_graphs([g1])
+    # grow only nodes: new padding nodes must use the existing padding graph
+    nb = pad_batch(b, b.num_nodes + 5, b.num_edges, b.num_graphs)
+    assert int(np.asarray(nb.node_graph).max()) < nb.num_graphs
+    # grow only edges: new padding edges must point at an existing padding node
+    eb = pad_batch(b, b.num_nodes, b.num_edges + 5, b.num_graphs)
+    assert int(np.asarray(eb.senders).max()) < eb.num_nodes
+    assert not bool(eb.node_mask[int(np.asarray(eb.senders)[-1])])
+
+
+def test_heterogeneous_fields_rejected():
+    import pytest
+
+    g1 = tiny_graph(2, [(0, 1)])
+    g2 = tiny_graph(2, [(0, 1)])
+    del g2["pos"]
+    g2["pos"] = None
+    with pytest.raises(ValueError):
+        batch_graphs([g1, g2])
+    with pytest.raises(ValueError):
+        batch_graphs([])
+
+
+def test_graphbatch_is_pytree():
+    g1 = tiny_graph(2, [(0, 1)])
+    b = batch_graphs([g1])
+    leaves = jax.tree_util.tree_leaves(b)
+    assert all(hasattr(l, "shape") for l in leaves)
+
+    @jax.jit
+    def f(batch: GraphBatch):
+        return batch.nodes.sum()
+
+    assert np.isfinite(float(f(b)))
