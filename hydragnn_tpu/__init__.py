@@ -28,21 +28,15 @@ from hydragnn_tpu import utils  # noqa: F401
 __version__ = "0.1.0"
 
 
+# Entry points live in hydragnn_tpu.api (a distinct module name, so the
+# lazy import cannot rebind these wrapper attributes to a submodule).
 def run_training(config, **kwargs):
-    try:
-        from hydragnn_tpu.run_training import run_training as _rt
-    except ModuleNotFoundError as e:  # pragma: no cover
-        raise NotImplementedError(
-            "hydragnn_tpu.run_training is not available in this build"
-        ) from e
+    from hydragnn_tpu.api import run_training as _rt
+
     return _rt(config, **kwargs)
 
 
 def run_prediction(config, **kwargs):
-    try:
-        from hydragnn_tpu.run_prediction import run_prediction as _rp
-    except ModuleNotFoundError as e:  # pragma: no cover
-        raise NotImplementedError(
-            "hydragnn_tpu.run_prediction is not available in this build"
-        ) from e
+    from hydragnn_tpu.api import run_prediction as _rp
+
     return _rp(config, **kwargs)

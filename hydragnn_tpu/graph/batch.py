@@ -128,10 +128,12 @@ def batch_graphs(
             f"n_graph_pad={n_graph_pad} must exceed num real graphs {n_graphs} "
             "(one slot is reserved for the padding graph)"
         )
-    if n_node_pad <= tot_nodes or n_edge_pad <= tot_edges:
+    # Padding edges only need a padding *node* to point at, so an exact-fit
+    # edge capacity is fine; the node side must strictly exceed.
+    if n_node_pad <= tot_nodes or n_edge_pad < tot_edges:
         raise ValueError(
-            f"padded sizes (nodes {n_node_pad}, edges {n_edge_pad}) must exceed "
-            f"real totals (nodes {tot_nodes}, edges {tot_edges})"
+            f"padded sizes (nodes {n_node_pad}, edges {n_edge_pad}) too small "
+            f"for real totals (nodes {tot_nodes}, edges {tot_edges})"
         )
 
     feat_dim = _as_2d(graphs[0]["x"]).shape[1]

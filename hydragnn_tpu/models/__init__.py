@@ -1,0 +1,27 @@
+from hydragnn_tpu.models.base import (
+    HydraModel,
+    ModelConfig,
+    PerNodeMLP,
+    masked_loss,
+    model_loss,
+)
+from hydragnn_tpu.models.create import (
+    create_model,
+    create_model_config,
+    model_config_from_dict,
+)
+from hydragnn_tpu.models import convs
+from hydragnn_tpu.models import layers
+
+__all__ = [
+    "HydraModel",
+    "ModelConfig",
+    "PerNodeMLP",
+    "masked_loss",
+    "model_loss",
+    "create_model",
+    "create_model_config",
+    "model_config_from_dict",
+    "convs",
+    "layers",
+]

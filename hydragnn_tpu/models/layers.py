@@ -1,0 +1,95 @@
+"""Shared neural building blocks: masked BatchNorm and MLP.
+
+The reference interleaves torch BatchNorm1d (via torch_geometric BatchNorm)
+with every conv layer (reference: hydragnn/models/Base.py:103-109,249-251).
+Under padding, naive BatchNorm would fold padding rows into the batch
+statistics, so this BatchNorm is mask-aware. With an ``axis_name`` it
+``psum``s the statistics across devices, which is the SyncBatchNorm
+equivalent (reference: hydragnn/utils/distributed.py:227-228) — under plain
+``jit`` over a sharded batch XLA already computes global statistics, so
+SyncBN comes for free there.
+
+Torch parity details: momentum 0.1 (new = 0.9*old + 0.1*batch), eps 1e-5,
+normalization uses biased variance, running variance stores the unbiased
+estimate.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+
+class MaskedBatchNorm(nn.Module):
+    features: int
+    momentum: float = 0.1
+    eps: float = 1e-5
+    axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(
+        self,
+        x: jnp.ndarray,
+        mask: Optional[jnp.ndarray] = None,
+        train: bool = True,
+    ) -> jnp.ndarray:
+        ra_mean = self.variable(
+            "batch_stats", "mean", lambda: jnp.zeros((self.features,), jnp.float32)
+        )
+        ra_var = self.variable(
+            "batch_stats", "var", lambda: jnp.ones((self.features,), jnp.float32)
+        )
+        scale = self.param("scale", nn.initializers.ones, (self.features,))
+        bias = self.param("bias", nn.initializers.zeros, (self.features,))
+
+        if train:
+            if mask is None:
+                count = jnp.asarray(x.shape[0], jnp.float32)
+                total = x.sum(axis=0)
+                total_sq = (x * x).sum(axis=0)
+            else:
+                m = mask.astype(x.dtype)[:, None]
+                count = m.sum()
+                total = (x * m).sum(axis=0)
+                total_sq = (x * x * m).sum(axis=0)
+            if self.axis_name is not None:
+                count = jax.lax.psum(count, self.axis_name)
+                total = jax.lax.psum(total, self.axis_name)
+                total_sq = jax.lax.psum(total_sq, self.axis_name)
+            safe_count = jnp.maximum(count, 1.0)
+            mean = total / safe_count
+            var = jnp.maximum(total_sq / safe_count - mean * mean, 0.0)
+
+            if not self.is_initializing() and self.is_mutable_collection("batch_stats"):
+                unbiased = var * safe_count / jnp.maximum(count - 1.0, 1.0)
+                mom = self.momentum
+                ra_mean.value = (1.0 - mom) * ra_mean.value + mom * mean
+                ra_var.value = (1.0 - mom) * ra_var.value + mom * unbiased
+        else:
+            mean, var = ra_mean.value, ra_var.value
+
+        y = (x - mean) * jax.lax.rsqrt(var + self.eps) * scale + bias
+        return y
+
+
+class MLP(nn.Module):
+    """Dense stack: Linear(+ReLU) x hidden, then a final Linear.
+
+    ``relu_last`` appends ReLU after the output layer too (the reference's
+    graph-head trunks end in ReLU, reference: hydragnn/models/Base.py:170-177).
+    """
+
+    layer_dims: Sequence[int]
+    relu_last: bool = False
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        n = len(self.layer_dims)
+        for i, dim in enumerate(self.layer_dims):
+            x = nn.Dense(dim)(x)
+            if i < n - 1 or self.relu_last:
+                x = nn.relu(x)
+        return x

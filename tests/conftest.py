@@ -10,10 +10,22 @@ Must run before jax is imported anywhere.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Unconditional: the ambient environment may pre-set JAX_PLATFORMS to the
+# real TPU backend, and tests must run on the virtual CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+# Persistent compilation cache: repeated test runs skip recompilation.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_test_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+
+# The ambient image registers a remote-TPU ("axon") PJRT plugin through
+# sitecustomize and pre-sets JAX_PLATFORMS=axon; if that backend wins, test
+# runs hang retrying the tunnel. Pin the config itself, not just the env.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

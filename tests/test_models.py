@@ -1,0 +1,203 @@
+"""Model chassis tests: all 7 conv flavors forward/loss/grad + padding invariance."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from hydragnn_tpu.graph import batch_graphs, pad_batch
+from hydragnn_tpu.models import HydraModel, ModelConfig, create_model, model_loss
+
+ALL_MODELS = ["GIN", "SAGE", "MFC", "CGCNN", "GAT", "PNA", "SchNet"]
+
+
+def make_graphs(num=3, feat=2, with_edge_attr=False, seed=0):
+    rng = np.random.RandomState(seed)
+    graphs = []
+    for gi in range(num):
+        n = rng.randint(3, 7)
+        # ring graph, bidirectional
+        s = np.concatenate([np.arange(n), np.roll(np.arange(n), 1)]).astype(np.int32)
+        r = np.concatenate([np.roll(np.arange(n), 1), np.arange(n)]).astype(np.int32)
+        pos = rng.rand(n, 3).astype(np.float32)
+        g = {
+            "x": rng.rand(n, feat).astype(np.float32),
+            "senders": s,
+            "receivers": r,
+            "pos": pos,
+            "graph_targets": {"energy": np.array([rng.rand()])},
+            "node_targets": {"charge": rng.rand(n, 1).astype(np.float32)},
+        }
+        if with_edge_attr:
+            g["edge_attr"] = (pos[r] - pos[s]).astype(np.float32)
+        graphs.append(g)
+    return graphs
+
+
+def make_cfg(model_type, feat=2, hidden=8, with_edge_attr=False, node_head="mlp", num_nodes=None):
+    edge_dim = 3 if with_edge_attr else None
+    return ModelConfig(
+        model_type=model_type,
+        input_dim=feat,
+        hidden_dim=feat if model_type == "CGCNN" else hidden,
+        output_dim=(1, 1),
+        output_type=("graph", "node"),
+        output_names=("energy", "charge"),
+        task_weights=(1.0, 1.0),
+        num_conv_layers=2,
+        graph_num_sharedlayers=2,
+        graph_dim_sharedlayers=4,
+        graph_num_headlayers=2,
+        graph_dim_headlayers=(8, 8),
+        node_num_headlayers=2,
+        node_dim_headlayers=(4, 4),
+        node_head_type=node_head,
+        num_nodes=num_nodes,
+        edge_dim=edge_dim,
+        max_neighbours=4,
+        pna_avg_deg_lin=2.0,
+        pna_avg_deg_log=1.1,
+        num_gaussians=10,
+        num_filters=16,
+        radius=2.0,
+    )
+
+
+@pytest.mark.parametrize("model_type", ALL_MODELS)
+def test_forward_shapes_and_loss(model_type):
+    graphs = make_graphs(with_edge_attr=(model_type in ("PNA", "CGCNN", "SchNet")))
+    batch = batch_graphs(graphs)
+    cfg = make_cfg(model_type, with_edge_attr=(model_type in ("PNA", "CGCNN", "SchNet")))
+    model, variables = create_model(cfg, batch)
+
+    outputs = model.apply(variables, batch, train=False)
+    assert outputs[0].shape == (batch.num_graphs, 1)
+    assert outputs[1].shape == (batch.num_nodes, 1)
+    assert all(np.isfinite(np.asarray(o)).all() for o in outputs)
+
+    total, tasks = model_loss(cfg, outputs, batch)
+    assert np.isfinite(float(total))
+    assert len(tasks) == 2
+
+
+@pytest.mark.parametrize("model_type", ALL_MODELS)
+def test_gradients_flow(model_type):
+    graphs = make_graphs(with_edge_attr=(model_type in ("PNA", "CGCNN", "SchNet")))
+    batch = batch_graphs(graphs)
+    cfg = make_cfg(model_type, with_edge_attr=(model_type in ("PNA", "CGCNN", "SchNet")))
+    model, variables = create_model(cfg, batch)
+
+    def loss_fn(params):
+        outputs, _ = model.apply(
+            {"params": params, "batch_stats": variables["batch_stats"]},
+            batch,
+            train=True,
+            mutable=["batch_stats"],
+            rngs={"dropout": jax.random.PRNGKey(0)},
+        )
+        total, _ = model_loss(cfg, outputs, batch)
+        return total
+
+    grads = jax.grad(loss_fn)(variables["params"])
+    norms = [float(jnp.abs(g).sum()) for g in jax.tree_util.tree_leaves(grads)]
+    assert all(np.isfinite(norms))
+    assert sum(n > 0 for n in norms) > len(norms) // 2, "most params should get gradient"
+
+
+@pytest.mark.parametrize("model_type", ["GIN", "PNA", "GAT", "SchNet"])
+def test_padding_invariance(model_type):
+    """Growing the padding must not change outputs on real slots."""
+    graphs = make_graphs(with_edge_attr=(model_type in ("PNA", "SchNet")))
+    b1 = batch_graphs(graphs)
+    b2 = pad_batch(b1, b1.num_nodes + 16, b1.num_edges + 16, b1.num_graphs + 3)
+    cfg = make_cfg(model_type, with_edge_attr=(model_type in ("PNA", "SchNet")))
+    model, variables = create_model(cfg, b1)
+
+    o1 = model.apply(variables, b1, train=False)
+    o2 = model.apply(variables, b2, train=False)
+    np.testing.assert_allclose(
+        np.asarray(o1[0][: len(graphs)]), np.asarray(o2[0][: len(graphs)]), atol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(o1[1][: b1.num_nodes]),
+        np.asarray(o2[1][: b1.num_nodes]),
+        atol=1e-5,
+    )
+
+
+def test_batchnorm_stats_ignore_padding():
+    graphs = make_graphs()
+    b1 = batch_graphs(graphs)
+    b2 = pad_batch(b1, b1.num_nodes + 32, b1.num_edges + 32, b1.num_graphs + 3)
+    cfg = make_cfg("GIN")
+    model, variables = create_model(cfg, b1)
+
+    _, s1 = model.apply(variables, b1, train=True, mutable=["batch_stats"])
+    _, s2 = model.apply(variables, b2, train=True, mutable=["batch_stats"])
+    l1 = jax.tree_util.tree_leaves(s1["batch_stats"])
+    l2 = jax.tree_util.tree_leaves(s2["batch_stats"])
+    for a, b in zip(l1, l2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_mlp_per_node_head():
+    # all graphs must share num_nodes for mlp_per_node (reference Base.py:209-212)
+    rng = np.random.RandomState(1)
+    graphs = []
+    n = 4
+    for _ in range(3):
+        s = np.concatenate([np.arange(n), np.roll(np.arange(n), 1)]).astype(np.int32)
+        r = np.concatenate([np.roll(np.arange(n), 1), np.arange(n)]).astype(np.int32)
+        graphs.append(
+            {
+                "x": rng.rand(n, 2).astype(np.float32),
+                "senders": s,
+                "receivers": r,
+                "pos": rng.rand(n, 3).astype(np.float32),
+                "graph_targets": {"energy": np.array([1.0])},
+                "node_targets": {"charge": rng.rand(n, 1).astype(np.float32)},
+            }
+        )
+    batch = batch_graphs(graphs)
+    cfg = make_cfg("GIN", node_head="mlp_per_node", num_nodes=n)
+    model, variables = create_model(cfg, batch)
+    outputs = model.apply(variables, batch, train=False)
+    assert outputs[1].shape == (batch.num_nodes, 1)
+    assert np.isfinite(np.asarray(outputs[1])).all()
+
+
+def test_conv_node_head():
+    graphs = make_graphs()
+    batch = batch_graphs(graphs)
+    cfg = make_cfg("GIN", node_head="conv")
+    model, variables = create_model(cfg, batch)
+    outputs = model.apply(variables, batch, train=False)
+    assert outputs[1].shape == (batch.num_nodes, 1)
+
+
+def test_task_weight_normalization():
+    cfg = make_cfg("GIN")
+    cfg2 = ModelConfig(**{**cfg.__dict__, "task_weights": (20.0, 1.0)})
+    w = cfg2.normalized_weights
+    np.testing.assert_allclose(sum(w), 1.0)
+    np.testing.assert_allclose(w[0] / w[1], 20.0)
+
+
+def test_config_validation():
+    cfg = make_cfg("GIN")
+    with pytest.raises(ValueError):
+        ModelConfig(**{**cfg.__dict__, "model_type": "NOPE"})
+    with pytest.raises(ValueError):
+        ModelConfig(**{**cfg.__dict__, "task_weights": (1.0,)})
+    with pytest.raises(ValueError):
+        ModelConfig(**{**cfg.__dict__, "node_head_type": "mlp_per_node", "num_nodes": None})
+
+
+def test_initial_bias():
+    graphs = make_graphs()
+    batch = batch_graphs(graphs)
+    cfg = make_cfg("GIN")
+    cfg = ModelConfig(**{**cfg.__dict__, "initial_bias": 7.5})
+    model, variables = create_model(cfg, batch)
+    bias = variables["params"]["graph_head_0"]["Dense_2"]["bias"]
+    np.testing.assert_allclose(np.asarray(bias), 7.5)
