@@ -1,0 +1,28 @@
+from hydragnn_tpu.data.radius_graph import radius_graph, radius_graph_pbc
+from hydragnn_tpu.data.dataset import (
+    GraphSample,
+    normalize_dataset,
+    scale_features_by_num_nodes,
+    update_predicted_values,
+    select_input_features,
+    samples_to_graph_dicts,
+)
+from hydragnn_tpu.data.splitting import split_dataset, compositional_stratified_splitting
+from hydragnn_tpu.data.loader import GraphLoader, pad_plan_for
+from hydragnn_tpu.data.synthetic import deterministic_graph_data
+
+__all__ = [
+    "radius_graph",
+    "radius_graph_pbc",
+    "GraphSample",
+    "normalize_dataset",
+    "scale_features_by_num_nodes",
+    "update_predicted_values",
+    "select_input_features",
+    "samples_to_graph_dicts",
+    "split_dataset",
+    "compositional_stratified_splitting",
+    "GraphLoader",
+    "pad_plan_for",
+    "deterministic_graph_data",
+]

@@ -1,0 +1,168 @@
+"""End-to-end dataset preparation: raw samples -> model-ready splits.
+
+The TPU-native equivalent of the reference chain
+``transform_raw_data_to_serialized`` -> ``SerializedDataLoader.
+load_serialized_data`` -> ``split_dataset`` (reference:
+hydragnn/preprocess/load_data.py:207-223,335-393 and
+hydragnn/preprocess/serialized_dataset_loader.py:106-259). Steps, in the
+reference's order:
+
+  1. read raw files (LSMS text / in-memory samples),
+  2. ``*_scaled_num_nodes`` feature scaling,
+  3. global min-max normalization,
+  4. optional rotation normalization (rotational invariance),
+  5. radius-graph edges (plain or PBC) + edge lengths,
+  6. global max edge-length normalization,
+  7. optional spherical-coordinate edge descriptors,
+  8. target packing (dict-of-heads) + input-feature column selection,
+  9. train/val/test split (proportional or compositional stratified).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from hydragnn_tpu.data.radius_graph import (
+    edge_lengths,
+    radius_graph,
+    radius_graph_pbc,
+)
+from hydragnn_tpu.data.dataset import (
+    GraphSample,
+    normalize_dataset,
+    scale_features_by_num_nodes,
+    select_input_features,
+    update_predicted_values,
+)
+from hydragnn_tpu.data.lsms import read_lsms_dir
+from hydragnn_tpu.data.splitting import split_dataset
+
+
+def normalize_rotation(samples: Sequence[GraphSample]) -> None:
+    """Center positions and rotate onto principal axes, in place (the
+    reference's PyG ``NormalizeRotation`` transform, used at
+    serialized_dataset_loader.py:128-130). Edge lengths are invariant."""
+    for s in samples:
+        pos = np.asarray(s.pos, dtype=np.float64)
+        pos = pos - pos.mean(axis=0, keepdims=True)
+        # right singular vectors = principal axes
+        _, _, vt = np.linalg.svd(pos, full_matrices=False)
+        s.pos = (pos @ vt.T).astype(np.float32)
+
+
+def build_edges(
+    samples: Sequence[GraphSample],
+    radius: float,
+    max_neighbours: Optional[int],
+    periodic_boundary_conditions: bool = False,
+    rotational_invariance: bool = False,
+    spherical_coordinates: bool = False,
+    max_edge_length: Optional[float] = None,
+) -> float:
+    """Compute radius-graph edges and normalized edge-length attributes for
+    every sample, in place. Returns the max edge length used for
+    normalization (compute it once on train+val+test together, like the
+    reference's global max all-reduce, serialized_dataset_loader.py:155-169)."""
+    if rotational_invariance:
+        normalize_rotation(samples)
+
+    for s in samples:
+        if periodic_boundary_conditions:
+            cell = s.meta.get("cell")
+            if cell is None:
+                raise ValueError("PBC requested but sample has no meta['cell']")
+            ei = radius_graph_pbc(
+                s.pos, radius, cell, max_num_neighbors=max_neighbours, loop=False
+            )
+        else:
+            ei = radius_graph(s.pos, radius, max_num_neighbors=max_neighbours, loop=False)
+        s.edge_index = ei
+        s.edge_attr = edge_lengths(s.pos, ei)
+
+    if max_edge_length is None:
+        max_edge_length = max(
+            (float(s.edge_attr.max()) for s in samples if s.edge_attr.size), default=1.0
+        )
+    for s in samples:
+        s.edge_attr = (s.edge_attr / max_edge_length).astype(np.float32)
+
+    if spherical_coordinates:
+        _append_spherical(samples)
+    return max_edge_length
+
+
+def _append_spherical(samples: Sequence[GraphSample]) -> None:
+    """Append (theta, phi) spherical angles to the edge attributes (PyG
+    ``Spherical`` transform equivalent; rho is the existing length)."""
+    for s in samples:
+        src = s.pos[s.edge_index[0]]
+        dst = s.pos[s.edge_index[1]]
+        d = (dst - src).astype(np.float64)
+        rho = np.linalg.norm(d, axis=1)
+        theta = np.arctan2(d[:, 1], d[:, 0])
+        theta = np.where(theta < 0, theta + 2 * np.pi, theta) / (2 * np.pi)
+        safe_rho = np.where(rho > 0, rho, 1.0)
+        phi = np.arccos(np.clip(d[:, 2] / safe_rho, -1.0, 1.0)) / np.pi
+        s.edge_attr = np.concatenate(
+            [s.edge_attr, theta[:, None].astype(np.float32), phi[:, None].astype(np.float32)],
+            axis=1,
+        )
+
+
+def prepare_dataset(
+    samples: List[GraphSample],
+    config: Dict,
+) -> Tuple[List[GraphSample], List[GraphSample], List[GraphSample], np.ndarray, np.ndarray]:
+    """Full preparation pipeline on an in-memory sample list.
+
+    ``config`` is the reference-shaped top-level dict (Dataset /
+    NeuralNetwork sections). Returns (train, val, test, minmax_graph,
+    minmax_node).
+    """
+    ds_cfg = config["Dataset"]
+    nn_cfg = config["NeuralNetwork"]
+    arch = nn_cfg["Architecture"]
+    voi = nn_cfg["Variables_of_interest"]
+    nf, gf = ds_cfg["node_features"], ds_cfg["graph_features"]
+
+    scale_features_by_num_nodes(samples, gf["name"], nf["name"], gf["dim"], nf["dim"])
+    mm_g, mm_n = normalize_dataset(samples, gf["dim"], nf["dim"])
+
+    desc = ds_cfg.get("Descriptors", {})
+    build_edges(
+        samples,
+        radius=arch["radius"],
+        max_neighbours=arch.get("max_neighbours"),
+        periodic_boundary_conditions=arch.get("periodic_boundary_conditions", False),
+        rotational_invariance=ds_cfg.get("rotational_invariance", False),
+        spherical_coordinates=desc.get("SphericalCoordinates", False),
+    )
+
+    update_predicted_values(
+        samples,
+        voi["type"],
+        voi["output_index"],
+        voi["output_names"],
+        gf["dim"],
+        nf["dim"],
+    )
+    select_input_features(samples, voi["input_node_features"], nf["dim"])
+
+    perc_train = nn_cfg["Training"]["perc_train"]
+    train, val, test = split_dataset(
+        samples,
+        perc_train,
+        stratify_splitting=ds_cfg.get("compositional_stratified_splitting", False),
+    )
+    return train, val, test, mm_g, mm_n
+
+
+def load_raw_samples(config: Dict, path: str) -> List[GraphSample]:
+    """Format dispatch for raw on-disk datasets (reference:
+    hydragnn/preprocess/load_data.py:335-349)."""
+    fmt = config["Dataset"]["format"]
+    if fmt in ("LSMS", "unit_test"):
+        return read_lsms_dir(path, config["Dataset"])
+    raise NameError(f"Data format not recognized for raw data loader: {fmt}")

@@ -1,0 +1,180 @@
+"""Host-side batching into statically-padded GraphBatches.
+
+Replaces the reference's DistributedSampler + PyG DataLoader stack
+(reference: hydragnn/preprocess/load_data.py:226-283). TPU-specific
+concerns drive the design:
+
+  - every batch in a loader has the SAME padded (nodes, edges, graphs)
+    shape, so the jitted train step compiles exactly once;
+  - the pad plan is computed from the dataset up front (worst-case batch
+    composition), not per batch;
+  - per-epoch shuffling is seeded (epoch number = reference
+    ``sampler.set_epoch``, train_validate_test.py:113-115);
+  - multi-host sharding = stride-sharding the sample list per process
+    (DistributedSampler equivalent); multi-device-per-host sharding =
+    stacking D equally-shaped sub-batches along a leading device axis.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Iterator, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from hydragnn_tpu.graph.batch import GraphBatch, batch_graphs
+from hydragnn_tpu.data.dataset import GraphSample, samples_to_graph_dicts
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def pad_plan_for(
+    samples: Sequence[GraphSample],
+    batch_size: int,
+    node_multiple: int = 8,
+    edge_multiple: int = 8,
+) -> tuple:
+    """Static (n_node_pad, n_edge_pad, n_graph_pad) covering any batch of
+    ``batch_size`` samples drawn from ``samples``.
+
+    Worst case is the ``batch_size`` largest graphs landing in one batch;
+    bounding by that keeps every epoch's batches one compiled shape.
+    """
+    nodes = sorted((s.num_nodes for s in samples), reverse=True)
+    edges = sorted((s.num_edges for s in samples), reverse=True)
+    worst_nodes = sum(nodes[:batch_size])
+    worst_edges = sum(edges[:batch_size])
+    return (
+        _round_up(worst_nodes + 1, node_multiple),
+        max(_round_up(worst_edges + 1, edge_multiple), edge_multiple),
+        batch_size + 1,
+    )
+
+
+class GraphLoader:
+    """Iterable over fixed-shape GraphBatches.
+
+    Args:
+      samples: the split's samples (edges and targets already built).
+      batch_size: graphs per batch (per process, matching the reference's
+        per-rank batch size under DDP).
+      shuffle: reshuffle each epoch (seeded by ``set_epoch``).
+      num_shards / shard_rank: multi-host data sharding (DistributedSampler
+        equivalent): this loader only sees samples[shard_rank::num_shards].
+      device_stack: if > 1, each yielded batch has a leading device axis of
+        this size; batch_size must divide evenly by it. Edge indices stay
+        local to each sub-batch (shard_map-ready: no cross-device gathers).
+    """
+
+    def __init__(
+        self,
+        samples: Sequence[GraphSample],
+        batch_size: int,
+        shuffle: bool = False,
+        seed: int = 0,
+        num_shards: int = 1,
+        shard_rank: int = 0,
+        device_stack: int = 1,
+        node_multiple: int = 8,
+        edge_multiple: int = 8,
+        drop_last: bool = False,
+    ):
+        if device_stack > 1 and batch_size % device_stack != 0:
+            raise ValueError(
+                f"batch_size {batch_size} must be divisible by device_stack {device_stack}"
+            )
+        self.all_samples = list(samples)
+        # DistributedSampler-style equalization: every shard sees exactly
+        # ceil(n / num_shards) samples (wrapping around), so every process
+        # runs the same number of jitted steps — required for cross-host
+        # collectives to stay in lockstep.
+        n = len(self.all_samples)
+        if num_shards > 1 and n > 0:
+            per_shard = math.ceil(n / num_shards)
+            idx = [(shard_rank + k * num_shards) % n for k in range(per_shard)]
+            self.samples = [self.all_samples[i] for i in idx]
+        else:
+            self.samples = list(self.all_samples)
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.device_stack = device_stack
+        self.drop_last = drop_last
+        self._epoch = 0
+        sub = batch_size // device_stack
+        # Pad plan from the FULL dataset, not the local shard: all hosts
+        # must compile identical batch shapes.
+        self.pad_nodes, self.pad_edges, self.pad_graphs = pad_plan_for(
+            self.all_samples, sub, node_multiple, edge_multiple
+        )
+        self._dicts = samples_to_graph_dicts(self.samples)
+
+    def set_epoch(self, epoch: int) -> None:
+        self._epoch = epoch
+
+    def __len__(self) -> int:
+        n = len(self.samples)
+        if self.drop_last:
+            return n // self.batch_size
+        return math.ceil(n / self.batch_size)
+
+    @property
+    def num_samples(self) -> int:
+        return len(self.samples)
+
+    def _order(self) -> np.ndarray:
+        n = len(self.samples)
+        if not self.shuffle:
+            return np.arange(n)
+        rng = np.random.default_rng(self.seed + self._epoch)
+        return rng.permutation(n)
+
+    def _make_sub_batch(self, idx: Sequence[int]) -> GraphBatch:
+        return batch_graphs(
+            [self._dicts[i] for i in idx],
+            n_node_pad=self.pad_nodes,
+            n_edge_pad=self.pad_edges,
+            n_graph_pad=self.pad_graphs,
+        )
+
+    def __iter__(self) -> Iterator[GraphBatch]:
+        order = self._order()
+        bs = self.batch_size
+        nb = len(self)
+        sub = bs // self.device_stack
+        for b in range(nb):
+            chunk = order[b * bs : (b + 1) * bs]
+            if self.device_stack == 1:
+                yield self._make_sub_batch(chunk)
+            else:
+                subs = []
+                for d in range(self.device_stack):
+                    part = chunk[d * sub : (d + 1) * sub]
+                    if len(part) == 0:
+                        # Partial final batch: an all-padding sub-batch keeps
+                        # the device axis full; masks zero it out everywhere.
+                        part = chunk[:1]
+                        empty = self._make_sub_batch(part)
+                        subs.append(_mask_out(empty))
+                    else:
+                        subs.append(self._make_sub_batch(part))
+                yield jax.tree_util.tree_map(lambda *xs: np.stack(xs), *subs)
+
+    def num_graphs_total(self) -> int:
+        return len(self.samples)
+
+
+def _mask_out(batch: GraphBatch) -> GraphBatch:
+    """Turn a batch into pure padding (all masks False, counts zero)."""
+    import numpy as _np
+
+    return batch.replace(
+        node_mask=_np.zeros_like(_np.asarray(batch.node_mask)),
+        edge_mask=_np.zeros_like(_np.asarray(batch.edge_mask)),
+        graph_mask=_np.zeros_like(_np.asarray(batch.graph_mask)),
+        n_node=_np.zeros_like(_np.asarray(batch.n_node)),
+        n_edge=_np.zeros_like(_np.asarray(batch.n_edge)),
+    )

@@ -1,0 +1,181 @@
+"""Host-side radius-graph construction (cell list, optional PBC).
+
+The reference builds neighbor graphs with torch-cluster's ``RadiusGraph``
+(reference: hydragnn/preprocess/utils.py:99-112) and with ase's C neighbor
+list for periodic boundary conditions (reference:
+hydragnn/preprocess/utils.py:131-171). Both run on host during
+preprocessing; here the equivalent is a numpy cell-list builder so the
+device never sees a dynamic shape. Edge convention matches PyG: each
+directed edge (sender j -> receiver i) with distance(j, i) <= r; no
+self-loops unless requested.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+def radius_graph(
+    pos: np.ndarray,
+    r: float,
+    max_num_neighbors: Optional[int] = None,
+    loop: bool = False,
+) -> np.ndarray:
+    """Edges within radius ``r``; returns edge_index [2, E] int64
+    (row 0 = senders, row 1 = receivers), receiver-major sorted.
+
+    ``max_num_neighbors`` caps incoming edges per receiver, keeping the
+    *nearest* ones (torch-cluster semantics keep arbitrary ones; nearest is
+    deterministic and at least as informative).
+    """
+    pos = np.asarray(pos, dtype=np.float64)
+    n = pos.shape[0]
+    if n == 0:
+        return np.zeros((2, 0), dtype=np.int64)
+
+    senders, receivers, dists = _candidate_pairs(pos, pos, r)
+    if not loop:
+        keep = senders != receivers
+        senders, receivers, dists = senders[keep], receivers[keep], dists[keep]
+    return _cap_and_sort(senders, receivers, dists, max_num_neighbors)
+
+
+def radius_graph_pbc(
+    pos: np.ndarray,
+    r: float,
+    cell: np.ndarray,
+    pbc: Tuple[bool, bool, bool] = (True, True, True),
+    max_num_neighbors: Optional[int] = None,
+    loop: bool = False,
+) -> np.ndarray:
+    """Periodic radius graph via explicit image shifts (supercell method,
+    matching ase.neighborlist semantics used by the reference's
+    ``RadiusGraphPBC``, hydragnn/preprocess/utils.py:131-171): a pair can
+    contribute several edges through different periodic images, and an atom
+    can neighbor its own image (i == j with a nonzero shift).
+    """
+    pos = np.asarray(pos, dtype=np.float64)
+    cell = np.asarray(cell, dtype=np.float64).reshape(3, 3)
+    n = pos.shape[0]
+    if n == 0:
+        return np.zeros((2, 0), dtype=np.int64)
+
+    # Number of cell repeats needed in each periodic direction so every
+    # image within r is covered (distance between lattice planes).
+    recip = np.linalg.inv(cell).T
+    heights = 1.0 / np.maximum(np.linalg.norm(recip, axis=1), 1e-30)
+    reps = [int(np.ceil(r / heights[k])) if pbc[k] else 0 for k in range(3)]
+
+    shifts = [
+        np.array([i, j, k], dtype=np.float64) @ cell
+        for i in range(-reps[0], reps[0] + 1)
+        for j in range(-reps[1], reps[1] + 1)
+        for k in range(-reps[2], reps[2] + 1)
+    ]
+
+    all_s, all_r, all_d = [], [], []
+    for shift in shifts:
+        is_zero_shift = not np.any(shift)
+        s, t, d = _candidate_pairs(pos + shift, pos, r)
+        if is_zero_shift and not loop:
+            keep = s != t
+            s, t, d = s[keep], t[keep], d[keep]
+        all_s.append(s)
+        all_r.append(t)
+        all_d.append(d)
+    senders = np.concatenate(all_s)
+    receivers = np.concatenate(all_r)
+    dists = np.concatenate(all_d)
+    return _cap_and_sort(senders, receivers, dists, max_num_neighbors)
+
+
+def edge_lengths(pos: np.ndarray, edge_index: np.ndarray) -> np.ndarray:
+    """[E, 1] Euclidean edge lengths (the reference's ``Distance``
+    transform with norm=False, hydragnn/preprocess/serialized_dataset_loader.py)."""
+    pos = np.asarray(pos, dtype=np.float64)
+    d = pos[edge_index[1]] - pos[edge_index[0]]
+    return np.linalg.norm(d, axis=1, keepdims=True).astype(np.float32)
+
+
+def _candidate_pairs(
+    src_pos: np.ndarray, dst_pos: np.ndarray, r: float
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """All (src, dst, dist) pairs with dist <= r, via a uniform cell grid.
+
+    Cell size = r, so neighbors of a dst point lie in the 27 surrounding
+    cells of its grid cell. O(N * avg_bucket) instead of O(N^2).
+    """
+    n_src, n_dst = src_pos.shape[0], dst_pos.shape[0]
+    if n_src * n_dst <= 4096:  # tiny: brute force is faster than bucketing
+        diff = src_pos[:, None, :] - dst_pos[None, :, :]
+        dist = np.sqrt((diff * diff).sum(-1))
+        s, t = np.nonzero(dist <= r)
+        return s.astype(np.int64), t.astype(np.int64), dist[s, t]
+
+    origin = np.minimum(src_pos.min(0), dst_pos.min(0))
+    inv = 1.0 / max(r, 1e-12)
+    src_cell = np.floor((src_pos - origin) * inv).astype(np.int64)
+    dst_cell = np.floor((dst_pos - origin) * inv).astype(np.int64)
+
+    def key(c):
+        # Collision-free linear key over the bounded grid.
+        extent = max(int(src_cell.max() if n_src else 0), int(dst_cell.max() if n_dst else 0)) + 3
+        return (c[:, 0] * extent + c[:, 1]) * extent + c[:, 2], extent
+
+    skey, extent = key(src_cell)
+    order = np.argsort(skey, kind="stable")
+    skey_sorted = skey[order]
+
+    out_s, out_t, out_d = [], [], []
+    offsets = np.array(
+        [[i, j, k] for i in (-1, 0, 1) for j in (-1, 0, 1) for k in (-1, 0, 1)],
+        dtype=np.int64,
+    )
+    for off in offsets:
+        qkey = ((dst_cell[:, 0] + off[0]) * extent + (dst_cell[:, 1] + off[1])) * extent + (
+            dst_cell[:, 2] + off[2]
+        )
+        lo = np.searchsorted(skey_sorted, qkey, side="left")
+        hi = np.searchsorted(skey_sorted, qkey, side="right")
+        counts = hi - lo
+        if counts.sum() == 0:
+            continue
+        t_idx = np.repeat(np.arange(n_dst, dtype=np.int64), counts)
+        # Gather the source indices bucket-by-bucket.
+        s_idx = order[
+            np.concatenate([np.arange(l, h, dtype=np.int64) for l, h in zip(lo, hi) if h > l])
+        ]
+        d = np.linalg.norm(src_pos[s_idx] - dst_pos[t_idx], axis=1)
+        keep = d <= r
+        out_s.append(s_idx[keep])
+        out_t.append(t_idx[keep])
+        out_d.append(d[keep])
+    if not out_s:
+        return (
+            np.zeros(0, dtype=np.int64),
+            np.zeros(0, dtype=np.int64),
+            np.zeros(0, dtype=np.float64),
+        )
+    return np.concatenate(out_s), np.concatenate(out_t), np.concatenate(out_d)
+
+
+def _cap_and_sort(
+    senders: np.ndarray,
+    receivers: np.ndarray,
+    dists: np.ndarray,
+    max_num_neighbors: Optional[int],
+) -> np.ndarray:
+    """Sort edges receiver-major (then by distance) and cap per-receiver
+    in-degree. Receiver-major ordering makes downstream ``segment_sum``
+    over receivers a sorted reduction (better XLA lowering)."""
+    order = np.lexsort((dists, receivers))
+    senders, receivers, dists = senders[order], receivers[order], dists[order]
+    if max_num_neighbors is not None and receivers.size:
+        # rank of each edge within its receiver run
+        starts = np.searchsorted(receivers, receivers, side="left")
+        rank = np.arange(receivers.size) - starts
+        keep = rank < max_num_neighbors
+        senders, receivers = senders[keep], receivers[keep]
+    return np.stack([senders, receivers]).astype(np.int64)

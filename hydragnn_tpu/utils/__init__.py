@@ -1,0 +1,33 @@
+from hydragnn_tpu.utils.config import (
+    load_config,
+    update_config,
+    get_log_name_config,
+    save_config,
+    check_if_graph_size_variable,
+    max_in_degree,
+    pna_degree_histogram,
+)
+from hydragnn_tpu.utils.print_utils import (
+    print_distributed,
+    iterate_tqdm,
+    setup_log,
+    log,
+)
+from hydragnn_tpu.utils.time_utils import Timer, print_timers, reset_timers
+
+__all__ = [
+    "load_config",
+    "update_config",
+    "get_log_name_config",
+    "save_config",
+    "check_if_graph_size_variable",
+    "max_in_degree",
+    "pna_degree_histogram",
+    "print_distributed",
+    "iterate_tqdm",
+    "setup_log",
+    "log",
+    "Timer",
+    "print_timers",
+    "reset_timers",
+]

@@ -1,0 +1,75 @@
+"""Verbosity-leveled, process-aware printing and logging.
+
+Mirrors the reference's scheme (reference:
+hydragnn/utils/print_utils.py:20-104): 5 verbosity levels (0 silent ->
+4 all-processes), process-0 filtering, and a per-run file+console logger.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+from typing import Iterable, Optional
+
+VERBOSITY_LEVELS = (0, 1, 2, 3, 4)
+_logger: Optional[logging.Logger] = None
+
+
+def _process_index() -> int:
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def print_distributed(verbosity_level: int, *args) -> None:
+    if verbosity_level not in VERBOSITY_LEVELS:
+        raise ValueError(f"Unknown verbosity level: {verbosity_level}")
+    # Levels 3 and 4 print on every process (reference print_utils.py maps
+    # both to print_all_processes); 1-2 print on process 0 only.
+    if verbosity_level >= 3 or (verbosity_level > 0 and _process_index() == 0):
+        print(f"[{_process_index()}]", *args)
+
+
+def iterate_tqdm(iterable: Iterable, verbosity_level: int, **kwargs):
+    """Wrap with tqdm at verbosity >= 2 on process 0 (reference:
+    print_utils.py:56-60); falls back to the plain iterable."""
+    if verbosity_level >= 2 and _process_index() == 0:
+        try:
+            from tqdm import tqdm
+
+            return tqdm(iterable, **kwargs)
+        except Exception:
+            return iterable
+    return iterable
+
+
+def setup_log(prefix: str, log_dir: str = "./logs") -> logging.Logger:
+    """File+console logger under ``log_dir/<prefix>/run.log`` (reference:
+    print_utils.py:63-88); every process writes its own file suffix."""
+    global _logger
+    path = os.path.join(log_dir, prefix)
+    os.makedirs(path, exist_ok=True)
+    rank = _process_index()
+    logger = logging.getLogger(f"hydragnn_tpu.{prefix}")
+    logger.setLevel(logging.INFO)
+    logger.handlers.clear()
+    fh = logging.FileHandler(os.path.join(path, f"run{'' if rank == 0 else rank}.log"))
+    fh.setFormatter(logging.Formatter("%(asctime)s %(message)s"))
+    logger.addHandler(fh)
+    if rank == 0:
+        sh = logging.StreamHandler(sys.stdout)
+        logger.addHandler(sh)
+    _logger = logger
+    return logger
+
+
+def log(*args) -> None:
+    msg = " ".join(str(a) for a in args)
+    if _logger is not None:
+        _logger.info(msg)
+    elif _process_index() == 0:
+        print(msg)

@@ -1,0 +1,84 @@
+"""Named cumulative timers with cross-process reduction.
+
+Mirrors the reference Timer registry (reference:
+hydragnn/utils/time_utils.py:22-138): named timers accumulate wall time
+across start/stop pairs; ``print_timers`` reports min/max/avg across
+processes (a host-side psum when running multi-process).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict
+
+from hydragnn_tpu.utils.print_utils import print_distributed
+
+_REGISTRY: Dict[str, "Timer"] = {}
+
+
+class Timer:
+    def __init__(self, name: str):
+        self.name = name
+        existing = _REGISTRY.get(name)
+        if existing is not None:
+            self.__dict__ = existing.__dict__
+            return
+        self.elapsed = 0.0
+        self.count = 0
+        self._start = None
+        _REGISTRY[name] = self
+
+    def start(self) -> None:
+        if self._start is not None:
+            raise RuntimeError(f"Timer {self.name} already running")
+        self._start = time.perf_counter()
+
+    def stop(self) -> None:
+        if self._start is None:
+            raise RuntimeError(f"Timer {self.name} not running")
+        self.elapsed += time.perf_counter() - self._start
+        self.count += 1
+        self._start = None
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+def reset_timers() -> None:
+    _REGISTRY.clear()
+
+
+def print_timers(verbosity: int = 1) -> Dict[str, Dict[str, float]]:
+    """Report each timer; multi-process runs reduce min/max/avg across
+    processes with a host-side allgather through jax."""
+    import numpy as np
+
+    stats = {}
+    names = sorted(_REGISTRY)
+    values = np.array([_REGISTRY[n].elapsed for n in names])
+    try:
+        import jax
+
+        nproc = jax.process_count()
+    except Exception:
+        nproc = 1
+    if nproc > 1 and len(values):
+        from jax.experimental import multihost_utils
+
+        all_vals = multihost_utils.process_allgather(values)
+        vmin, vmax, vavg = all_vals.min(0), all_vals.max(0), all_vals.mean(0)
+    else:
+        vmin = vmax = vavg = values
+    for i, n in enumerate(names):
+        stats[n] = {"min": float(vmin[i]), "max": float(vmax[i]), "avg": float(vavg[i])}
+        print_distributed(
+            verbosity,
+            f"timer {n}: avg {vavg[i]:.4f}s min {vmin[i]:.4f}s max {vmax[i]:.4f}s "
+            f"(n={_REGISTRY[n].count})",
+        )
+    return stats

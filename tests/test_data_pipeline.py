@@ -1,0 +1,230 @@
+"""Data pipeline tests: generator round-trip, radius graph, normalization,
+splitting, loader shapes. Mirrors the reference's unit-test strategy of a
+deterministic dataset with known closed-form structure (reference:
+tests/deterministic_graph_data.py, tests/test_periodic_boundary_conditions.py)."""
+
+import numpy as np
+import pytest
+
+from hydragnn_tpu.data.synthetic import deterministic_graph_data, write_lsms_files
+from hydragnn_tpu.data.lsms import read_lsms_dir
+from hydragnn_tpu.data.radius_graph import radius_graph, radius_graph_pbc, edge_lengths
+from hydragnn_tpu.data.ingest import prepare_dataset, build_edges
+from hydragnn_tpu.data.loader import GraphLoader
+from hydragnn_tpu.data.splitting import split_dataset
+from hydragnn_tpu.utils.config import update_config
+
+
+def base_config(multihead=True):
+    voi = (
+        {
+            "input_node_features": [0],
+            "output_names": ["sum_x_x2_x3", "x", "x2", "x3"],
+            "output_index": [0, 0, 1, 2],
+            "type": ["graph", "node", "node", "node"],
+        }
+        if multihead
+        else {
+            "input_node_features": [0],
+            "output_names": ["sum_x_x2_x3"],
+            "output_index": [0],
+            "type": ["graph"],
+        }
+    )
+    return {
+        "Dataset": {
+            "name": "unit_test",
+            "format": "unit_test",
+            "compositional_stratified_splitting": True,
+            "rotational_invariance": False,
+            "node_features": {
+                "name": ["x", "x2", "x3"],
+                "dim": [1, 1, 1],
+                "column_index": [0, 6, 7],
+            },
+            "graph_features": {
+                "name": ["sum_x_x2_x3"],
+                "dim": [1],
+                "column_index": [0],
+            },
+        },
+        "NeuralNetwork": {
+            "Architecture": {
+                "model_type": "PNA",
+                "radius": 2.0,
+                "max_neighbours": 100,
+                "periodic_boundary_conditions": False,
+                "hidden_dim": 8,
+                "num_conv_layers": 2,
+                "output_heads": {
+                    "graph": {
+                        "num_sharedlayers": 2,
+                        "dim_sharedlayers": 4,
+                        "num_headlayers": 2,
+                        "dim_headlayers": [10, 10],
+                    },
+                    "node": {"num_headlayers": 2, "dim_headlayers": [4, 4], "type": "mlp"},
+                },
+                "task_weights": [20.0, 1.0, 1.0, 1.0] if multihead else [1.0],
+            },
+            "Variables_of_interest": voi,
+            "Training": {
+                "num_epoch": 2,
+                "perc_train": 0.7,
+                "loss_function_type": "mse",
+                "batch_size": 16,
+                "Optimizer": {"type": "AdamW", "learning_rate": 0.01},
+            },
+        },
+    }
+
+
+def pytest_generator_lsms_roundtrip(tmp_path):
+    mem = deterministic_graph_data(number_configurations=20, seed=11)
+    write_lsms_files(str(tmp_path), number_configurations=20, seed=11)
+    cfg = base_config()["Dataset"]
+    disk = read_lsms_dir(str(tmp_path), cfg)
+    # files sort lexically; match by configuration id
+    order = sorted(range(20), key=lambda k: f"output{k}.txt")
+    for file_pos, conf_id in enumerate(order):
+        np.testing.assert_allclose(disk[file_pos].x, mem[conf_id].x, rtol=1e-6)
+        np.testing.assert_allclose(disk[file_pos].pos, mem[conf_id].pos, rtol=1e-6)
+        np.testing.assert_allclose(
+            disk[file_pos].graph_y, mem[conf_id].graph_y, rtol=1e-6
+        )
+
+
+def pytest_radius_graph_simple():
+    # 3 points on a line, spacing 1; r=1.5 connects neighbors only
+    pos = np.array([[0.0, 0, 0], [1.0, 0, 0], [2.0, 0, 0]])
+    ei = radius_graph(pos, 1.5)
+    pairs = set(map(tuple, ei.T))
+    assert pairs == {(0, 1), (1, 0), (1, 2), (2, 1)}
+    lengths = edge_lengths(pos, ei)
+    np.testing.assert_allclose(lengths, np.ones((4, 1)))
+
+
+def pytest_radius_graph_max_neighbors():
+    # hub with 4 spokes at increasing distance; cap keeps the 2 nearest
+    pos = np.array(
+        [[0.0, 0, 0], [1.0, 0, 0], [0, 1.1, 0], [0, 0, 1.2], [1.3, 0, 0]]
+    )
+    ei = radius_graph(pos, 2.0, max_num_neighbors=2)
+    incoming0 = ei[0][ei[1] == 0]
+    assert set(incoming0.tolist()) == {1, 2}
+
+
+def pytest_radius_graph_brute_vs_celllist():
+    rng = np.random.default_rng(0)
+    pos = rng.uniform(0, 10, size=(300, 3))  # large enough for cell-list path
+    r = 1.2
+    ei = radius_graph(pos, r)
+    # brute force reference
+    diff = pos[:, None] - pos[None, :]
+    dist = np.sqrt((diff**2).sum(-1))
+    expect = {(j, i) for j in range(300) for i in range(300) if j != i and dist[j, i] <= r}
+    assert set(map(tuple, ei.T)) == expect
+
+
+def pytest_radius_graph_pbc_counts():
+    # single atom in a unit cube with r=1: 6 face-neighbor images
+    pos = np.zeros((1, 3))
+    cell = np.eye(3)
+    ei = radius_graph_pbc(pos, 1.0, cell)
+    assert ei.shape[1] == 6
+    # two atoms: H2-like pair, each sees the other plus its own images
+    pos2 = np.array([[0.0, 0, 0], [0.5, 0, 0]])
+    ei2 = radius_graph_pbc(pos2, 0.6, np.eye(3) * 1.0)
+    # each atom: other atom at 0.5 in both x directions = 2 edges each way
+    pairs = [tuple(e) for e in ei2.T]
+    assert pairs.count((0, 1)) == 2 and pairs.count((1, 0)) == 2
+
+
+def pytest_prepare_dataset_normalized_and_packed():
+    config = base_config()
+    samples = deterministic_graph_data(number_configurations=40, seed=5)
+    train, val, test, mm_g, mm_n = prepare_dataset(samples, config)
+    for split in (train, val, test):
+        for s in split:
+            assert 0.0 <= s.x.min() and s.x.max() <= 1.0
+            assert s.edge_attr.max() <= 1.0 + 1e-6
+            assert set(s.node_targets) == {"x", "x2", "x3"}
+            assert set(s.graph_targets) == {"sum_x_x2_x3"}
+            assert s.x.shape[1] == 1  # input selection applied
+
+
+def pytest_update_config_inference():
+    config = base_config()
+    samples = deterministic_graph_data(number_configurations=40, seed=5)
+    train, val, test, _, _ = prepare_dataset(samples, config)
+    config = update_config(config, train, val, test)
+    arch = config["NeuralNetwork"]["Architecture"]
+    assert arch["output_dim"] == [1, 1, 1, 1]
+    assert arch["output_type"] == ["graph", "node", "node", "node"]
+    assert arch["input_dim"] == 1
+    assert arch["max_neighbours"] > 0
+    assert arch["pna_deg"] is not None and sum(arch["pna_deg"]) > 0
+    assert arch["edge_dim"] is None  # no edge_features declared
+
+
+def pytest_split_plain_proportions():
+    samples = deterministic_graph_data(number_configurations=50, seed=1)
+    tr, va, te = split_dataset(samples, 0.7, stratify_splitting=False)
+    assert len(tr) == 35 and len(va) == 7 and len(te) == 8
+
+
+def pytest_stratified_split_covers_categories():
+    from hydragnn_tpu.data.splitting import composition_categories
+
+    samples = deterministic_graph_data(number_configurations=200, seed=2)
+    tr, va, te = split_dataset(samples, 0.7, stratify_splitting=True)
+    cats_all = set(composition_categories(list(samples)))
+    cats_train = set(composition_categories(tr))
+    # every category with >=2 members must appear in train
+    from collections import Counter
+
+    counts = Counter(composition_categories(list(samples)))
+    for c, n in counts.items():
+        if n >= 2:
+            assert c in cats_train
+
+
+def pytest_loader_fixed_shapes_and_masks():
+    config = base_config()
+    samples = deterministic_graph_data(number_configurations=40, seed=5)
+    train, _, _, _, _ = prepare_dataset(samples, config)
+    loader = GraphLoader(train, batch_size=8, shuffle=True, seed=0)
+    shapes = set()
+    total_real = 0
+    for epoch in range(2):
+        loader.set_epoch(epoch)
+        epoch_real = 0
+        for b in loader:
+            shapes.add((b.num_nodes, b.num_edges, b.num_graphs))
+            epoch_real += int(np.asarray(b.graph_mask).sum())
+        assert epoch_real == len(train)
+    assert len(shapes) == 1  # one compiled shape for the whole run
+
+
+def pytest_loader_device_stack():
+    config = base_config()
+    samples = deterministic_graph_data(number_configurations=40, seed=5)
+    train, _, _, _, _ = prepare_dataset(samples, config)
+    loader = GraphLoader(train, batch_size=8, device_stack=4)
+    seen = 0
+    for b in loader:
+        assert b.nodes.ndim == 3 and b.nodes.shape[0] == 4
+        seen += int(np.asarray(b.graph_mask).sum())
+    assert seen == len(train)
+
+
+def pytest_loader_sharding():
+    samples = deterministic_graph_data(number_configurations=41, seed=5)
+    build_edges(samples, radius=2.0, max_neighbours=100)
+    l0 = GraphLoader(samples, batch_size=8, num_shards=2, shard_rank=0)
+    l1 = GraphLoader(samples, batch_size=8, num_shards=2, shard_rank=1)
+    # DistributedSampler-style equalization: both shards get ceil(41/2)=21
+    # samples (one wraps around) so every host runs the same step count.
+    assert l0.num_samples == 21 and l1.num_samples == 21
+    assert len(l0) == len(l1) == 3
+    assert (l0.pad_nodes, l0.pad_edges) == (l1.pad_nodes, l1.pad_edges)
