@@ -1,0 +1,93 @@
+"""Benchmark: flagship (PNA multi-head) training throughput in graphs/sec.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no throughput numbers (BASELINE.md: "none
+published"), so ``vs_baseline`` is measured against the first recorded
+bench of this build (BENCH_r1.json, written by the driver) when present,
+else 1.0.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def main() -> None:
+    import jax
+
+    # keep bench on the real device the driver provides (TPU under axon,
+    # else whatever the default backend is)
+    import numpy as np
+
+    from hydragnn_tpu.flagship import build_flagship
+    from hydragnn_tpu.train import create_train_state, make_train_step, select_optimizer
+
+    n_samples = int(os.environ.get("BENCH_SAMPLES", 512))
+    batch_size = int(os.environ.get("BENCH_BATCH", 128))
+    hidden = int(os.environ.get("BENCH_HIDDEN", 128))
+    layers = int(os.environ.get("BENCH_LAYERS", 6))
+    measure_steps = int(os.environ.get("BENCH_STEPS", 40))
+
+    config, model, variables, loader = build_flagship(
+        n_samples=n_samples,
+        hidden_dim=hidden,
+        num_conv_layers=layers,
+        batch_size=batch_size,
+    )
+    tx = select_optimizer(config["NeuralNetwork"]["Training"])
+    state = create_train_state(variables, tx)
+    step = make_train_step(model, tx)
+
+    batches = list(loader)
+    if not batches:
+        raise RuntimeError("empty bench loader")
+    graphs_per_batch = batch_size
+
+    # compile + warmup
+    state, loss, _ = step(state, batches[0])
+    jax.block_until_ready(loss)
+
+    done = 0
+    t0 = time.perf_counter()
+    while done < measure_steps:
+        for b in batches:
+            state, loss, _ = step(state, b)
+            done += 1
+            if done >= measure_steps:
+                break
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    graphs_per_sec = done * graphs_per_batch / dt
+
+    baseline = None
+    for fname in ("BENCH_r1.json", "BENCH_BASELINE.json"):
+        p = os.path.join(os.path.dirname(os.path.abspath(__file__)), fname)
+        if os.path.exists(p):
+            try:
+                with open(p) as f:
+                    rec = json.load(f)
+                if rec.get("unit") == "graphs/sec" and rec.get("value"):
+                    baseline = float(rec["value"])
+                    break
+            except (json.JSONDecodeError, KeyError, TypeError, ValueError):
+                pass
+    vs_baseline = graphs_per_sec / baseline if baseline else 1.0
+
+    print(
+        json.dumps(
+            {
+                "metric": "flagship_pna_multihead_train_throughput",
+                "value": round(graphs_per_sec, 2),
+                "unit": "graphs/sec",
+                "vs_baseline": round(vs_baseline, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

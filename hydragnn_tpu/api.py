@@ -1,0 +1,230 @@
+"""Top-level entry points: ``run_training`` and ``run_prediction``.
+
+Mirrors the reference pipelines (reference: hydragnn/run_training.py:42-133
+and hydragnn/run_prediction.py:27-83): log setup -> distributed init ->
+data load/split -> config inference -> model factory -> optimizer ->
+optional checkpoint-continue -> epoch loop -> save model -> timers.
+Differences by design: the "DDP wrap" disappears (data parallelism is a
+sharding annotation in the train step, not a model wrapper), and H2D
+movement happens in the loader (fixed-shape batches).
+
+Both accept a config file path or dict (the reference uses singledispatch,
+run_training.py:42-57); the dataset comes either from
+``Dataset.path["total"]`` raw files or from an in-memory ``samples`` list
+(the synthetic/test path).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from hydragnn_tpu.data.ingest import load_raw_samples, prepare_dataset
+from hydragnn_tpu.data.loader import GraphLoader
+from hydragnn_tpu.models.create import create_model_config
+from hydragnn_tpu.train import (
+    create_train_state,
+    make_eval_step,
+    select_optimizer,
+    test_epoch,
+    train_validate_test,
+)
+from hydragnn_tpu.utils.checkpoint import (
+    load_existing_model,
+    load_existing_model_config,
+    save_model,
+)
+from hydragnn_tpu.utils.config import (
+    get_log_name_config,
+    load_config,
+    save_config,
+    update_config,
+)
+from hydragnn_tpu.utils.print_utils import setup_log
+from hydragnn_tpu.utils.time_utils import Timer, print_timers
+
+
+def prepare_loaders_and_config(
+    config: Dict[str, Any],
+    samples: Optional[List] = None,
+    device_stack: int = 1,
+) -> Tuple[GraphLoader, GraphLoader, GraphLoader, Dict[str, Any]]:
+    """Data load + split + config inference (reference:
+    dataset_loading_and_splitting + update_config, run_training.py:67-78).
+
+    ``device_stack`` > 1 makes every loader yield batches with a leading
+    device axis for the sharded (data-parallel) step functions."""
+    if samples is None:
+        path = config["Dataset"]["path"]
+        if "total" not in path:
+            raise NotImplementedError(
+                "per-split raw paths not supported yet; provide Dataset.path.total"
+            )
+        samples = load_raw_samples(config, path["total"])
+    train, val, test, mm_g, mm_n = prepare_dataset(samples, config)
+
+    voi = config["NeuralNetwork"]["Variables_of_interest"]
+    voi["minmax_graph_feature"] = mm_g.tolist()
+    voi["minmax_node_feature"] = mm_n.tolist()
+    config = update_config(config, train, val, test)
+
+    training = config["NeuralNetwork"]["Training"]
+    bs = int(training["batch_size"])
+    nproc, rank = jax.process_count(), jax.process_index()
+    kw = dict(
+        num_shards=nproc,
+        shard_rank=rank,
+        device_stack=device_stack,
+        cache_device_batches=bool(training.get("cache_device_batches", False)),
+    )
+    train_loader = GraphLoader(train, bs, shuffle=True, **kw)
+    val_loader = GraphLoader(val, bs, **kw)
+    test_loader = GraphLoader(test, bs, **kw)
+    return train_loader, val_loader, test_loader, config
+
+
+def _choose_device_stack(config: Dict[str, Any]) -> int:
+    """Data-parallel width for this process: all local devices when the
+    batch size divides evenly, else single-device. Multi-host runs need
+    the distributed data plane (DDStore/ADIOS equivalents) and are
+    rejected until it lands — silently training unsynced replicas would
+    be worse (reference DDP all-reduces every step)."""
+    if jax.process_count() > 1:
+        raise NotImplementedError(
+            "multi-host run_training requires the distributed data plane; "
+            "single-host multi-device (data mesh) is supported"
+        )
+    n_local = jax.local_device_count()
+    bs = int(config["NeuralNetwork"]["Training"]["batch_size"])
+    return n_local if n_local > 1 and bs % n_local == 0 else 1
+
+
+def run_training(
+    config_file_or_dict,
+    samples: Optional[List] = None,
+    log_dir: str = "./logs/",
+):
+    """Full training pipeline; returns (model, state, history, config)."""
+    config = load_config(config_file_or_dict)
+    verbosity = config.get("Verbosity", {}).get("level", 0)
+
+    timer = Timer("total_training")
+    timer.start()
+
+    device_stack = _choose_device_stack(config)
+    train_loader, val_loader, test_loader, config = prepare_loaders_and_config(
+        config, samples, device_stack=device_stack
+    )
+    log_name = get_log_name_config(config)
+    setup_log(log_name, log_dir)
+    save_config(config, log_name, log_dir)
+
+    nn_config = config["NeuralNetwork"]
+    example = next(iter(train_loader))
+    sharded = device_stack > 1
+    if sharded:
+        example_one = jax.tree_util.tree_map(lambda x: x[0], example)
+    else:
+        example_one = example
+
+    training = nn_config["Training"]
+    freeze = bool(nn_config["Architecture"].get("freeze_conv_layers"))
+    tx = select_optimizer(training, freeze_conv=freeze)
+
+    train_step = eval_step = eval_step_out = None
+    if sharded:
+        from hydragnn_tpu.parallel import (
+            DATA_AXIS,
+            batch_sharding,
+            make_mesh,
+            make_sharded_eval_step,
+            make_sharded_train_step,
+            place_state,
+        )
+
+        model, variables = create_model_config(
+            nn_config, example_one, bn_axis_name=DATA_AXIS
+        )
+        mesh = make_mesh(device_stack)
+        for loader in (train_loader, val_loader, test_loader):
+            loader.set_sharding(batch_sharding(mesh))
+        zero1 = bool(training.get("Optimizer", {}).get("use_zero_redundancy", False))
+        state = create_train_state(variables, tx)
+        state = load_existing_model_config(state, training, log_dir)
+        state = place_state(mesh, state, zero1=zero1)
+        train_step = make_sharded_train_step(model, tx, mesh, zero1=zero1)
+        eval_step = make_sharded_eval_step(model, mesh)
+        eval_step_out = make_sharded_eval_step(model, mesh, with_outputs=True)
+    else:
+        model, variables = create_model_config(nn_config, example_one)
+        state = create_train_state(variables, tx)
+        state = load_existing_model_config(state, training, log_dir)
+
+    viz = config.get("Visualization", {})
+    state, history = train_validate_test(
+        model,
+        tx,
+        state,
+        train_loader,
+        val_loader,
+        test_loader,
+        nn_config,
+        log_name=log_name,
+        verbosity=verbosity,
+        create_plots=bool(viz.get("create_plots", False)),
+        plot_init_solution=bool(viz.get("plot_init_solution", False)),
+        plot_hist_solution=bool(viz.get("plot_hist_solution", False)),
+        log_dir=log_dir,
+        train_step=train_step,
+        eval_step=eval_step,
+        eval_step_out=eval_step_out,
+    )
+
+    save_model(state, log_name, log_dir, verbosity)
+    timer.stop()
+    print_timers(verbosity)
+    return model, state, history, config
+
+
+def run_prediction(
+    config_file_or_dict,
+    samples: Optional[List] = None,
+    log_dir: str = "./logs/",
+) -> Tuple[float, np.ndarray, List[np.ndarray], List[np.ndarray]]:
+    """Load data + trained weights, run the full test pass, optionally
+    denormalize; returns (error, error_rmse_task, true_values,
+    predicted_values) (reference: run_prediction.py:27-83)."""
+    config = load_config(config_file_or_dict)
+    verbosity = config.get("Verbosity", {}).get("level", 0)
+
+    _, _, test_loader, config = prepare_loaders_and_config(config, samples)
+    log_name = get_log_name_config(config)
+
+    nn_config = config["NeuralNetwork"]
+    example = next(iter(test_loader))
+    model, variables = create_model_config(nn_config, example)
+    # Same optimizer chain as training: freeze_conv changes the opt_state
+    # pytree structure, and the checkpoint schema must match to deserialize.
+    tx = select_optimizer(
+        nn_config["Training"],
+        freeze_conv=bool(nn_config["Architecture"].get("freeze_conv_layers")),
+    )
+    state = create_train_state(variables, tx)
+    state = load_existing_model(state, log_name, log_dir)
+
+    eval_step = make_eval_step(model, with_outputs=True)
+    error, error_rmse_task, true_values, predicted_values = test_epoch(
+        test_loader, state, eval_step, model.cfg, verbosity, return_samples=True
+    )
+
+    voi = nn_config["Variables_of_interest"]
+    if voi.get("denormalize_output"):
+        from hydragnn_tpu.postprocess.postprocess import output_denormalize
+
+        true_values, predicted_values = output_denormalize(
+            voi["y_minmax"], true_values, predicted_values
+        )
+
+    return error, error_rmse_task, true_values, predicted_values

@@ -67,6 +67,11 @@ class GraphLoader:
       device_stack: if > 1, each yielded batch has a leading device axis of
         this size; batch_size must divide evenly by it. Edge indices stay
         local to each sub-batch (shard_map-ready: no cross-device gathers).
+      cache_device_batches: build every batch once (fixed composition) and
+        keep it on device; epochs then permute batch ORDER only. Removes
+        per-epoch host batching + H2D transfer from the hot loop — the win
+        is large when the host->device link is slow — at the cost of
+        coarser shuffling (batch membership is fixed after epoch 0).
     """
 
     def __init__(
@@ -81,6 +86,7 @@ class GraphLoader:
         node_multiple: int = 8,
         edge_multiple: int = 8,
         drop_last: bool = False,
+        cache_device_batches: bool = False,
     ):
         if device_stack > 1 and batch_size % device_stack != 0:
             raise ValueError(
@@ -103,6 +109,9 @@ class GraphLoader:
         self.seed = seed
         self.device_stack = device_stack
         self.drop_last = drop_last
+        self.cache_device_batches = cache_device_batches
+        self._cached_batches: Optional[List[GraphBatch]] = None
+        self._sharding = None
         self._epoch = 0
         sub = batch_size // device_stack
         # Pad plan from the FULL dataset, not the local shard: all hosts
@@ -114,6 +123,15 @@ class GraphLoader:
 
     def set_epoch(self, epoch: int) -> None:
         self._epoch = epoch
+
+    def set_sharding(self, sharding) -> None:
+        """Sharding for cached device batches (e.g. NamedSharding over the
+        data mesh for device_stack > 1, so cached batches live on their
+        target devices instead of being resharded from device 0 each step).
+        Must be set before the first iteration builds the cache."""
+        if self._cached_batches is not None and sharding is not self._sharding:
+            self._cached_batches = None  # rebuild with the new placement
+        self._sharding = sharding
 
     def __len__(self) -> int:
         n = len(self.samples)
@@ -140,28 +158,46 @@ class GraphLoader:
             n_graph_pad=self.pad_graphs,
         )
 
+    def _make_batch(self, chunk: Sequence[int]) -> GraphBatch:
+        sub = self.batch_size // self.device_stack
+        if self.device_stack == 1:
+            return self._make_sub_batch(chunk)
+        subs = []
+        for d in range(self.device_stack):
+            part = chunk[d * sub : (d + 1) * sub]
+            if len(part) == 0:
+                # Partial final batch: an all-padding sub-batch keeps
+                # the device axis full; masks zero it out everywhere.
+                part = chunk[:1]
+                empty = self._make_sub_batch(part)
+                subs.append(_mask_out(empty))
+            else:
+                subs.append(self._make_sub_batch(part))
+        return jax.tree_util.tree_map(lambda *xs: np.stack(xs), *subs)
+
     def __iter__(self) -> Iterator[GraphBatch]:
-        order = self._order()
         bs = self.batch_size
         nb = len(self)
-        sub = bs // self.device_stack
-        for b in range(nb):
-            chunk = order[b * bs : (b + 1) * bs]
-            if self.device_stack == 1:
-                yield self._make_sub_batch(chunk)
+        if self.cache_device_batches:
+            if self._cached_batches is None:
+                base = np.arange(len(self.samples))
+                self._cached_batches = [
+                    jax.device_put(
+                        self._make_batch(base[b * bs : (b + 1) * bs]), self._sharding
+                    )
+                    for b in range(nb)
+                ]
+            if self.shuffle:
+                rng = np.random.default_rng(self.seed + self._epoch)
+                batch_order = rng.permutation(nb)
             else:
-                subs = []
-                for d in range(self.device_stack):
-                    part = chunk[d * sub : (d + 1) * sub]
-                    if len(part) == 0:
-                        # Partial final batch: an all-padding sub-batch keeps
-                        # the device axis full; masks zero it out everywhere.
-                        part = chunk[:1]
-                        empty = self._make_sub_batch(part)
-                        subs.append(_mask_out(empty))
-                    else:
-                        subs.append(self._make_sub_batch(part))
-                yield jax.tree_util.tree_map(lambda *xs: np.stack(xs), *subs)
+                batch_order = np.arange(nb)
+            for b in batch_order:
+                yield self._cached_batches[b]
+            return
+        order = self._order()
+        for b in range(nb):
+            yield self._make_batch(order[b * bs : (b + 1) * bs])
 
     def num_graphs_total(self) -> int:
         return len(self.samples)

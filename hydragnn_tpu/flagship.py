@@ -1,0 +1,118 @@
+"""Flagship model/problem builder shared by bench.py and __graft_entry__.py.
+
+The flagship configuration is the reference's strongest model family — a
+multi-head PNA stack (graph energy head + 3 nodal heads) on the
+deterministic BCC dataset (reference model zoo: hydragnn/models/PNAStack.py;
+dataset: tests/deterministic_graph_data.py) — scaled so the conv stack's
+matmuls land on the MXU with meaningful tiles.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+from hydragnn_tpu.data.ingest import prepare_dataset
+from hydragnn_tpu.data.loader import GraphLoader
+from hydragnn_tpu.data.synthetic import deterministic_graph_data
+from hydragnn_tpu.models.create import create_model_config
+from hydragnn_tpu.utils.config import update_config
+
+
+def flagship_config(
+    hidden_dim: int = 128,
+    num_conv_layers: int = 6,
+    batch_size: int = 128,
+    num_epoch: int = 1,
+) -> Dict[str, Any]:
+    return {
+        "Verbosity": {"level": 0},
+        "Dataset": {
+            "name": "flagship_bench",
+            "format": "unit_test",
+            "compositional_stratified_splitting": False,
+            "rotational_invariance": False,
+            "node_features": {
+                "name": ["x", "x2", "x3"],
+                "dim": [1, 1, 1],
+                "column_index": [0, 6, 7],
+            },
+            "graph_features": {
+                "name": ["sum_x_x2_x3"],
+                "dim": [1],
+                "column_index": [0],
+            },
+        },
+        "NeuralNetwork": {
+            "Architecture": {
+                "model_type": "PNA",
+                "radius": 2.0,
+                "max_neighbours": 100,
+                "periodic_boundary_conditions": False,
+                "hidden_dim": hidden_dim,
+                "num_conv_layers": num_conv_layers,
+                "output_heads": {
+                    "graph": {
+                        "num_sharedlayers": 2,
+                        "dim_sharedlayers": hidden_dim,
+                        "num_headlayers": 2,
+                        "dim_headlayers": [hidden_dim, hidden_dim // 2],
+                    },
+                    "node": {
+                        "num_headlayers": 2,
+                        "dim_headlayers": [hidden_dim, hidden_dim // 2],
+                        "type": "mlp",
+                    },
+                },
+                "task_weights": [4.0, 2.0, 2.0, 2.0],
+            },
+            "Variables_of_interest": {
+                "input_node_features": [0],
+                "output_names": ["sum_x_x2_x3", "x", "x2", "x3"],
+                "output_index": [0, 0, 1, 2],
+                "type": ["graph", "node", "node", "node"],
+            },
+            "Training": {
+                "num_epoch": num_epoch,
+                "perc_train": 0.8,
+                "loss_function_type": "mse",
+                "batch_size": batch_size,
+                "Optimizer": {"type": "AdamW", "learning_rate": 1e-3},
+            },
+        },
+    }
+
+
+def build_flagship(
+    n_samples: int = 512,
+    hidden_dim: int = 128,
+    num_conv_layers: int = 6,
+    batch_size: int = 128,
+    device_stack: int = 1,
+    unit_cells: Tuple[int, int] = (2, 4),
+    seed: int = 0,
+):
+    """Returns (config, model, variables, train_loader)."""
+    config = flagship_config(hidden_dim, num_conv_layers, batch_size)
+    samples = deterministic_graph_data(
+        number_configurations=n_samples,
+        unit_cell_x_range=unit_cells,
+        unit_cell_y_range=unit_cells,
+        unit_cell_z_range=unit_cells,
+        seed=seed,
+    )
+    train, val, test, _, _ = prepare_dataset(samples, config)
+    config = update_config(config, train, val, test)
+    loader = GraphLoader(
+        train,
+        batch_size,
+        shuffle=True,
+        device_stack=device_stack,
+        drop_last=True,
+    )
+    import jax
+
+    example = next(iter(loader))
+    if device_stack > 1:
+        example = jax.tree_util.tree_map(lambda x: x[0], example)
+    model, variables = create_model_config(config["NeuralNetwork"], example)
+    return config, model, variables, loader

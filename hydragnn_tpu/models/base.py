@@ -77,6 +77,11 @@ class ModelConfig:
     radius: Optional[float] = None
     freeze_conv: bool = False
     initial_bias: Optional[float] = None
+    # SyncBatchNorm equivalent: name of the mapped device axis to psum
+    # batch statistics over (reference: SyncBatchNorm convert,
+    # hydragnn/utils/distributed.py:227-228). None = per-device stats,
+    # matching DDP's default non-synced BatchNorm.
+    bn_axis_name: Optional[str] = None
 
     def __post_init__(self):
         if self.model_type not in KNOWN_MODELS:
@@ -122,24 +127,25 @@ class HydraModel(nn.Module):
 
     cfg: ModelConfig
 
-    def _make_conv(self, out_dim: int, concat: bool = True) -> nn.Module:
+    def _make_conv(self, out_dim: int, concat: bool = True, name: Optional[str] = None) -> nn.Module:
         cfg = self.cfg
         mt = cfg.model_type
         if mt == "GIN":
-            return C.GINConv(out_dim)
+            return C.GINConv(out_dim, name=name)
         if mt == "SAGE":
-            return C.SAGEConv(out_dim)
+            return C.SAGEConv(out_dim, name=name)
         if mt == "MFC":
             assert cfg.max_neighbours is not None, "MFC requires max_neighbours"
-            return C.MFConv(out_dim, max_degree=cfg.max_neighbours)
+            return C.MFConv(out_dim, max_degree=cfg.max_neighbours, name=name)
         if mt == "CGCNN":
-            return C.CGConv(out_dim)
+            return C.CGConv(out_dim, name=name)
         if mt == "PNA":
             return C.PNAConv(
                 out_dim,
                 avg_deg_lin=cfg.pna_avg_deg_lin,
                 avg_deg_log=cfg.pna_avg_deg_log,
                 edge_dim=cfg.edge_dim,
+                name=name,
             )
         if mt == "GAT":
             return C.GATv2Conv(
@@ -148,6 +154,7 @@ class HydraModel(nn.Module):
                 negative_slope=cfg.gat_negative_slope,
                 dropout=cfg.dropout,
                 concat=concat,
+                name=name,
             )
         if mt == "SchNet":
             assert cfg.num_gaussians and cfg.num_filters and cfg.radius
@@ -156,6 +163,7 @@ class HydraModel(nn.Module):
                 num_filters=cfg.num_filters,
                 num_gaussians=cfg.num_gaussians,
                 cutoff=cfg.radius,
+                name=name,
             )
         raise ValueError(mt)
 
@@ -212,9 +220,12 @@ class HydraModel(nn.Module):
             bn_width = (
                 cfg.hidden_dim * cfg.gat_heads if (is_gat and not last) else cfg.hidden_dim
             )
-            conv = self._make_conv(width, concat=concat)
+            # Explicit names make the encoder stack addressable by the
+            # optimizer's freeze_conv mask (reference: Base._freeze_conv
+            # Base.py:117-121 freezes self.convs only, not batch norms).
+            conv = self._make_conv(width, concat=concat, name=f"conv_{layer}")
             x = self._apply_conv(conv, x, ctx, train)
-            x = MaskedBatchNorm(bn_width)(x, mask=batch.node_mask, train=train)
+            x = MaskedBatchNorm(bn_width, axis_name=cfg.bn_axis_name)(x, mask=batch.node_mask, train=train)
             x = nn.relu(x)
 
         # ---- masked global mean pool (reference: Base.py:256-258) ----
@@ -262,11 +273,11 @@ class HydraModel(nn.Module):
                 conv = self._make_conv(dim, concat=True)
                 bn_width = dim * cfg.gat_heads if is_gat else dim
                 h = self._apply_conv(conv, h, ctx, train)
-                h = MaskedBatchNorm(bn_width)(h, mask=batch.node_mask, train=train)
+                h = MaskedBatchNorm(bn_width, axis_name=cfg.bn_axis_name)(h, mask=batch.node_mask, train=train)
                 h = nn.relu(h)
             conv = self._make_conv(out_dim, concat=False)
             h = self._apply_conv(conv, h, ctx, train)
-            h = MaskedBatchNorm(out_dim)(h, mask=batch.node_mask, train=train)
+            h = MaskedBatchNorm(out_dim, axis_name=cfg.bn_axis_name)(h, mask=batch.node_mask, train=train)
             return h
         raise ValueError(
             f"Unknown head NN structure for node features {nht}; currently only "

@@ -21,9 +21,17 @@ from hydragnn_tpu.models.base import HydraModel, ModelConfig
 from hydragnn_tpu.models.convs import avg_degree_stats
 
 
-def model_config_from_dict(config: Dict[str, Any]) -> ModelConfig:
+def model_config_from_dict(
+    config: Dict[str, Any], bn_axis_name: Optional[str] = None
+) -> ModelConfig:
     """Build a static ModelConfig from the reference-shaped config dict
-    (the ``NeuralNetwork`` section, after update_config inference)."""
+    (the ``NeuralNetwork`` section, after update_config inference).
+
+    ``bn_axis_name`` is the mapped device axis the caller will bind (via
+    shard_map) — required for ``Architecture.SyncBatchNorm`` to take
+    effect; it is ignored when the config does not request SyncBN
+    (reference: SyncBatchNorm convert, hydragnn/utils/distributed.py:
+    227-228, default injected at config_utils.py:82-83)."""
     arch = config["Architecture"]
     training = config.get("Training", {})
     heads_cfg = arch.get("output_heads", {})
@@ -70,6 +78,7 @@ def model_config_from_dict(config: Dict[str, Any]) -> ModelConfig:
         radius=arch.get("radius"),
         freeze_conv=bool(arch.get("freeze_conv_layers", False)),
         initial_bias=arch.get("initial_bias"),
+        bn_axis_name=bn_axis_name if arch.get("SyncBatchNorm") else None,
     )
 
 
@@ -78,8 +87,9 @@ def create_model_config(
     example_batch: GraphBatch,
     seed: int = 0,
     verbosity: int = 0,
+    bn_axis_name: Optional[str] = None,
 ) -> Tuple[HydraModel, Dict[str, Any]]:
-    cfg = model_config_from_dict(config)
+    cfg = model_config_from_dict(config, bn_axis_name=bn_axis_name)
     return create_model(cfg, example_batch, seed=seed)
 
 

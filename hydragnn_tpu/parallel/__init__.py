@@ -1,0 +1,14 @@
+from hydragnn_tpu.parallel.mesh import (
+    DATA_AXIS,
+    batch_sharding,
+    get_comm_size_and_rank,
+    local_device_count,
+    make_mesh,
+    replicated_sharding,
+    setup_distributed,
+)
+from hydragnn_tpu.parallel.sharded import (
+    make_sharded_eval_step,
+    make_sharded_train_step,
+    place_state,
+)

@@ -1,0 +1,165 @@
+"""Result visualization: parity scatters, error histograms, loss curves.
+
+Compact TPU-build counterpart of the reference Visualizer (reference:
+hydragnn/postprocess/visualizer.py:24-742, methods listed at :66-741).
+Same artifact set — per-head parity scatter plots, error histograms,
+2-D density contour with conditional mean, loss-history curves, node-count
+histogram — rendered with the Agg backend into ``logs/<name>/``. Values
+arrive as per-head numpy arrays (the ``test_epoch`` collection format)
+rather than lists of per-sample tensors, so everything vectorizes.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt  # noqa: E402
+
+
+class Visualizer:
+    def __init__(
+        self,
+        model_with_config_name: str,
+        num_heads: int = 1,
+        head_names: Optional[Sequence[str]] = None,
+        log_dir: str = "./logs/",
+    ):
+        self.name = model_with_config_name
+        self.num_heads = num_heads
+        self.head_names = list(head_names or [f"head{i}" for i in range(num_heads)])
+        self.out_dir = os.path.join(log_dir, model_with_config_name)
+        os.makedirs(self.out_dir, exist_ok=True)
+
+    # ---- per-head parity scatter (reference create_scatter_plots) ----
+
+    def create_scatter_plots(
+        self,
+        true_values: List[np.ndarray],
+        predicted_values: List[np.ndarray],
+        output_names: Optional[Sequence[str]] = None,
+        iepoch: Optional[int] = None,
+    ) -> List[str]:
+        names = list(output_names or self.head_names)
+        paths = []
+        for ihead in range(len(true_values)):
+            t = np.asarray(true_values[ihead]).reshape(-1)
+            p = np.asarray(predicted_values[ihead]).reshape(-1)
+            fig, ax = plt.subplots(figsize=(5, 5))
+            ax.scatter(t, p, s=4, alpha=0.4, edgecolors="none")
+            lo = float(min(t.min(), p.min())) if t.size else 0.0
+            hi = float(max(t.max(), p.max())) if t.size else 1.0
+            ax.plot([lo, hi], [lo, hi], "k--", linewidth=1)
+            ax.set_xlabel("True")
+            ax.set_ylabel("Predicted")
+            suffix = "" if iepoch is None else f"_epoch{iepoch}"
+            ax.set_title(f"{names[ihead]}{suffix}")
+            path = os.path.join(self.out_dir, f"scatter_{names[ihead]}{suffix}.png")
+            fig.tight_layout()
+            fig.savefig(path, dpi=100)
+            plt.close(fig)
+            paths.append(path)
+        return paths
+
+    # ---- per-head error histogram (reference create_error_histograms) ----
+
+    def create_error_histograms(
+        self,
+        true_values: List[np.ndarray],
+        predicted_values: List[np.ndarray],
+        output_names: Optional[Sequence[str]] = None,
+        iepoch: Optional[int] = None,
+    ) -> List[str]:
+        names = list(output_names or self.head_names)
+        paths = []
+        for ihead in range(len(true_values)):
+            err = (
+                np.asarray(predicted_values[ihead]).reshape(-1)
+                - np.asarray(true_values[ihead]).reshape(-1)
+            )
+            fig, ax = plt.subplots(figsize=(5, 4))
+            ax.hist(err, bins=50)
+            ax.set_xlabel("Predicted - True")
+            ax.set_ylabel("Count")
+            suffix = "" if iepoch is None else f"_epoch{iepoch}"
+            ax.set_title(f"{names[ihead]} error{suffix}")
+            path = os.path.join(self.out_dir, f"errhist_{names[ihead]}{suffix}.png")
+            fig.tight_layout()
+            fig.savefig(path, dpi=100)
+            plt.close(fig)
+            paths.append(path)
+        return paths
+
+    # ---- 2-D density + conditional mean (reference create_plot_global) ----
+
+    def create_plot_global(
+        self,
+        true_values: List[np.ndarray],
+        predicted_values: List[np.ndarray],
+        output_names: Optional[Sequence[str]] = None,
+    ) -> List[str]:
+        names = list(output_names or self.head_names)
+        paths = []
+        for ihead in range(len(true_values)):
+            t = np.asarray(true_values[ihead]).reshape(-1)
+            p = np.asarray(predicted_values[ihead]).reshape(-1)
+            fig, axes = plt.subplots(1, 3, figsize=(13, 4))
+            if t.size:
+                h, xe, ye = np.histogram2d(t, p, bins=50)
+                xc = 0.5 * (xe[:-1] + xe[1:])
+                yc = 0.5 * (ye[:-1] + ye[1:])
+                hmax = h.max() if h.max() > 0 else 1.0
+                axes[0].contourf(xc, yc, (h / hmax).T, levels=10)
+                # conditional mean error per true-value bin
+                bin_ids = np.clip(np.digitize(t, xe) - 1, 0, len(xc) - 1)
+                cond_mean = np.full(len(xc), np.nan)
+                for b in range(len(xc)):
+                    sel = bin_ids == b
+                    if sel.any():
+                        cond_mean[b] = (p[sel] - t[sel]).mean()
+                axes[1].plot(xc, cond_mean)
+                axes[2].hist(p - t, bins=50, density=True)
+            axes[0].set_title(f"{names[ihead]} density")
+            axes[1].set_title("conditional mean error")
+            axes[2].set_title("error pdf")
+            path = os.path.join(self.out_dir, f"global_{names[ihead]}.png")
+            fig.tight_layout()
+            fig.savefig(path, dpi=100)
+            plt.close(fig)
+            paths.append(path)
+        return paths
+
+    # ---- loss-history curves (reference plot_history) ----
+
+    def plot_history(self, history: Dict[str, list]) -> str:
+        fig, ax = plt.subplots(figsize=(6, 4))
+        for key in ("train_loss", "val_loss", "test_loss"):
+            if history.get(key):
+                ax.plot(history[key], label=key)
+        ax.set_xlabel("Epoch")
+        ax.set_ylabel("Loss")
+        ax.set_yscale("log")
+        ax.legend()
+        path = os.path.join(self.out_dir, "history.png")
+        fig.tight_layout()
+        fig.savefig(path, dpi=100)
+        plt.close(fig)
+        return path
+
+    # ---- node-count histogram (reference num_nodes_plot) ----
+
+    def num_nodes_plot(self, num_nodes_list: Sequence[int]) -> str:
+        fig, ax = plt.subplots(figsize=(5, 4))
+        ax.hist(np.asarray(num_nodes_list), bins=30)
+        ax.set_xlabel("Nodes per graph")
+        ax.set_ylabel("Count")
+        path = os.path.join(self.out_dir, "num_nodes.png")
+        fig.tight_layout()
+        fig.savefig(path, dpi=100)
+        plt.close(fig)
+        return path

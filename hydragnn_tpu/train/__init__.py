@@ -1,0 +1,19 @@
+from hydragnn_tpu.train.optimizer import (
+    select_optimizer,
+    current_learning_rate,
+    set_learning_rate,
+)
+from hydragnn_tpu.train.state import (
+    TrainState,
+    create_train_state,
+    make_train_step,
+    make_eval_step,
+)
+from hydragnn_tpu.train.loop import (
+    EarlyStopping,
+    ReduceLROnPlateau,
+    train_epoch,
+    evaluate_epoch,
+    test_epoch,
+    train_validate_test,
+)

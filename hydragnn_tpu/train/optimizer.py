@@ -1,0 +1,96 @@
+"""Optimizer selection — the optax analog of the reference's 8-way factory.
+
+Reference: hydragnn/utils/optimizer.py:12-113 selects one of
+{SGD, Adam, Adadelta, Adagrad, Adamax, AdamW, RMSprop, FusedLAMB} and
+optionally wraps it in ZeroRedundancyOptimizer (ZeRO stage 1). On TPU:
+
+  - every optimizer maps to its optax counterpart (FusedLAMB -> optax.lamb;
+    no custom kernel is needed, XLA fuses the update);
+  - ZeRO-1 is not an optimizer wrapper but a *sharding rule*: optimizer
+    state is sharded over the data axis by the parallel layer
+    (hydragnn_tpu/parallel), so ``use_zero_redundancy`` is accepted and
+    recorded but changes nothing here;
+  - the learning rate is injected as a dynamic hyperparameter so the
+    host-side ReduceLROnPlateau controller can change it between steps
+    without recompiling (reference: torch scheduler mutates param groups,
+    hydragnn/run_training.py:94-96).
+
+``freeze_conv_layers`` (reference: Base._freeze_conv Base.py:117-121 via
+requires_grad=False on the conv stack) is honored by zeroing the final
+updates for every parameter subtree named ``conv_*``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import optax
+
+
+OPTIMIZERS = ("SGD", "Adam", "Adadelta", "Adagrad", "Adamax", "AdamW", "RMSprop", "FusedLAMB")
+
+
+def _base_optimizer(opt_type: str, learning_rate) -> optax.GradientTransformation:
+    if opt_type == "SGD":
+        return optax.sgd(learning_rate)
+    if opt_type == "Adam":
+        return optax.adam(learning_rate)
+    if opt_type == "Adadelta":
+        return optax.adadelta(learning_rate)
+    if opt_type == "Adagrad":
+        return optax.adagrad(learning_rate)
+    if opt_type == "Adamax":
+        return optax.adamax(learning_rate)
+    if opt_type == "AdamW":
+        return optax.adamw(learning_rate)
+    if opt_type == "RMSprop":
+        return optax.rmsprop(learning_rate)
+    if opt_type == "FusedLAMB":
+        return optax.lamb(learning_rate)
+    raise NameError(f"The string used to identify the optimizer is not recognized: {opt_type}")
+
+
+def _frozen_conv_mask(params) -> Any:
+    """True (frozen) for every top-level ``conv_*`` subtree."""
+    return {k: jax.tree_util.tree_map(lambda _: k.startswith("conv_"), v) for k, v in params.items()}
+
+
+def select_optimizer(
+    training_config: Dict[str, Any],
+    freeze_conv: bool = False,
+    params: Optional[Any] = None,
+) -> optax.GradientTransformation:
+    """Build the optimizer from the ``Training`` config section.
+
+    ``training_config["Optimizer"]`` carries ``type`` and ``learning_rate``
+    (reference config schema, hydragnn/utils/optimizer.py:43-113).
+    Returns an ``inject_hyperparams`` transformation whose state exposes
+    ``.hyperparams["learning_rate"]`` for the plateau scheduler.
+    """
+    opt_cfg = training_config.get("Optimizer", {})
+    opt_type = opt_cfg.get("type", "AdamW")
+    lr = float(opt_cfg.get("learning_rate", training_config.get("learning_rate", 1e-3)))
+
+    def make(learning_rate):
+        tx = _base_optimizer(opt_type, learning_rate)
+        if freeze_conv:
+            tx = optax.chain(tx, optax.masked(optax.set_to_zero(), _frozen_conv_mask))
+        return tx
+
+    return optax.inject_hyperparams(make)(learning_rate=lr)
+
+
+def current_learning_rate(opt_state) -> float:
+    """Read the dynamic learning rate out of an inject_hyperparams state."""
+    return float(opt_state.hyperparams["learning_rate"])
+
+
+def set_learning_rate(opt_state, lr: float):
+    """Return a new opt_state with the learning rate replaced (host-side;
+    the next jitted step picks it up as a donated input, no recompile)."""
+    import jax.numpy as jnp
+
+    hyper = dict(opt_state.hyperparams)
+    hyper["learning_rate"] = jnp.asarray(lr, dtype=jnp.asarray(hyper["learning_rate"]).dtype)
+    return opt_state._replace(hyperparams=hyper)

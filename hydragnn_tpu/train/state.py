@@ -1,0 +1,105 @@
+"""Train state and jitted step functions.
+
+The reference hot loop (hydragnn/train/train_validate_test.py:333-371) does
+zero_grad -> head indexing -> H2D copy -> forward -> loss -> backward ->
+step per batch. Here the whole step is ONE jitted function over a
+``TrainState`` pytree: forward + weighted multi-task loss + grad + optax
+update + BatchNorm running-stat update, compiled once (fixed batch shapes
+come from the loader's pad plan). Head indexing does not exist — targets
+are already a dict-of-heads on the batch.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+
+from hydragnn_tpu.graph.batch import GraphBatch
+from hydragnn_tpu.models.base import HydraModel, model_loss
+
+
+class TrainState(struct.PyTreeNode):
+    step: jnp.ndarray
+    params: Any
+    batch_stats: Any
+    opt_state: Any
+    rng: jnp.ndarray
+
+
+def create_train_state(
+    variables: Dict[str, Any], tx: optax.GradientTransformation, seed: int = 0
+) -> TrainState:
+    # The jitted step donates the state's buffers; copy so the caller's
+    # ``variables`` stay usable after the first step (e.g. re-init paths).
+    params = jax.tree_util.tree_map(jnp.copy, variables["params"])
+    batch_stats = jax.tree_util.tree_map(jnp.copy, variables.get("batch_stats", {}))
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        batch_stats=batch_stats,
+        opt_state=tx.init(params),
+        rng=jax.random.PRNGKey(seed),
+    )
+
+
+def make_train_step(
+    model: HydraModel, tx: optax.GradientTransformation
+) -> Callable[[TrainState, GraphBatch], Tuple[TrainState, jnp.ndarray, jnp.ndarray]]:
+    """Returns jitted ``(state, batch) -> (state, loss, tasks_loss)``."""
+
+    def step(state: TrainState, batch: GraphBatch):
+        rng, dropout_rng = jax.random.split(state.rng)
+
+        def loss_fn(params):
+            outputs, mutated = model.apply(
+                {"params": params, "batch_stats": state.batch_stats},
+                batch,
+                train=True,
+                mutable=["batch_stats"],
+                rngs={"dropout": dropout_rng},
+            )
+            total, tasks = model_loss(model.cfg, outputs, batch)
+            return total, (jnp.stack(tasks), mutated)
+
+        (loss, (tasks, mutated)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params
+        )
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        new_state = state.replace(
+            step=state.step + 1,
+            params=params,
+            batch_stats=mutated["batch_stats"],
+            opt_state=opt_state,
+            rng=rng,
+        )
+        return new_state, loss, tasks
+
+    return jax.jit(step, donate_argnums=(0,))
+
+
+def make_eval_step(
+    model: HydraModel, with_outputs: bool = False
+) -> Callable[..., Any]:
+    """Returns jitted ``(state, batch) -> (loss, tasks_loss[, outputs])``
+    using running BatchNorm statistics (train=False), the analog of the
+    reference's ``model.eval()`` validate/test passes
+    (train_validate_test.py:374-443)."""
+
+    def step(state: TrainState, batch: GraphBatch):
+        outputs = model.apply(
+            {"params": state.params, "batch_stats": state.batch_stats},
+            batch,
+            train=False,
+        )
+        loss, tasks = model_loss(model.cfg, outputs, batch)
+        if with_outputs:
+            return loss, jnp.stack(tasks), outputs
+        return loss, jnp.stack(tasks)
+
+    return jax.jit(step)

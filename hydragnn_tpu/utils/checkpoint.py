@@ -1,0 +1,76 @@
+"""Checkpoint / resume.
+
+The reference saves one ``.pk`` file holding model+optimizer state dicts,
+written by rank 0 (after ZeRO consolidation), and supports config-driven
+continuation (reference: hydragnn/utils/model.py:41-86, config keys
+``Training.continue``/``startfrom``). TPU equivalent: the whole
+``TrainState`` pytree (params, batch_stats, optimizer state, step, rng) is
+serialized with flax msgpack into one file per run — process 0 writes,
+every process reads. Loading targets an already-constructed state, so the
+structure acts as the schema (the analog of ``load_state_dict``); sharded
+multi-host array state is pulled to host before writing.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import numpy as np
+from flax import serialization
+
+
+def _checkpoint_path(log_name: str, path: str = "./logs/") -> str:
+    return os.path.join(path, log_name, f"{log_name}.mp")
+
+
+def _to_host(x: Any) -> np.ndarray:
+    """Fetch one leaf to host. Leaves sharded across non-addressable
+    devices (multi-host ZeRO-1 optimizer state) are first all-gathered to
+    a replicated layout with an XLA collective — the ZeRO consolidation
+    step (reference: consolidate_state_dict, model.py:44-45)."""
+    if isinstance(x, jax.Array) and not x.is_fully_addressable:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        mesh = x.sharding.mesh
+        x = jax.jit(lambda a: a, out_shardings=NamedSharding(mesh, PartitionSpec()))(x)
+    return np.asarray(x)
+
+
+def save_model(state: Any, log_name: str, path: str = "./logs/", verbosity: int = 0) -> str:
+    """Write the TrainState to ``<path>/<log_name>/<log_name>.mp``
+    (process-0 write, like the reference's rank-0 save, model.py:41-54)."""
+    ckpt_path = _checkpoint_path(log_name, path)
+    host_state = jax.tree_util.tree_map(_to_host, state)
+    if jax.process_index() == 0:
+        os.makedirs(os.path.dirname(ckpt_path), exist_ok=True)
+        with open(ckpt_path, "wb") as f:
+            f.write(serialization.to_bytes(host_state))
+    return ckpt_path
+
+
+def load_existing_model(
+    state: Any, log_name: str, path: str = "./logs/"
+) -> Any:
+    """Restore a TrainState from the run's checkpoint file. ``state`` is the
+    freshly-constructed target (its pytree structure = the schema)."""
+    ckpt_path = _checkpoint_path(log_name, path)
+    with open(ckpt_path, "rb") as f:
+        data = f.read()
+    return serialization.from_bytes(state, data)
+
+
+def load_existing_model_config(
+    state: Any, training_config: dict, path: str = "./logs/"
+) -> Any:
+    """Config-driven continue (reference: model.py:64-67, keys
+    ``Training.continue`` and ``Training.startfrom``)."""
+    if "continue" in training_config and training_config["continue"] == 1:
+        model_name = training_config["startfrom"]
+        return load_existing_model(state, model_name, path)
+    return state
+
+
+def checkpoint_exists(log_name: str, path: str = "./logs/") -> bool:
+    return os.path.exists(_checkpoint_path(log_name, path))

@@ -1,0 +1,54 @@
+"""Visualizer artifact tests (reference: hydragnn/postprocess/visualizer.py
+produces scatter/histogram/global/history/node-count plots; here we assert
+each method writes its file and the train-loop wiring produces plots when
+Visualization.create_plots is set)."""
+
+import os
+
+import numpy as np
+
+from hydragnn_tpu.postprocess.visualizer import Visualizer
+
+
+def pytest_visualizer_artifacts(tmp_path):
+    rng = np.random.default_rng(0)
+    t = [rng.normal(size=(50, 1)), rng.normal(size=(200, 1))]
+    p = [a + 0.1 * rng.normal(size=a.shape) for a in t]
+    viz = Visualizer("vtest", num_heads=2, head_names=["e", "x"], log_dir=str(tmp_path))
+
+    for path in viz.create_scatter_plots(t, p, iepoch=3):
+        assert os.path.exists(path)
+    for path in viz.create_error_histograms(t, p):
+        assert os.path.exists(path)
+    for path in viz.create_plot_global(t, p):
+        assert os.path.exists(path)
+    hist = {"train_loss": [1.0, 0.5], "val_loss": [1.1, 0.6], "test_loss": [1.2, 0.7]}
+    assert os.path.exists(viz.plot_history(hist))
+    assert os.path.exists(viz.num_nodes_plot([4, 8, 8, 16]))
+
+
+def pytest_train_loop_writes_plots(tmp_path):
+    import sys
+
+    sys.path.insert(0, os.path.dirname(__file__))
+    from test_train_e2e import make_config
+
+    from hydragnn_tpu.api import run_training
+    from hydragnn_tpu.data.synthetic import deterministic_graph_data
+    from hydragnn_tpu.utils.config import get_log_name_config
+
+    config = make_config("GIN", False, str(tmp_path), num_epoch=2)
+    config["Visualization"] = {
+        "create_plots": True,
+        "plot_init_solution": True,
+        "plot_hist_solution": True,
+    }
+    samples = deterministic_graph_data(number_configurations=40, seed=2)
+    log_dir = str(tmp_path) + "/logs/"
+    _, _, _, full_config = run_training(config, samples=samples, log_dir=log_dir)
+    out_dir = os.path.join(log_dir, get_log_name_config(full_config))
+    pngs = [f for f in os.listdir(out_dir) if f.endswith(".png")]
+    assert any(f.startswith("scatter_") for f in pngs)
+    assert any(f.startswith("errhist_") for f in pngs)
+    assert any(f.startswith("global_") for f in pngs)
+    assert "history.png" in pngs
