@@ -1,0 +1,344 @@
+"""HGC: sharded binary graph container — the ADIOS2-equivalent store.
+
+Same schema as the reference's ADIOS design (reference:
+hydragnn/utils/adiosdataset.py:79-179): each field of every sample is
+concatenated along its ragged axis into ONE global array per field, with
+per-sample ``count`` index arrays (offsets = exclusive cumsum) and global
+attributes (ndata, minmax tables). On-disk layout under ``<path>/``:
+
+    meta.json            schema: ndata, fields {dtype, row_shape}, attrs
+    <field>.bin          the concatenated global array (C-order rows)
+    <field>.cnt          int64[ndata] per-sample row counts
+
+Field names: ``x``, ``pos``, ``edge_index`` (stored row-ragged as [e, 2]),
+``edge_attr``, ``graph_y``, ``gt_<head>``/``nt_<head>`` target dicts.
+
+Read modes (reference AdiosDataset modes, adiosdataset.py:263-368):
+  - ``mmap``    zero-copy memory-mapped reads (out-of-core; page cache
+                shares physical pages across processes on a host),
+  - ``preload`` load everything into RAM up front,
+  - ``shm``     one-copy preload into /dev/shm per node, then mmap from
+                there (parallel-filesystem-friendly).
+
+The read hot path (batched ragged row-gather) and the shm copy run in the
+native C++ core (hydragnn_tpu/native, libhgc.so) with a numpy fallback.
+
+Multi-process writing mirrors AdiosWriter's MPI pattern: allgather shard
+row-counts, then every process writes its own byte range of the
+preallocated ``.bin`` files (reference adiosdataset.py:90-130).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from hydragnn_tpu.data.dataset import GraphSample
+from hydragnn_tpu.native import MappedFile, copy_to_shm
+
+
+def _field_arrays(sample: GraphSample) -> Dict[str, np.ndarray]:
+    """Decompose a GraphSample into named row-ragged 2-D arrays."""
+    out: Dict[str, np.ndarray] = {"x": np.asarray(sample.x, dtype=np.float32)}
+    if sample.pos is not None:
+        out["pos"] = np.asarray(sample.pos, dtype=np.float32)
+    if sample.edge_index is not None:
+        out["edge_index"] = np.ascontiguousarray(
+            np.asarray(sample.edge_index, dtype=np.int32).T
+        )  # [e, 2]: ragged axis first
+    if sample.edge_attr is not None:
+        out["edge_attr"] = np.asarray(sample.edge_attr, dtype=np.float32)
+    if sample.graph_y is not None:
+        out["graph_y"] = np.asarray(sample.graph_y, dtype=np.float32).reshape(1, -1)
+    for name, v in sample.graph_targets.items():
+        out[f"gt_{name}"] = np.asarray(v, dtype=np.float32).reshape(1, -1)
+    for name, v in sample.node_targets.items():
+        out[f"nt_{name}"] = np.asarray(v, dtype=np.float32)
+    # meta (e.g. PBC cell, composition id) rides along as ragged JSON bytes
+    # — dropping it would break downstream PBC edge building
+    # (hydragnn_tpu/data/ingest.py requires meta['cell']).
+    meta_bytes = json.dumps(_jsonable_meta(sample.meta)).encode() if sample.meta else b""
+    out["meta"] = np.frombuffer(meta_bytes, dtype=np.uint8).reshape(-1, 1).copy()
+    return out
+
+
+def _jsonable_meta(meta: Dict[str, Any]) -> Dict[str, Any]:
+    out = {}
+    for k, v in meta.items():
+        if isinstance(v, np.ndarray):
+            out[k] = v.tolist()
+        elif isinstance(v, (np.integer, np.floating)):
+            out[k] = v.item()
+        else:
+            out[k] = v
+    return out
+
+
+class ContainerWriter:
+    """Writes a sample list (this process's shard) into an HGC container.
+
+    Single-process: trivial. Multi-process (jax.process_count() > 1):
+    every process calls ``save()`` with its own shard; row counts are
+    allgathered and each process writes its byte range (the AdiosWriter
+    pattern, reference adiosdataset.py:90-130,138-179).
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self.samples: List[GraphSample] = []
+        self.attrs: Dict[str, Any] = {}
+
+    def add(self, samples: Sequence[GraphSample]) -> None:
+        self.samples.extend(samples)
+
+    def add_global(self, name: str, value) -> None:
+        self.attrs[name] = np.asarray(value).tolist() if hasattr(value, "tolist") else value
+
+    def save(self) -> None:
+        import jax
+
+        nproc, rank = jax.process_count(), jax.process_index()
+        os.makedirs(self.path, exist_ok=True)
+
+        per_sample = [_field_arrays(s) for s in self.samples]
+        if not per_sample:
+            # an empty shard cannot learn the schema, and skipping its
+            # collectives would deadlock peers mid-save
+            raise ValueError(
+                "every process must contribute at least one sample to save()"
+            )
+        field_names = sorted(per_sample[0].keys())
+        for i, fa in enumerate(per_sample):
+            if sorted(fa.keys()) != field_names:
+                raise ValueError(
+                    f"sample {i} has fields {sorted(fa.keys())}, "
+                    f"expected {field_names} (schema must be homogeneous)"
+                )
+
+        if nproc > 1:
+            from jax.experimental import multihost_utils
+
+            import hashlib
+
+            # cross-rank schema agreement: mismatched field sets would
+            # desynchronize the per-field collectives below and hang
+            fp = np.frombuffer(
+                hashlib.sha1(",".join(field_names).encode()).digest(), dtype=np.uint8
+            )
+            all_fp = np.asarray(multihost_utils.process_allgather(fp))
+            if not (all_fp == all_fp[0]).all():
+                raise ValueError("field schema differs across processes")
+            local_n = np.asarray([len(self.samples)], dtype=np.int64)
+            all_n = np.asarray(multihost_utils.process_allgather(local_n)).reshape(-1)
+        else:
+            all_n = np.asarray([len(self.samples)], dtype=np.int64)
+
+        meta: Dict[str, Any] = {
+            "ndata": int(all_n.sum()),
+            "keys": field_names,
+            "attrs": self.attrs,
+            "fields": {},
+        }
+
+        for fname in field_names:
+            arrays = [fa[fname] for fa in per_sample]
+            counts = np.asarray([a.shape[0] for a in arrays], dtype=np.int64)
+            row_shape = arrays[0].shape[1:]
+            dtype = arrays[0].dtype
+            local_concat = (
+                np.concatenate(arrays, axis=0)
+                if arrays
+                else np.zeros((0,) + row_shape, dtype)
+            )
+
+            if nproc > 1:
+                from jax.experimental import multihost_utils
+
+                local_rows = np.asarray([local_concat.shape[0]], dtype=np.int64)
+                all_rows = np.asarray(
+                    multihost_utils.process_allgather(local_rows)
+                ).reshape(-1)
+                # ragged per-shard count vectors: pad-gather-trim
+                n_max = int(all_n.max())
+                padded = np.zeros(n_max, dtype=np.int64)
+                padded[: len(counts)] = counts
+                all_counts = np.asarray(multihost_utils.process_allgather(padded))
+                global_counts = np.concatenate(
+                    [all_counts[p, : all_n[p]] for p in range(nproc)]
+                )
+            else:
+                all_rows = np.asarray([local_concat.shape[0]], dtype=np.int64)
+                global_counts = counts
+
+            total_rows = int(all_rows.sum())
+            row_start = int(all_rows[:rank].sum())
+            row_elems = int(np.prod(row_shape)) if row_shape else 1
+
+            bin_path = os.path.join(self.path, f"{fname}.bin")
+            cnt_path = os.path.join(self.path, f"{fname}.cnt")
+            if rank == 0:
+                # preallocate, write the full count index
+                with open(bin_path, "wb") as f:
+                    f.truncate(total_rows * row_elems * dtype.itemsize)
+                global_counts.astype(np.int64).tofile(cnt_path)
+            if nproc > 1:
+                from jax.experimental import multihost_utils
+
+                multihost_utils.sync_global_devices(f"hgc_alloc_{fname}")
+            if local_concat.shape[0] > 0:
+                mm = np.memmap(
+                    bin_path,
+                    dtype=dtype,
+                    mode="r+",
+                    shape=(total_rows,) + tuple(row_shape),
+                )
+                mm[row_start : row_start + local_concat.shape[0]] = local_concat
+                mm.flush()
+                del mm
+
+            meta["fields"][fname] = {
+                "dtype": dtype.name,
+                "row_shape": list(row_shape),
+                "total_rows": total_rows,
+            }
+
+        if rank == 0:
+            with open(os.path.join(self.path, "meta.json"), "w") as f:
+                json.dump(meta, f)
+        if nproc > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("hgc_meta")
+
+
+class ContainerDataset:
+    """Reads an HGC container; ``get(i)`` returns a GraphSample.
+
+    Modes: ``mmap`` (default, out-of-core), ``preload`` (all in RAM),
+    ``shm`` (node-local /dev/shm preload + mmap). ``fetch_rows`` exposes
+    the threaded native batched gather for bulk loading.
+    """
+
+    def __init__(self, path: str, mode: str = "mmap", shm_dir: Optional[str] = None):
+        if mode not in ("mmap", "preload", "shm"):
+            raise ValueError(f"unknown mode {mode}")
+        self.path = path
+        self.mode = mode
+        with open(os.path.join(path, "meta.json")) as f:
+            self.meta = json.load(f)
+        self.ndata: int = int(self.meta["ndata"])
+        self.attrs: Dict[str, Any] = self.meta.get("attrs", {})
+        self.fields: Dict[str, Dict[str, Any]] = self.meta["fields"]
+
+        self._maps: Dict[str, MappedFile] = {}
+        self._views: Dict[str, np.ndarray] = {}
+        self._counts: Dict[str, np.ndarray] = {}
+        self._offsets: Dict[str, np.ndarray] = {}
+        # key the default shm dir on the full path, not the basename —
+        # distinct containers named alike must not shadow each other
+        import hashlib
+
+        path_key = hashlib.sha1(os.path.abspath(path).encode()).hexdigest()[:12]
+        shm_target = shm_dir or os.path.join(
+            "/dev/shm",
+            f"hgc_{os.path.basename(os.path.normpath(path))}_{path_key}",
+        )
+        for fname, info in self.fields.items():
+            bin_path = os.path.join(path, f"{fname}.bin")
+            cnt_path = os.path.join(path, f"{fname}.cnt")
+            if mode == "shm":
+                bin_path = copy_to_shm(bin_path, shm_target)
+            cnt = np.fromfile(cnt_path, dtype=np.int64)
+            self._counts[fname] = cnt
+            self._offsets[fname] = np.concatenate([[0], np.cumsum(cnt)])
+            mf = MappedFile(bin_path)
+            self._maps[fname] = mf
+            view = mf.view(np.dtype(info["dtype"]), tuple(info["row_shape"]))
+            if mode == "preload":
+                view = np.array(view)  # materialize in RAM
+            self._views[fname] = view
+
+    def __len__(self) -> int:
+        return self.ndata
+
+    def field_rows(self, fname: str, idx: int) -> np.ndarray:
+        off = self._offsets[fname]
+        return self._views[fname][off[idx] : off[idx + 1]]
+
+    def get(self, idx: int) -> GraphSample:
+        if not 0 <= idx < self.ndata:
+            raise IndexError(idx)
+        x = np.array(self.field_rows("x", idx))
+        sample = GraphSample(x=x)
+        if "pos" in self._views:
+            sample.pos = np.array(self.field_rows("pos", idx))
+        if "edge_index" in self._views:
+            sample.edge_index = np.ascontiguousarray(
+                self.field_rows("edge_index", idx).T
+            )
+        if "edge_attr" in self._views:
+            sample.edge_attr = np.array(self.field_rows("edge_attr", idx))
+        if "graph_y" in self._views:
+            sample.graph_y = np.array(self.field_rows("graph_y", idx)).reshape(-1)
+        for fname in self._views:
+            if fname.startswith("gt_"):
+                sample.graph_targets[fname[3:]] = np.array(
+                    self.field_rows(fname, idx)
+                ).reshape(-1)
+            elif fname.startswith("nt_"):
+                sample.node_targets[fname[3:]] = np.array(self.field_rows(fname, idx))
+        if "meta" in self._views:
+            raw = np.array(self.field_rows("meta", idx)).reshape(-1).tobytes()
+            if raw:
+                sample.meta = json.loads(raw.decode())
+                # PBC cells round-trip as arrays (ingest requires them)
+                if "cell" in sample.meta:
+                    sample.meta["cell"] = np.asarray(sample.meta["cell"])
+        return sample
+
+    def __getitem__(self, idx: int) -> GraphSample:
+        return self.get(idx)
+
+    def samples(self, indices: Optional[Sequence[int]] = None) -> List[GraphSample]:
+        if indices is None:
+            indices = range(self.ndata)
+        return [self.get(i) for i in indices]
+
+    def fetch_rows(self, fname: str, indices: Sequence[int]) -> Tuple[np.ndarray, np.ndarray]:
+        """Bulk ragged gather via the native threaded core: returns
+        (packed rows [sum(cnt), *row_shape], per-sample counts)."""
+        info = self.fields[fname]
+        dtype = np.dtype(info["dtype"])
+        row_shape = tuple(info["row_shape"])
+        row_elems = int(np.prod(row_shape)) if row_shape else 1
+        row_bytes = row_elems * dtype.itemsize
+        idx = np.asarray(indices, dtype=np.int64)
+        cnt = self._counts[fname][idx]
+        src_off = self._offsets[fname][idx]
+        out_off = np.concatenate([[0], np.cumsum(cnt)[:-1]])
+        total = int(cnt.sum())
+        if self.mode == "preload":
+            packed = np.concatenate(
+                [self._views[fname][s : s + c] for s, c in zip(src_off, cnt)], axis=0
+            ) if total else np.zeros((0,) + row_shape, dtype)
+            return packed, cnt
+        out = np.empty(total * row_bytes, dtype=np.uint8)
+        self._maps[fname].gather(row_bytes, src_off, cnt, out_off, out)
+        return out.view(dtype).reshape((total,) + row_shape), cnt
+
+    def minmax(self) -> Tuple[Optional[np.ndarray], Optional[np.ndarray]]:
+        g = self.attrs.get("minmax_graph_feature")
+        n = self.attrs.get("minmax_node_feature")
+        return (
+            np.asarray(g) if g is not None else None,
+            np.asarray(n) if n is not None else None,
+        )
+
+    def close(self) -> None:
+        for mf in self._maps.values():
+            mf.close()
+        self._maps.clear()
+        self._views.clear()
