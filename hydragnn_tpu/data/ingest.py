@@ -161,8 +161,21 @@ def prepare_dataset(
 
 def load_raw_samples(config: Dict, path: str) -> List[GraphSample]:
     """Format dispatch for raw on-disk datasets (reference:
-    hydragnn/preprocess/load_data.py:335-349)."""
+    hydragnn/preprocess/load_data.py:335-349; format set matches the
+    reference's LSMS/CFG/XYZ readers plus the HGC container)."""
     fmt = config["Dataset"]["format"]
     if fmt in ("LSMS", "unit_test"):
         return read_lsms_dir(path, config["Dataset"])
+    if fmt == "XYZ":
+        from hydragnn_tpu.data.formats import read_xyz_dir
+
+        return read_xyz_dir(path, config["Dataset"])
+    if fmt == "CFG":
+        from hydragnn_tpu.data.formats import read_cfg_dir
+
+        return read_cfg_dir(path, config["Dataset"])
+    if fmt == "HGC":
+        from hydragnn_tpu.data.container import ContainerDataset
+
+        return ContainerDataset(path).samples()
     raise NameError(f"Data format not recognized for raw data loader: {fmt}")

@@ -1,0 +1,103 @@
+"""Epoch-gated profiler over ``jax.profiler`` tensorboard traces.
+
+Reference: hydragnn/utils/profile.py:9-70 — a torch.profiler subclass with
+schedule wait=5/warmup=3/active=3 gated to one target epoch, writing
+tensorboard traces, configured from ``NeuralNetwork.Profile``
+({"enable": 1, "target_epoch": E}) and driven by the train loop
+(set_current_epoch / context manager around the epoch / step per batch).
+
+The JAX profiler traces a time window rather than a step schedule, so the
+schedule is emulated: within the target epoch, tracing starts after
+``wait + warmup`` steps and stops after ``active`` more. Traces land in
+``<prefix>/plugins/profile`` and open in TensorBoard / XProf (including
+TPU HLO timelines when run on TPU).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+
+class Profiler:
+    def __init__(
+        self,
+        prefix: str = "",
+        enable: bool = False,
+        target_epoch: int = 0,
+        wait: int = 5,
+        warmup: int = 3,
+        active: int = 3,
+    ):
+        self.prefix = prefix or "./logs/profile"
+        self.enable = enable
+        self.target_epoch = target_epoch
+        self.current_epoch = -1
+        self.wait = wait
+        self.warmup = warmup
+        self.active = active
+        self.done = False
+        self._step_in_epoch = 0
+        self._tracing = False
+
+    def setup(self, config: dict) -> None:
+        """Configure from the ``Profile`` config section (reference keys:
+        ``enable``, ``target_epoch``; profile.py:32-42)."""
+        self.enable = config.get("enable", 0) == 1
+        self.target_epoch = config.get("target_epoch", 0)
+
+    def set_current_epoch(self, current_epoch: int) -> None:
+        self.current_epoch = current_epoch
+        self._step_in_epoch = 0
+
+    @property
+    def _armed(self) -> bool:
+        return (
+            self.enable
+            and not self.done
+            and self.current_epoch == self.target_epoch
+        )
+
+    def step(self) -> None:
+        """Call once per training batch (reference: profiler.step() in the
+        hot loop, train_validate_test.py:362)."""
+        if not self._armed:
+            return
+        self._step_in_epoch += 1
+        start_at = self.wait + self.warmup
+        if not self._tracing and self._step_in_epoch == start_at:
+            os.makedirs(self.prefix, exist_ok=True)
+            jax.profiler.start_trace(self.prefix)
+            self._tracing = True
+        elif self._tracing and self._step_in_epoch >= start_at + self.active:
+            self._stop()
+
+    def _stop(self) -> None:
+        if self._tracing:
+            jax.profiler.stop_trace()
+            self._tracing = False
+            self.done = True
+            print(f"Profiler trace written to {self.prefix} (epoch {self.target_epoch})")
+
+    def __enter__(self) -> "Profiler":
+        return self
+
+    def __exit__(self, exc_type, exc_value, tb) -> bool:
+        # end of the epoch: close an in-flight trace even if the epoch had
+        # fewer steps than wait+warmup+active
+        self._stop()
+        return False
+
+    def reset(self) -> None:
+        self._step_in_epoch = 0
+        self.done = False
+
+
+def trace_annotation(name: str):
+    """Named span inside jitted/host code for the profiler timeline — the
+    analog of torch.profiler.record_function spans
+    (reference: train_validate_test.py:349-358) and the gptl4py/nvtx shim
+    (reference: hydragnn/utils/gptl4py_dummy.py)."""
+    return jax.profiler.TraceAnnotation(name)

@@ -1,0 +1,116 @@
+"""Native XYZ/CFG parser tests with generated files.
+
+The reference covers these readers implicitly through ase; here the
+parsers are native, so the tests generate files in both formats and check
+the exact GraphSample packing the reference produces (x column orders,
+sidecar column selection, cell recovery)."""
+
+import os
+
+import numpy as np
+
+from hydragnn_tpu.data.formats import (
+    read_cfg_file,
+    read_cfg_sample,
+    read_xyz_file,
+    read_xyz_sample,
+)
+
+
+def _write_xyz(path, with_lattice=True):
+    lattice = 'Lattice="5.0 0.0 0.0 0.0 6.0 0.0 0.0 0.0 7.0" ' if with_lattice else ""
+    content = (
+        "3\n"
+        f"{lattice}Properties=species:S:1:pos:R:3\n"
+        "Fe 0.0 0.0 0.0\n"
+        "Pt 1.5 1.5 1.5\n"
+        "H 2.0 2.5 3.0\n"
+    )
+    with open(path, "w") as f:
+        f.write(content)
+    with open(os.path.splitext(path)[0] + "_energy.txt", "w") as f:
+        f.write("-123.45 0.5 7.7\n")
+
+
+def _write_cfg(path):
+    content = """Number of particles = 3
+A = 1.0 Angstrom (basic length-scale)
+H0(1,1) = 4.0 A
+H0(1,2) = 0.0 A
+H0(1,3) = 0.0 A
+H0(2,1) = 0.0 A
+H0(2,2) = 4.0 A
+H0(2,3) = 0.0 A
+H0(3,1) = 0.0 A
+H0(3,2) = 0.0 A
+H0(3,3) = 4.0 A
+.NO_VELOCITY.
+entry_count = 7
+auxiliary[0] = c_peratom
+auxiliary[1] = fx
+auxiliary[2] = fy
+auxiliary[3] = fz
+55.845
+Fe
+0.0 0.0 0.0 1.1 0.1 0.2 0.3
+0.5 0.5 0.5 2.2 0.4 0.5 0.6
+195.084
+Pt
+0.25 0.25 0.75 3.3 0.7 0.8 0.9
+"""
+    with open(path, "w") as f:
+        f.write(content)
+    with open(os.path.splitext(path)[0] + ".bulk", "w") as f:
+        f.write("42.5 99.0\n")
+
+
+def pytest_xyz_parse(tmp_path):
+    p = str(tmp_path / "s1.xyz")
+    _write_xyz(p)
+    zs, pos, cell = read_xyz_file(p)
+    np.testing.assert_array_equal(zs, [26, 78, 1])
+    np.testing.assert_allclose(pos[1], [1.5, 1.5, 1.5])
+    np.testing.assert_allclose(cell, np.diag([5.0, 6.0, 7.0]))
+
+
+def pytest_xyz_sample_with_sidecar(tmp_path):
+    p = str(tmp_path / "s2.xyz")
+    _write_xyz(p)
+    # graph feature: 1 feature of dim 2 starting at column 1 -> [0.5, 7.7]
+    s = read_xyz_sample(p, [2], [1])
+    np.testing.assert_allclose(s.graph_y, [0.5, 7.7])
+    np.testing.assert_array_equal(s.x[:, 0], [26, 78, 1])
+    np.testing.assert_allclose(s.meta["cell"], np.diag([5.0, 6.0, 7.0]))
+
+
+def pytest_xyz_without_lattice(tmp_path):
+    p = str(tmp_path / "s3.xyz")
+    _write_xyz(p, with_lattice=False)
+    s = read_xyz_sample(p, [1], [0])
+    assert "cell" not in s.meta
+    np.testing.assert_allclose(s.graph_y, [-123.45])
+
+
+def pytest_cfg_parse(tmp_path):
+    p = str(tmp_path / "c1.cfg")
+    _write_cfg(p)
+    parsed = read_cfg_file(p)
+    np.testing.assert_array_equal(parsed["numbers"], [26, 26, 78])
+    np.testing.assert_allclose(parsed["masses"], [55.845, 55.845, 195.084])
+    np.testing.assert_allclose(parsed["cell"], np.eye(3) * 4.0)
+    # reduced (0.5,0.5,0.5) @ 4A cell -> (2,2,2)
+    np.testing.assert_allclose(parsed["pos"][1], [2.0, 2.0, 2.0])
+    np.testing.assert_allclose(parsed["c_peratom"], [1.1, 2.2, 3.3])
+    np.testing.assert_allclose(parsed["fz"], [0.3, 0.6, 0.9])
+
+
+def pytest_cfg_sample_packing(tmp_path):
+    p = str(tmp_path / "c2.cfg")
+    _write_cfg(p)
+    s = read_cfg_sample(p, [1], [0])
+    # reference packing: [Z, mass, c_peratom, fx, fy, fz]
+    np.testing.assert_allclose(
+        s.x[2], [78, 195.084, 3.3, 0.7, 0.8, 0.9], rtol=1e-6
+    )
+    np.testing.assert_allclose(s.graph_y, [42.5])
+    np.testing.assert_allclose(s.meta["cell"], np.eye(3) * 4.0)
