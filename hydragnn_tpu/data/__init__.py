@@ -10,6 +10,13 @@ from hydragnn_tpu.data.dataset import (
 from hydragnn_tpu.data.splitting import split_dataset, compositional_stratified_splitting
 from hydragnn_tpu.data.loader import GraphLoader, pad_plan_for
 from hydragnn_tpu.data.synthetic import deterministic_graph_data
+from hydragnn_tpu.data.smiles import (
+    generate_graphdata_from_smilestr,
+    get_node_attribute_name,
+    mol_from_smiles,
+    parse_smiles,
+)
+from hydragnn_tpu.data.atomic_descriptors import atomicdescriptors
 
 __all__ = [
     "radius_graph",
@@ -25,4 +32,9 @@ __all__ = [
     "GraphLoader",
     "pad_plan_for",
     "deterministic_graph_data",
+    "generate_graphdata_from_smilestr",
+    "get_node_attribute_name",
+    "mol_from_smiles",
+    "parse_smiles",
+    "atomicdescriptors",
 ]

@@ -1,0 +1,11 @@
+from hydragnn_tpu.tools.lsms_tools import (
+    compositional_histogram_cutoff,
+    compute_formation_enthalpy,
+    convert_raw_data_energy_to_gibbs,
+)
+
+__all__ = [
+    "compositional_histogram_cutoff",
+    "compute_formation_enthalpy",
+    "convert_raw_data_energy_to_gibbs",
+]

@@ -1,0 +1,70 @@
+"""Formation enthalpy / Gibbs conversion tests (reference:
+tests/test_enthalpy.py:21-65 — with linear-only targets every formation
+enthalpy must be exactly 0)."""
+
+import os
+
+import numpy as np
+
+from hydragnn_tpu.data.synthetic import write_lsms_files
+from hydragnn_tpu.tools import (
+    compositional_histogram_cutoff,
+    convert_raw_data_energy_to_gibbs,
+)
+
+
+def _make_binary_dataset(dir, num_config=10):
+    write_lsms_files(dir, num_config, number_types=2, linear_only=True)
+    # pure components (reference builds one file per pure element)
+    write_lsms_files(dir, 1, configuration_start=num_config, types=[0],
+                     linear_only=True)
+    write_lsms_files(dir, 1, configuration_start=num_config + 1, types=[1],
+                     linear_only=True)
+
+
+def pytest_formation_enthalpy(tmp_path):
+    dir = str(tmp_path / "unit_test_enthalpy")
+    _make_binary_dataset(dir)
+    new_dir = convert_raw_data_energy_to_gibbs(dir, [0, 1], create_plots=False)
+    files = os.listdir(new_dir)
+    assert len(files) == 12
+    for filename in files:
+        enthalpy = np.loadtxt(os.path.join(new_dir, filename), max_rows=1)
+        assert abs(float(np.atleast_1d(enthalpy)[0])) < 1e-8
+
+
+def pytest_gibbs_temperature_lowers_energy(tmp_path):
+    dir = str(tmp_path / "unit_test_gibbs")
+    _make_binary_dataset(dir)
+    hot = convert_raw_data_energy_to_gibbs(
+        dir, [0, 1], temperature_kelvin=1000.0, create_plots=False,
+        overwrite_data=True,
+    )
+    # mixed configurations must have strictly negative Gibbs energy at T>0
+    # (enthalpy 0 minus T * positive entropy); pure ones stay exactly 0
+    n_mixed = sum(
+        len(np.unique(np.loadtxt(os.path.join(dir, f), skiprows=1,
+                                 ndmin=2)[:, 0])) > 1
+        for f in os.listdir(dir)
+    )
+    n_negative = 0
+    for filename in os.listdir(hot):
+        g = float(np.atleast_1d(
+            np.loadtxt(os.path.join(hot, filename), max_rows=1))[0])
+        assert g <= 1e-12
+        if g < -1e-12:
+            n_negative += 1
+    assert n_negative == n_mixed > 0
+
+
+def pytest_histogram_cutoff(tmp_path):
+    dir = str(tmp_path / "unit_test_cutoff")
+    _make_binary_dataset(dir, num_config=20)
+    out = compositional_histogram_cutoff(
+        dir, [0, 1], histogram_cutoff=2, num_bins=5, create_plots=False,
+    )
+    kept = os.listdir(out)
+    assert 0 < len(kept) <= 5 * 2
+    # symlinks resolve to original files
+    for f in kept:
+        assert os.path.exists(os.path.join(out, f))

@@ -1,0 +1,128 @@
+"""SMILES featurizer tests (reference behavior:
+hydragnn/utils/smiles_utils.py:18-119 via RDKit; here a native parser).
+
+Assertions check hydrogen-complete formulas, feature layout, bond classes,
+and H-neighbor counts against hand-computed chemistry.
+"""
+
+import numpy as np
+import pytest
+
+from hydragnn_tpu.data.smiles import (
+    SmilesParseError,
+    generate_graphdata_from_smilestr,
+    get_node_attribute_name,
+    mol_from_smiles,
+    molecular_formula,
+    parse_smiles,
+)
+from hydragnn_tpu.data.atomic_descriptors import atomicdescriptors
+
+TYPES = {"C": 0, "H": 1, "O": 2, "N": 3, "F": 4, "S": 5}
+
+
+@pytest.mark.parametrize(
+    "smiles,formula",
+    [
+        ("C", {"C": 1, "H": 4}),                      # methane
+        ("CC", {"C": 2, "H": 6}),                     # ethane
+        ("C=C", {"C": 2, "H": 4}),                    # ethene
+        ("C#N", {"C": 1, "N": 1, "H": 1}),            # HCN
+        ("CO", {"C": 1, "O": 1, "H": 4}),             # methanol
+        ("c1ccccc1", {"C": 6, "H": 6}),               # benzene
+        ("c1ccncc1", {"C": 5, "N": 1, "H": 5}),       # pyridine
+        ("c1cc[nH]c1", {"C": 4, "N": 1, "H": 5}),     # pyrrole
+        ("c1ccoc1", {"C": 4, "O": 1, "H": 4}),        # furan
+        ("Cc1ccccc1", {"C": 7, "H": 8}),              # toluene
+        ("CC(=O)O", {"C": 2, "O": 2, "H": 4}),        # acetic acid
+        ("C1CC1", {"C": 3, "H": 6}),                  # cyclopropane
+        ("[NH4+]", {"N": 1, "H": 4}),                 # bracket atom + charge
+        ("O.O", {"O": 2, "H": 4}),                    # disconnected waters
+        ("N#N", {"N": 2}),                            # dinitrogen
+        ("CS(=O)(=O)C", {"C": 2, "S": 1, "O": 2, "H": 6}),  # DMSO2 (S valence 6)
+    ],
+)
+def pytest_formula(smiles, formula):
+    assert molecular_formula(mol_from_smiles(smiles)) == formula
+
+
+def pytest_parse_errors():
+    for bad in ["C(", "C)", "C1CC", "[C", "Cl(", "Xx", "C%1"]:
+        with pytest.raises((SmilesParseError, ValueError)):
+            mol_from_smiles(bad)
+
+
+def pytest_ring_closure_percent():
+    # %12-style two-digit ring closure
+    atoms, bonds = parse_smiles("C%12CCCCC%12")
+    assert len(atoms) == 6 and len(bonds) == 6
+
+
+def pytest_feature_layout_methane():
+    g = generate_graphdata_from_smilestr("C", np.array([1.5]), TYPES)
+    # 1 C + 4 H, features = 6 one-hot + [Z, aromatic, sp, sp2, sp3, numHs]
+    assert g.x.shape == (5, len(TYPES) + 6)
+    c = g.x[0]
+    assert c[0] == 1.0 and c[len(TYPES)] == 6  # one-hot C, Z=6
+    assert c[len(TYPES) + 1] == 0  # not aromatic
+    assert tuple(c[len(TYPES) + 2 : len(TYPES) + 5]) == (0, 0, 1)  # sp3
+    assert c[len(TYPES) + 5] == 4  # 4 H neighbors
+    for h in g.x[1:]:
+        assert h[1] == 1.0 and h[len(TYPES)] == 1
+    # 4 bonds, both directions
+    assert g.edge_index.shape == (2, 8)
+    # all single bonds -> class 0
+    assert np.all(g.edge_attr[:, 0] == 1)
+    # sorted by sender*N+receiver like the reference (smiles_utils.py:83-85)
+    key = g.edge_index[0] * 5 + g.edge_index[1]
+    assert np.all(np.diff(key) > 0)
+
+
+def pytest_hybridization_and_aromatic():
+    g = generate_graphdata_from_smilestr("c1ccccc1", np.array([0.0]), TYPES)
+    ring = g.x[:6]
+    assert np.all(ring[:, len(TYPES) + 1] == 1)  # aromatic
+    assert np.all(ring[:, len(TYPES) + 3] == 1)  # sp2
+    # aromatic bond class 3 present
+    arom_edges = g.edge_attr[:, 3].sum()
+    assert arom_edges == 12  # 6 ring bonds x 2 directions
+
+    g2 = generate_graphdata_from_smilestr("C#N", np.array([0.0]), TYPES)
+    assert g2.x[0, len(TYPES) + 2] == 1  # C is sp
+    assert g2.edge_attr[:, 2].sum() == 2  # one triple bond, 2 directions
+
+
+def pytest_graph_target_and_descriptors(tmp_path):
+    desc = atomicdescriptors(str(tmp_path / "emb.json"), element_types=["C", "H", "O"])
+    g0 = generate_graphdata_from_smilestr("CO", np.array([2.0]), TYPES)
+    table = np.stack(
+        [desc.get_atom_features(int(z)) for z in g0.x[:, len(TYPES)]]
+    )
+    g = generate_graphdata_from_smilestr("CO", np.array([2.0]), TYPES,
+                                         atomic_descriptors=table)
+    assert g.graph_y.tolist() == [2.0]
+    assert g.x.shape[1] == len(TYPES) + 6 + table.shape[1]
+
+
+def pytest_node_attribute_names():
+    names, dims = get_node_attribute_name(TYPES)
+    assert names[:2] == ["atomC", "atomH"]
+    assert names[-1] == "Hprop" and all(d == 1 for d in dims)
+
+
+def pytest_descriptor_table(tmp_path):
+    d = atomicdescriptors(str(tmp_path / "e.json"),
+                          element_types=["C", "H", "S"])
+    fc = d.get_atom_features("C")
+    # 3 type one-hot + group + period + radius + EA + 4 block + volume + Z
+    # + weight + EN + nvalence + ion = 3 + 1*10 + 4 = 17
+    assert fc.shape == (17,)
+    assert d.get_atom_features(6).tolist() == fc.tolist()
+    # reload path (overwritten=False)
+    d2 = atomicdescriptors(str(tmp_path / "e.json"), overwritten=False)
+    assert d2.get_atom_features("S").shape == (17,)
+    # one-hot mode: all entries binary
+    d3 = atomicdescriptors(str(tmp_path / "e1h.json"),
+                           element_types=["C", "H", "S"], one_hot=True)
+    f1h = d3.get_atom_features("H")
+    assert set(np.unique(f1h)).issubset({0.0, 1.0})
