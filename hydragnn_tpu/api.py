@@ -70,6 +70,22 @@ def prepare_loaders_and_config(
     voi["minmax_node_feature"] = mm_n.tolist()
     config = update_config(config, train, val, test)
 
+    train_loader, val_loader, test_loader = create_dataloaders(
+        train, val, test, config, device_stack=device_stack
+    )
+    return train_loader, val_loader, test_loader, config
+
+
+def create_dataloaders(
+    train: List,
+    val: List,
+    test: List,
+    config: Dict[str, Any],
+    device_stack: int = 1,
+) -> Tuple[GraphLoader, GraphLoader, GraphLoader]:
+    """Per-split loaders over prepared sample lists (the reference's
+    ``create_dataloaders``, hydragnn/preprocess/load_data.py:226-283; the
+    DistributedSampler role is played by num_shards/shard_rank)."""
     training = config["NeuralNetwork"]["Training"]
     bs = int(training["batch_size"])
     nproc, rank = jax.process_count(), jax.process_index()
@@ -82,7 +98,7 @@ def prepare_loaders_and_config(
     train_loader = GraphLoader(train, bs, shuffle=True, **kw)
     val_loader = GraphLoader(val, bs, **kw)
     test_loader = GraphLoader(test, bs, **kw)
-    return train_loader, val_loader, test_loader, config
+    return train_loader, val_loader, test_loader
 
 
 def _choose_device_stack(config: Dict[str, Any]) -> int:
@@ -101,22 +117,19 @@ def _choose_device_stack(config: Dict[str, Any]) -> int:
     return n_local if n_local > 1 and bs % n_local == 0 else 1
 
 
-def run_training(
-    config_file_or_dict,
-    samples: Optional[List] = None,
+def train_with_loaders(
+    config: Dict[str, Any],
+    train_loader: GraphLoader,
+    val_loader: GraphLoader,
+    test_loader: GraphLoader,
     log_dir: str = "./logs/",
+    device_stack: int = 1,
 ):
-    """Full training pipeline; returns (model, state, history, config)."""
-    config = load_config(config_file_or_dict)
+    """Model creation + optimizer + epoch loop + checkpoint save, on
+    already-built loaders whose config has been through ``update_config``
+    — the manual-wiring tail every reference example driver repeats
+    (e.g. examples/qm9/qm9.py:66-95). Returns (model, state, history)."""
     verbosity = config.get("Verbosity", {}).get("level", 0)
-
-    timer = Timer("total_training")
-    timer.start()
-
-    device_stack = _choose_device_stack(config)
-    train_loader, val_loader, test_loader, config = prepare_loaders_and_config(
-        config, samples, device_stack=device_stack
-    )
     log_name = get_log_name_config(config)
     setup_log(log_name, log_dir)
     save_config(config, log_name, log_dir)
@@ -183,6 +196,33 @@ def run_training(
     )
 
     save_model(state, log_name, log_dir, verbosity)
+    return model, state, history
+
+
+def run_training(
+    config_file_or_dict,
+    samples: Optional[List] = None,
+    log_dir: str = "./logs/",
+):
+    """Full training pipeline; returns (model, state, history, config)."""
+    config = load_config(config_file_or_dict)
+    verbosity = config.get("Verbosity", {}).get("level", 0)
+
+    timer = Timer("total_training")
+    timer.start()
+
+    device_stack = _choose_device_stack(config)
+    train_loader, val_loader, test_loader, config = prepare_loaders_and_config(
+        config, samples, device_stack=device_stack
+    )
+    model, state, history = train_with_loaders(
+        config,
+        train_loader,
+        val_loader,
+        test_loader,
+        log_dir=log_dir,
+        device_stack=device_stack,
+    )
     timer.stop()
     print_timers(verbosity)
     return model, state, history, config

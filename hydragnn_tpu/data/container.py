@@ -62,7 +62,9 @@ def _field_arrays(sample: GraphSample) -> Dict[str, np.ndarray]:
     # (hydragnn_tpu/data/ingest.py requires meta['cell']).
     meta_bytes = json.dumps(_jsonable_meta(sample.meta)).encode() if sample.meta else b""
     out["meta"] = np.frombuffer(meta_bytes, dtype=np.uint8).reshape(-1, 1).copy()
-    return out
+    # zero-width fields (e.g. graph_y with no configured graph features)
+    # carry no data and would mmap empty .bin files
+    return {k: v for k, v in out.items() if int(np.prod(v.shape[1:])) > 0 or v.ndim == 1}
 
 
 def _jsonable_meta(meta: Dict[str, Any]) -> Dict[str, Any]:
@@ -176,6 +178,10 @@ class ContainerWriter:
             total_rows = int(all_rows.sum())
             row_start = int(all_rows[:rank].sum())
             row_elems = int(np.prod(row_shape)) if row_shape else 1
+            if total_rows * row_elems == 0:
+                # nothing to store (e.g. no sample carries meta); an empty
+                # .bin cannot be mmapped, so omit the field entirely
+                continue
 
             bin_path = os.path.join(self.path, f"{fname}.bin")
             cnt_path = os.path.join(self.path, f"{fname}.cnt")

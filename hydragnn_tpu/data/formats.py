@@ -228,7 +228,7 @@ def read_cfg_sample(
     x = np.stack(cols, axis=1)
     graph_y = None
     bulk_path = os.path.splitext(path)[0] + ".bulk"
-    if os.path.exists(bulk_path):
+    if os.path.exists(bulk_path) and sum(graph_feature_dims) > 0:
         graph_y = _sidecar_graph_features(bulk_path, graph_feature_dims, graph_feature_cols)
     return GraphSample(
         x=x,

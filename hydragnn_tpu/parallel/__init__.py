@@ -1,9 +1,11 @@
 from hydragnn_tpu.parallel.mesh import (
     DATA_AXIS,
+    barrier,
     batch_sharding,
     get_comm_size_and_rank,
     local_device_count,
     make_mesh,
+    nsplit,
     replicated_sharding,
     setup_distributed,
 )

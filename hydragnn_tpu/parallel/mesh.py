@@ -30,6 +30,23 @@ DATA_AXIS = "data"
 _DISTRIBUTED_INITIALIZED = False
 
 
+def nsplit(seq, n: int):
+    """Split ``seq`` into ``n`` near-even contiguous chunks (the
+    reference's work-sharding helper, hydragnn/utils/distributed.py:246-248)
+    — used to shard file lists / generation work across processes."""
+    k, m = divmod(len(seq), n)
+    return (seq[i * k + min(i, m) : (i + 1) * k + min(i + 1, m)] for i in range(n))
+
+
+def barrier(tag: str = "barrier") -> None:
+    """Cross-process sync point (the reference's ``comm.Barrier()``
+    pattern in the example drivers); no-op single-process."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(tag)
+
+
 def _multiprocess_env_configured() -> bool:
     """Pure env sniffing — MUST NOT touch any jax API that would
     initialize the XLA backend (``jax.distributed.initialize`` has to run

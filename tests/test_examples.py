@@ -1,0 +1,45 @@
+"""Subprocess smoke tests for the example drivers (reference:
+tests/test_examples.py:18-26 runs qm9 and md17 the same way). Each
+example runs offline on its synthetic fallback dataset with tiny sizes.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_example(subdir: str, script: str, *args: str) -> None:
+    path = os.path.join(_REPO, "examples", subdir)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)  # single-device run is enough for a smoke test
+    ret = subprocess.run(
+        [sys.executable, script, *args],
+        cwd=path,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+    assert ret.returncode == 0, f"{subdir}/{script} failed:\n{ret.stdout}\n{ret.stderr}"
+
+
+@pytest.mark.parametrize(
+    "subdir,script,args",
+    [
+        ("qm9", "qm9.py", ["--nsamples", "120"]),
+        ("md17", "md17.py", ["--maxframes", "150"]),
+    ],
+)
+def pytest_examples_train(subdir, script, args):
+    _run_example(subdir, script, *args)
+
+
+def pytest_example_ising_preonly_then_train(tmp_path):
+    """The container (preonly) pipeline end to end on the smallest lattice."""
+    _run_example("ising_model", "train_ising.py", "--preonly", "--natom", "2",
+                 "--cutoff", "6")
+    _run_example("ising_model", "train_ising.py", "--natom", "2", "--cutoff", "6")
