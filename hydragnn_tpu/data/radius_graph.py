@@ -105,7 +105,10 @@ def _candidate_pairs(
     """All (src, dst, dist) pairs with dist <= r, via a uniform cell grid.
 
     Cell size = r, so neighbors of a dst point lie in the 27 surrounding
-    cells of its grid cell. O(N * avg_bucket) instead of O(N^2).
+    cells of its grid cell. O(N * avg_bucket) instead of O(N^2). The hot
+    path is the threaded C++ cell-list kernel (native/radius.cpp, the
+    torch-cluster/ase-neighborlist stand-in, SURVEY.md §2.9); numpy
+    below is the no-compiler fallback.
     """
     n_src, n_dst = src_pos.shape[0], dst_pos.shape[0]
     if n_src * n_dst <= 4096:  # tiny: brute force is faster than bucketing
@@ -113,6 +116,12 @@ def _candidate_pairs(
         dist = np.sqrt((diff * diff).sum(-1))
         s, t = np.nonzero(dist <= r)
         return s.astype(np.int64), t.astype(np.int64), dist[s, t]
+
+    from hydragnn_tpu.native import native_radius_pairs
+
+    native = native_radius_pairs(src_pos, dst_pos, r)
+    if native is not None:
+        return native
 
     origin = np.minimum(src_pos.min(0), dst_pos.min(0))
     inv = 1.0 / max(r, 1e-12)

@@ -18,7 +18,10 @@ from typing import Optional
 import numpy as np
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-_SRC = os.path.join(_REPO_ROOT, "native", "hgc.cpp")
+_SRCS = [
+    os.path.join(_REPO_ROOT, "native", "hgc.cpp"),
+    os.path.join(_REPO_ROOT, "native", "radius.cpp"),
+]
 _BUILD_DIR = os.path.join(_REPO_ROOT, "native", "build")
 
 _lib: Optional[ctypes.CDLL] = None
@@ -28,7 +31,9 @@ HAVE_NATIVE = False
 
 def _build_library() -> Optional[str]:
     so_path = os.path.join(_BUILD_DIR, "libhgc.so")
-    if os.path.exists(so_path) and os.path.getmtime(so_path) >= os.path.getmtime(_SRC):
+    if os.path.exists(so_path) and all(
+        os.path.getmtime(so_path) >= os.path.getmtime(src) for src in _SRCS
+    ):
         return so_path
     os.makedirs(_BUILD_DIR, exist_ok=True)
     # Build into a temp name + atomic rename: concurrent processes (pytest
@@ -37,7 +42,7 @@ def _build_library() -> Optional[str]:
     os.close(fd)
     cmd = [
         "g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
-        _SRC, "-o", tmp,
+        *_SRCS, "-o", tmp,
     ]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
@@ -77,9 +82,49 @@ def _load() -> Optional[ctypes.CDLL]:
     ]
     lib.hgc_copy_file.restype = ctypes.c_int
     lib.hgc_copy_file.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+    lib.rg_pairs.restype = ctypes.c_int64
+    lib.rg_pairs.argtypes = [
+        ctypes.POINTER(ctypes.c_double), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_double), ctypes.c_int64,
+        ctypes.c_double,
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_double), ctypes.c_int64, ctypes.c_int,
+    ]
     _lib = lib
     HAVE_NATIVE = True
     return lib
+
+
+def native_radius_pairs(src_pos, dst_pos, r):
+    """All (src, dst, dist) pairs with dist <= r via the C++ cell-list
+    kernel; returns None when the native library is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    src = np.ascontiguousarray(src_pos, dtype=np.float64)
+    dst = np.ascontiguousarray(dst_pos, dtype=np.float64)
+    n_src, n_dst = src.shape[0], dst.shape[0]
+    capacity = max(1024, n_dst * 48)
+    for _ in range(2):
+        s = np.empty(capacity, dtype=np.int64)
+        t = np.empty(capacity, dtype=np.int64)
+        d = np.empty(capacity, dtype=np.float64)
+        total = lib.rg_pairs(
+            src.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            ctypes.c_int64(n_src),
+            dst.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            ctypes.c_int64(n_dst),
+            ctypes.c_double(float(r)),
+            s.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            t.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            d.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            ctypes.c_int64(capacity),
+            ctypes.c_int(0),
+        )
+        if total <= capacity:
+            return s[:total], t[:total], d[:total]
+        capacity = int(total)
+    raise RuntimeError("rg_pairs capacity retry failed")  # pragma: no cover
 
 
 class MappedFile:

@@ -1,0 +1,155 @@
+// Cell-list radius-graph pair finder — the native stand-in for
+// torch-cluster's RadiusGraph / ase.neighborlist (SURVEY.md §2.9).
+//
+// rg_pairs(): all (src, dst) pairs with |src_pos[s] - dst_pos[t]| <= r,
+// found via a uniform grid of cell size r (each dst point only scans the
+// 27 surrounding cells), parallelized over dst points. The bipartite
+// form serves both the plain radius graph (src == dst) and the periodic
+// one (src = dst + image shift, one call per shift).
+//
+// Output protocol: writes up to `capacity` edges into the caller's
+// buffers and returns the total pair count; when the total exceeds
+// capacity the caller re-invokes with a larger buffer (the count is
+// exact either way).
+
+#include <atomic>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Grid {
+  double origin[3];
+  double inv_cell;
+  int64_t dims[3];
+  // CSR buckets over src points
+  std::vector<int64_t> bucket_start;
+  std::vector<int64_t> order;
+
+  int64_t cell_of(const double* p, int64_t k0, int64_t k1, int64_t k2) const {
+    return (k0 * dims[1] + k1) * dims[2] + k2;
+  }
+};
+
+inline int64_t clampi(int64_t v, int64_t lo, int64_t hi) {
+  return v < lo ? lo : (v > hi ? hi : v);
+}
+
+void build_grid(const double* src, int64_t n_src, const double* dst,
+                int64_t n_dst, double r, Grid& g) {
+  for (int d = 0; d < 3; ++d) {
+    double mn = 1e300;
+    for (int64_t i = 0; i < n_src; ++i) mn = std::min(mn, src[3 * i + d]);
+    for (int64_t i = 0; i < n_dst; ++i) mn = std::min(mn, dst[3 * i + d]);
+    g.origin[d] = mn;
+  }
+  g.inv_cell = 1.0 / std::max(r, 1e-12);
+  int64_t mx[3] = {0, 0, 0};
+  auto cell_coord = [&](const double* p, int d) {
+    return (int64_t)std::floor((p[d] - g.origin[d]) * g.inv_cell);
+  };
+  for (int64_t i = 0; i < n_src; ++i)
+    for (int d = 0; d < 3; ++d)
+      mx[d] = std::max(mx[d], cell_coord(src + 3 * i, d));
+  for (int64_t i = 0; i < n_dst; ++i)
+    for (int d = 0; d < 3; ++d)
+      mx[d] = std::max(mx[d], cell_coord(dst + 3 * i, d));
+  for (int d = 0; d < 3; ++d) g.dims[d] = mx[d] + 1;
+
+  const int64_t n_cells = g.dims[0] * g.dims[1] * g.dims[2];
+  g.bucket_start.assign(n_cells + 1, 0);
+  std::vector<int64_t> cell_id(n_src);
+  for (int64_t i = 0; i < n_src; ++i) {
+    int64_t k0 = cell_coord(src + 3 * i, 0);
+    int64_t k1 = cell_coord(src + 3 * i, 1);
+    int64_t k2 = cell_coord(src + 3 * i, 2);
+    cell_id[i] = (k0 * g.dims[1] + k1) * g.dims[2] + k2;
+    g.bucket_start[cell_id[i] + 1]++;
+  }
+  for (int64_t c = 0; c < n_cells; ++c) g.bucket_start[c + 1] += g.bucket_start[c];
+  g.order.resize(n_src);
+  std::vector<int64_t> cursor(g.bucket_start.begin(), g.bucket_start.end() - 1);
+  for (int64_t i = 0; i < n_src; ++i) g.order[cursor[cell_id[i]]++] = i;
+}
+
+struct Hit {
+  int64_t s, t;
+  double d;
+};
+
+}  // namespace
+
+extern "C" {
+
+// Returns the exact pair count; fills at most `capacity` entries of
+// (senders, receivers, dists).
+int64_t rg_pairs(const double* src_pos, int64_t n_src, const double* dst_pos,
+                 int64_t n_dst, double r, int64_t* senders, int64_t* receivers,
+                 double* dists, int64_t capacity, int n_threads) {
+  if (n_src == 0 || n_dst == 0) return 0;
+  Grid g;
+  build_grid(src_pos, n_src, dst_pos, n_dst, r, g);
+  const double r2 = r * r;
+
+  int T = n_threads > 0 ? n_threads
+                        : (int)std::min<int64_t>(
+                              std::max(1u, std::thread::hardware_concurrency()),
+                              std::max<int64_t>(1, n_dst / 512));
+  if (T < 1) T = 1;
+  std::vector<std::vector<Hit>> results((size_t)T);
+
+  auto worker = [&](int tid) {
+    std::vector<Hit>& out = results[(size_t)tid];
+    const int64_t lo = n_dst * tid / T, hi = n_dst * (tid + 1) / T;
+    for (int64_t t = lo; t < hi; ++t) {
+      const double* p = dst_pos + 3 * t;
+      int64_t c0 = (int64_t)std::floor((p[0] - g.origin[0]) * g.inv_cell);
+      int64_t c1 = (int64_t)std::floor((p[1] - g.origin[1]) * g.inv_cell);
+      int64_t c2 = (int64_t)std::floor((p[2] - g.origin[2]) * g.inv_cell);
+      for (int64_t a = clampi(c0 - 1, 0, g.dims[0] - 1);
+           a <= clampi(c0 + 1, 0, g.dims[0] - 1); ++a)
+        for (int64_t b = clampi(c1 - 1, 0, g.dims[1] - 1);
+             b <= clampi(c1 + 1, 0, g.dims[1] - 1); ++b)
+          for (int64_t c = clampi(c2 - 1, 0, g.dims[2] - 1);
+               c <= clampi(c2 + 1, 0, g.dims[2] - 1); ++c) {
+            const int64_t cell = (a * g.dims[1] + b) * g.dims[2] + c;
+            for (int64_t k = g.bucket_start[cell]; k < g.bucket_start[cell + 1];
+                 ++k) {
+              const int64_t s = g.order[k];
+              const double* q = src_pos + 3 * s;
+              const double dx = q[0] - p[0], dy = q[1] - p[1], dz = q[2] - p[2];
+              const double d2 = dx * dx + dy * dy + dz * dz;
+              if (d2 <= r2) out.push_back({s, t, std::sqrt(d2)});
+            }
+          }
+    }
+  };
+
+  if (T == 1) {
+    worker(0);
+  } else {
+    std::vector<std::thread> threads;
+    threads.reserve((size_t)T);
+    for (int tid = 0; tid < T; ++tid) threads.emplace_back(worker, tid);
+    for (auto& th : threads) th.join();
+  }
+
+  int64_t total = 0;
+  for (auto& v : results) total += (int64_t)v.size();
+  if (total <= capacity) {
+    int64_t w = 0;
+    for (auto& v : results)
+      for (const Hit& h : v) {
+        senders[w] = h.s;
+        receivers[w] = h.t;
+        dists[w] = h.d;
+        ++w;
+      }
+  }
+  return total;
+}
+
+}  // extern "C"
