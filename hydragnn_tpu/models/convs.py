@@ -193,15 +193,28 @@ class PNAConv(nn.Module):
         z = jnp.concatenate(z, axis=-1)
         msg = nn.Dense(fin)(z)  # pre_nn, pre_layers=1
 
+        # mean/std share one fused sum-family pass (sum, sumsq, count read
+        # the messages once — hydragnn_tpu/ops/segment_pallas.py); min/max
+        # are separate XLA segment reductions.
+        from hydragnn_tpu.ops import segment_sum_family
+
+        msum, msumsq, cnt = segment_sum_family(
+            msg, ctx.receivers, n, mask=ctx.edge_mask
+        )
+        safe_cnt = jnp.maximum(cnt, 1.0)[:, None]
+        mean = msum / safe_cnt
+        # PyG 'std': sqrt(relu(mean(x^2) - mean(x)^2) + eps)
+        var = jax.nn.relu(msumsq / safe_cnt - mean * mean)
+        std = jnp.sqrt(var + 1e-5)
         aggs = [
-            S.segment_mean(msg, ctx.receivers, n, mask=ctx.edge_mask),
+            mean,
             S.segment_min(msg, ctx.receivers, n, mask=ctx.edge_mask),
             S.segment_max(msg, ctx.receivers, n, mask=ctx.edge_mask),
-            S.segment_std(msg, ctx.receivers, n, mask=ctx.edge_mask),
+            std,
         ]
         agg = jnp.concatenate(aggs, axis=-1)  # [N, 4*fin]
 
-        deg = jnp.maximum(S.node_degree(ctx.receivers, n, mask=ctx.edge_mask), 1.0)
+        deg = jnp.maximum(cnt, 1.0)
         log_deg = jnp.log(deg + 1.0)[:, None]
         amplification = log_deg / self.avg_deg_log
         attenuation = self.avg_deg_log / log_deg

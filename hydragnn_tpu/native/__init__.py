@@ -42,6 +42,7 @@ def _build_library() -> Optional[str]:
     os.close(fd)
     cmd = [
         "g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+        "-Werror=return-type",  # missing return in C++ is silent UB
         *_SRCS, "-o", tmp,
     ]
     try:
@@ -109,7 +110,17 @@ def native_radius_pairs(src_pos, dst_pos, r):
         s = np.empty(capacity, dtype=np.int64)
         t = np.empty(capacity, dtype=np.int64)
         d = np.empty(capacity, dtype=np.float64)
-        total = lib.rg_pairs(
+        total = _rg_pairs_raw(lib, src, dst, n_src, n_dst, r, s, t, d, capacity)
+        if total < 0:
+            return None  # dense grid unsuited (outliers/sparse cloud)
+        if total <= capacity:
+            return s[:total], t[:total], d[:total]
+        capacity = int(total)
+    raise RuntimeError("rg_pairs capacity retry failed")  # pragma: no cover
+
+
+def _rg_pairs_raw(lib, src, dst, n_src, n_dst, r, s, t, d, capacity):
+    return lib.rg_pairs(
             src.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
             ctypes.c_int64(n_src),
             dst.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
@@ -121,10 +132,6 @@ def native_radius_pairs(src_pos, dst_pos, r):
             ctypes.c_int64(capacity),
             ctypes.c_int(0),
         )
-        if total <= capacity:
-            return s[:total], t[:total], d[:total]
-        capacity = int(total)
-    raise RuntimeError("rg_pairs capacity retry failed")  # pragma: no cover
 
 
 class MappedFile:

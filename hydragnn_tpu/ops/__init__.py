@@ -1,0 +1,6 @@
+from hydragnn_tpu.ops.segment_pallas import (
+    pallas_available,
+    segment_sum_family,
+    segment_sum_family_pallas,
+    segment_sum_family_xla,
+)

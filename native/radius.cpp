@@ -28,17 +28,17 @@ struct Grid {
   // CSR buckets over src points
   std::vector<int64_t> bucket_start;
   std::vector<int64_t> order;
-
-  int64_t cell_of(const double* p, int64_t k0, int64_t k1, int64_t k2) const {
-    return (k0 * dims[1] + k1) * dims[2] + k2;
-  }
 };
 
 inline int64_t clampi(int64_t v, int64_t lo, int64_t hi) {
   return v < lo ? lo : (v > hi ? hi : v);
 }
 
-void build_grid(const double* src, int64_t n_src, const double* dst,
+// Returns false when the dense grid would be pathologically large
+// (sparse point cloud / outlier coordinates) — the caller then reports
+// "unsupported" and Python uses its sparse-key fallback instead of this
+// allocation aborting the process.
+bool build_grid(const double* src, int64_t n_src, const double* dst,
                 int64_t n_dst, double r, Grid& g) {
   for (int d = 0; d < 3; ++d) {
     double mn = 1e300;
@@ -59,6 +59,12 @@ void build_grid(const double* src, int64_t n_src, const double* dst,
       mx[d] = std::max(mx[d], cell_coord(dst + 3 * i, d));
   for (int d = 0; d < 3; ++d) g.dims[d] = mx[d] + 1;
 
+  // cap grid memory at ~8 cells per source point (plus slack): beyond
+  // that the dense grid loses to the sparse fallback anyway
+  const double cells_f =
+      (double)g.dims[0] * (double)g.dims[1] * (double)g.dims[2];
+  if (cells_f > 8.0 * (double)n_src + 65536.0) return false;
+
   const int64_t n_cells = g.dims[0] * g.dims[1] * g.dims[2];
   g.bucket_start.assign(n_cells + 1, 0);
   std::vector<int64_t> cell_id(n_src);
@@ -73,6 +79,7 @@ void build_grid(const double* src, int64_t n_src, const double* dst,
   g.order.resize(n_src);
   std::vector<int64_t> cursor(g.bucket_start.begin(), g.bucket_start.end() - 1);
   for (int64_t i = 0; i < n_src; ++i) g.order[cursor[cell_id[i]]++] = i;
+  return true;
 }
 
 struct Hit {
@@ -84,14 +91,15 @@ struct Hit {
 
 extern "C" {
 
-// Returns the exact pair count; fills at most `capacity` entries of
-// (senders, receivers, dists).
+// Returns the exact pair count and fills at most `capacity` entries of
+// (senders, receivers, dists); returns -1 when the point distribution is
+// unsuited to a dense grid (caller should use its fallback path).
 int64_t rg_pairs(const double* src_pos, int64_t n_src, const double* dst_pos,
                  int64_t n_dst, double r, int64_t* senders, int64_t* receivers,
                  double* dists, int64_t capacity, int n_threads) {
   if (n_src == 0 || n_dst == 0) return 0;
   Grid g;
-  build_grid(src_pos, n_src, dst_pos, n_dst, r, g);
+  if (!build_grid(src_pos, n_src, dst_pos, n_dst, r, g)) return -1;
   const double r2 = r * r;
 
   int T = n_threads > 0 ? n_threads

@@ -63,3 +63,14 @@ def pytest_max_neighbors_cap(big_cloud):
     ei = rg.radius_graph(big_cloud, 2.5, max_num_neighbors=4)
     _, counts = np.unique(ei[1], return_counts=True)
     assert counts.max() <= 4
+
+
+def pytest_native_outlier_falls_back():
+    """A far outlier must not blow up the dense grid (returns None ->
+    numpy fallback handles it), and the public API must stay correct."""
+    rng = np.random.default_rng(2)
+    pos = rng.uniform(0, 12.0, (400, 3))
+    pos[0] = [2e5, 2e5, 2e5]
+    assert native_radius_pairs(pos, pos, 1.7) is None
+    ei = rg.radius_graph(pos, 1.7)  # falls back internally
+    assert ei.shape[0] == 2 and (ei[0] != 0).all()  # outlier has no edges
