@@ -40,7 +40,13 @@ def main() -> None:
     )
     tx = select_optimizer(config["NeuralNetwork"]["Training"])
     state = create_train_state(variables, tx)
-    step = make_train_step(model, tx)
+    # bf16 forward/backward (f32 master params); BENCH_BF16=0 opts out
+    compute_dtype = None
+    if os.environ.get("BENCH_BF16", "1") == "1":
+        import jax.numpy as jnp
+
+        compute_dtype = jnp.bfloat16
+    step = make_train_step(model, tx, compute_dtype=compute_dtype)
 
     batches = list(loader)
     if not batches:

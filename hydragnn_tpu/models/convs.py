@@ -201,20 +201,22 @@ class PNAConv(nn.Module):
         msum, msumsq, cnt = segment_sum_family(
             msg, ctx.receivers, n, mask=ctx.edge_mask
         )
+        # mean/var formed in f32 (the family op accumulates f32); cast
+        # back to the compute dtype only after the cancellation
         safe_cnt = jnp.maximum(cnt, 1.0)[:, None]
         mean = msum / safe_cnt
         # PyG 'std': sqrt(relu(mean(x^2) - mean(x)^2) + eps)
         var = jax.nn.relu(msumsq / safe_cnt - mean * mean)
         std = jnp.sqrt(var + 1e-5)
         aggs = [
-            mean,
+            mean.astype(msg.dtype),
             S.segment_min(msg, ctx.receivers, n, mask=ctx.edge_mask),
             S.segment_max(msg, ctx.receivers, n, mask=ctx.edge_mask),
-            std,
+            std.astype(msg.dtype),
         ]
         agg = jnp.concatenate(aggs, axis=-1)  # [N, 4*fin]
 
-        deg = jnp.maximum(cnt, 1.0)
+        deg = jnp.maximum(cnt, 1.0).astype(msg.dtype)
         log_deg = jnp.log(deg + 1.0)[:, None]
         amplification = log_deg / self.avg_deg_log
         attenuation = self.avg_deg_log / log_deg

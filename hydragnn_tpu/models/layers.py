@@ -45,6 +45,10 @@ class MaskedBatchNorm(nn.Module):
         scale = self.param("scale", nn.initializers.ones, (self.features,))
         bias = self.param("bias", nn.initializers.zeros, (self.features,))
 
+        # statistics always in f32: batch-wide sums in bf16 (mixed
+        # precision) lose enough mantissa to corrupt the running stats
+        in_dtype = x.dtype
+        x = x.astype(jnp.float32)
         if train:
             if mask is None:
                 count = jnp.asarray(x.shape[0], jnp.float32)
@@ -72,7 +76,7 @@ class MaskedBatchNorm(nn.Module):
             mean, var = ra_mean.value, ra_var.value
 
         y = (x - mean) * jax.lax.rsqrt(var + self.eps) * scale + bias
-        return y
+        return y.astype(in_dtype)
 
 
 class MLP(nn.Module):

@@ -59,9 +59,12 @@ def segment_sum_family_xla(
     are not guaranteed sorted here — a false ``indices_are_sorted`` is
     undefined behavior. Measured cost of the unsorted scatter on v5e is
     within noise of the sorted one."""
-    ones = jnp.ones((data.shape[0], 1), dtype=data.dtype)
+    # accumulate in f32 even under bf16 mixed precision: sum/sumsq feed a
+    # variance cancellation (mean(x^2) - mean(x)^2) that bf16 cannot carry
+    data = data.astype(jnp.float32)
+    ones = jnp.ones((data.shape[0], 1), dtype=jnp.float32)
     if mask is not None:
-        m = mask[:, None].astype(data.dtype)
+        m = mask[:, None].astype(jnp.float32)
         data = data * m
         ones = ones * m
     packed = jnp.concatenate([data, data * data, ones], axis=-1)
@@ -121,16 +124,29 @@ def _family_kernel(block_ptr_ref, msg_hbm, recv_hbm,
     jax.lax.fori_loop(k0, k1, chunk_body, 0)
 
 
-@functools.partial(jax.jit, static_argnames=("num_segments", "interpret"))
+@functools.partial(
+    jax.jit, static_argnames=("num_segments", "interpret", "indices_are_sorted")
+)
 def segment_sum_family_pallas(
     data: jnp.ndarray,
     segment_ids: jnp.ndarray,
     num_segments: int,
     mask: Optional[jnp.ndarray] = None,
     interpret: bool = False,
+    indices_are_sorted: bool = False,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
+
+    if not indices_are_sorted:
+        # the kernel's CSR block pointers require sorted receivers;
+        # SMILES-featurized graphs order edges sender-major, so sort
+        # unless the caller guarantees otherwise
+        order = jnp.argsort(segment_ids)
+        segment_ids = segment_ids[order]
+        data = data[order]
+        if mask is not None:
+            mask = mask[order]
 
     e, h = data.shape
     n_pad = ((num_segments + BN - 1) // BN) * BN

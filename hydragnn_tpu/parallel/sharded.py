@@ -77,11 +77,15 @@ def make_sharded_train_step(
     tx: optax.GradientTransformation,
     mesh: Mesh,
     zero1: bool = False,
+    compute_dtype=None,
 ) -> Callable[[TrainState, GraphBatch], Tuple[TrainState, jnp.ndarray, jnp.ndarray]]:
     """Jitted ``(state, batch[D-leading]) -> (state, loss, tasks)``.
 
     ``batch`` leaves carry a leading device axis of size mesh['data']
-    (GraphLoader(device_stack=D) output)."""
+    (GraphLoader(device_stack=D) output). ``compute_dtype=jnp.bfloat16``
+    enables mixed precision exactly like the single-device step: bf16
+    forward/backward, f32 master params / grads / BN stats / loss."""
+    from hydragnn_tpu.train.state import _cast_floats
 
     def per_device_grads(params, batch_stats, dropout_rng, batch: GraphBatch):
         # Each device sees its own sub-batch (leading axis stripped by
@@ -90,13 +94,20 @@ def make_sharded_train_step(
         dropout_rng = jax.random.fold_in(dropout_rng, jax.lax.axis_index(DATA_AXIS))
 
         def loss_fn(p):
+            if compute_dtype is not None:
+                ap = _cast_floats(p, compute_dtype)
+                ab = _cast_floats(batch, compute_dtype)
+            else:
+                ap, ab = p, batch
             outputs, mutated = model.apply(
-                {"params": p, "batch_stats": batch_stats},
-                batch,
+                {"params": ap, "batch_stats": batch_stats},
+                ab,
                 train=True,
                 mutable=["batch_stats"],
                 rngs={"dropout": dropout_rng},
             )
+            # loss in f32 against the original (uncast) targets
+            outputs = [o.astype(jnp.float32) for o in outputs]
             total, tasks = model_loss(model.cfg, outputs, batch)
             return total, (jnp.stack(tasks), mutated)
 

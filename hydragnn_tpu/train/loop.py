@@ -256,7 +256,13 @@ def train_validate_test(
     scheduler = ReduceLROnPlateau()
 
     cfg = model.cfg
-    train_step = train_step or make_train_step(model, tx)
+    # Training.mixed_precision: bf16 forward/backward with f32 master
+    # params/optimizer/BN stats (MXU-native; absent from the reference,
+    # which has no AMP path — SURVEY §2.2 "explicitly absent")
+    compute_dtype = (
+        jnp.bfloat16 if training.get("mixed_precision") else None
+    )
+    train_step = train_step or make_train_step(model, tx, compute_dtype=compute_dtype)
     eval_step = eval_step or make_eval_step(model)
     eval_step_out = eval_step_out or make_eval_step(model, with_outputs=True)
 

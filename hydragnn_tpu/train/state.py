@@ -47,22 +47,46 @@ def create_train_state(
     )
 
 
+def _cast_floats(tree: Any, dtype) -> Any:
+    """Cast float32 leaves to ``dtype`` (ints/bools untouched)."""
+    return jax.tree_util.tree_map(
+        lambda x: x.astype(dtype)
+        if hasattr(x, "dtype") and x.dtype == jnp.float32
+        else x,
+        tree,
+    )
+
+
 def make_train_step(
-    model: HydraModel, tx: optax.GradientTransformation
+    model: HydraModel,
+    tx: optax.GradientTransformation,
+    compute_dtype=None,
 ) -> Callable[[TrainState, GraphBatch], Tuple[TrainState, jnp.ndarray, jnp.ndarray]]:
-    """Returns jitted ``(state, batch) -> (state, loss, tasks_loss)``."""
+    """Returns jitted ``(state, batch) -> (state, loss, tasks_loss)``.
+
+    ``compute_dtype=jnp.bfloat16`` enables mixed precision: params and
+    batch features are cast to bf16 for the forward/backward (MXU-native
+    on TPU), while the master params, optimizer state, BatchNorm
+    statistics, and the loss stay float32."""
 
     def step(state: TrainState, batch: GraphBatch):
         rng, dropout_rng = jax.random.split(state.rng)
 
         def loss_fn(params):
+            if compute_dtype is not None:
+                apply_params = _cast_floats(params, compute_dtype)
+                apply_batch = _cast_floats(batch, compute_dtype)
+            else:
+                apply_params, apply_batch = params, batch
             outputs, mutated = model.apply(
-                {"params": params, "batch_stats": state.batch_stats},
-                batch,
+                {"params": apply_params, "batch_stats": state.batch_stats},
+                apply_batch,
                 train=True,
                 mutable=["batch_stats"],
                 rngs={"dropout": dropout_rng},
             )
+            # loss in f32 against the ORIGINAL (uncast) targets
+            outputs = [o.astype(jnp.float32) for o in outputs]
             total, tasks = model_loss(model.cfg, outputs, batch)
             return total, (jnp.stack(tasks), mutated)
 

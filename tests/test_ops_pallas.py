@@ -78,3 +78,36 @@ def pytest_xla_family_unsorted_ids():
     rs, rsq, rc = _reference(data, recv, n, np.ones(e, bool))
     np.testing.assert_allclose(s, rs, rtol=1e-4, atol=1e-4)
     np.testing.assert_allclose(c, rc, rtol=1e-6)
+
+
+def pytest_pallas_family_unsorted_ids_sorts():
+    """Default indices_are_sorted=False must be correct for sender-major
+    orderings (the kernel sorts internally)."""
+    rng = np.random.default_rng(13)
+    e, h, n = 600, 8, 70
+    recv = rng.integers(0, n, e).astype(np.int32)  # deliberately unsorted
+    data = rng.normal(size=(e, h)).astype(np.float32)
+    s, sq, c = segment_sum_family_pallas(
+        jnp.asarray(data), jnp.asarray(recv), n, None, interpret=True
+    )
+    rs, rsq, rc = _reference(data, recv, n, np.ones(e, bool))
+    np.testing.assert_allclose(s, rs, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(c, rc, rtol=1e-6)
+
+
+def pytest_family_accumulates_f32_under_bf16():
+    """bf16 inputs: mean/var cancellation must not collapse (f32 accum)."""
+    rng = np.random.default_rng(21)
+    e, n = 512, 4
+    recv = np.sort(rng.integers(0, n, e)).astype(np.int32)
+    # mean 8, spread 0.2: representable in bf16 (ulp ~0.03) but a ~128-term
+    # bf16 running sum (~1000, ulp ~4) would drown the contributions;
+    # f32 accumulation must preserve the variance's order of magnitude
+    data = (8.0 + 0.2 * rng.normal(size=(e, 8))).astype(np.float32)
+    s, sq, c = segment_sum_family_xla(
+        jnp.asarray(data, dtype=jnp.bfloat16), jnp.asarray(recv), n
+    )
+    mean = np.asarray(s) / np.asarray(c)[:, None]
+    var = np.asarray(sq) / np.asarray(c)[:, None] - mean**2
+    assert np.all(var > 5e-3), var.min()
+    assert np.all(var < 1e-1), var.max()

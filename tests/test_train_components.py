@@ -155,3 +155,38 @@ def pytest_checkpoint_roundtrip(small_problem, tmp_path):
     l1, _ = ev(state, batch)
     l2, _ = ev(restored, batch)
     assert float(l1) == pytest.approx(float(l2), rel=1e-6)
+
+
+def pytest_mixed_precision_step_trains():
+    """bf16 compute path: finite loss that decreases, f32 master state
+    and BatchNorm statistics preserved."""
+    import jax
+    import jax.numpy as jnp
+    from hydragnn_tpu.flagship import build_flagship
+    from hydragnn_tpu.train import (
+        create_train_state,
+        make_train_step,
+        select_optimizer,
+    )
+
+    config, model, variables, loader = build_flagship(
+        n_samples=48, hidden_dim=16, num_conv_layers=2, batch_size=8
+    )
+    tx = select_optimizer(config["NeuralNetwork"]["Training"])
+    state = create_train_state(variables, tx)
+    step = make_train_step(model, tx, compute_dtype=jnp.bfloat16)
+    batches = list(loader)
+    first = None
+    for epoch in range(6):
+        for b in batches:
+            state, loss, _ = step(state, b)
+            if first is None:
+                first = float(loss)
+    last = float(loss)
+    assert np.isfinite(last)
+    assert last < first
+    # master params and BN stats stay f32
+    for leaf in jax.tree_util.tree_leaves(state.params):
+        assert leaf.dtype == jnp.float32
+    for leaf in jax.tree_util.tree_leaves(state.batch_stats):
+        assert leaf.dtype == jnp.float32
