@@ -58,12 +58,27 @@ def prepare_loaders_and_config(
     device axis for the sharded (data-parallel) step functions."""
     if samples is None:
         path = config["Dataset"]["path"]
-        if "total" not in path:
-            raise NotImplementedError(
-                "per-split raw paths not supported yet; provide Dataset.path.total"
+        if "total" in path:
+            samples = load_raw_samples(config, path["total"])
+            train, val, test, mm_g, mm_n = prepare_dataset(samples, config)
+        else:
+            # per-split raw paths (reference: Dataset.path train/validate/
+            # test layout, load_data.py:352-393); split membership is
+            # pre-defined, normalization spans all splits
+            from hydragnn_tpu.data.ingest import prepare_presplit_dataset
+
+            splits = {}
+            for key in ("train", "validate", "test"):
+                if key not in path:
+                    raise ValueError(
+                        f"Dataset.path needs 'total' or 'train'/'validate'/'test'; missing {key!r}"
+                    )
+                splits[key] = load_raw_samples(config, path[key])
+            train, val, test, mm_g, mm_n = prepare_presplit_dataset(
+                splits["train"], splits["validate"], splits["test"], config
             )
-        samples = load_raw_samples(config, path["total"])
-    train, val, test, mm_g, mm_n = prepare_dataset(samples, config)
+    else:
+        train, val, test, mm_g, mm_n = prepare_dataset(samples, config)
 
     voi = config["NeuralNetwork"]["Variables_of_interest"]
     voi["minmax_graph_feature"] = mm_g.tolist()
@@ -177,6 +192,11 @@ def train_with_loaders(
         model, variables = create_model_config(nn_config, example_one)
         state = create_train_state(variables, tx)
         state = load_existing_model_config(state, training, log_dir)
+
+    if jax.process_index() == 0:
+        from hydragnn_tpu.utils.print_utils import print_model
+
+        print_model(state.params, verbosity)
 
     viz = config.get("Visualization", {})
     state, history = train_validate_test(

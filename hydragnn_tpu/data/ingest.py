@@ -111,16 +111,11 @@ def _append_spherical(samples: Sequence[GraphSample]) -> None:
         )
 
 
-def prepare_dataset(
-    samples: List[GraphSample],
-    config: Dict,
-) -> Tuple[List[GraphSample], List[GraphSample], List[GraphSample], np.ndarray, np.ndarray]:
-    """Full preparation pipeline on an in-memory sample list.
-
-    ``config`` is the reference-shaped top-level dict (Dataset /
-    NeuralNetwork sections). Returns (train, val, test, minmax_graph,
-    minmax_node).
-    """
+def _prepare_samples(
+    samples: List[GraphSample], config: Dict
+) -> Tuple[np.ndarray, np.ndarray]:
+    """The shared preparation body (steps 2-8 of the module docstring),
+    in place over ``samples``; returns (minmax_graph, minmax_node)."""
     ds_cfg = config["Dataset"]
     nn_cfg = config["NeuralNetwork"]
     arch = nn_cfg["Architecture"]
@@ -149,14 +144,48 @@ def prepare_dataset(
         nf["dim"],
     )
     select_input_features(samples, voi["input_node_features"], nf["dim"])
+    return mm_g, mm_n
 
-    perc_train = nn_cfg["Training"]["perc_train"]
+
+def prepare_dataset(
+    samples: List[GraphSample],
+    config: Dict,
+) -> Tuple[List[GraphSample], List[GraphSample], List[GraphSample], np.ndarray, np.ndarray]:
+    """Full preparation pipeline on an in-memory sample list.
+
+    ``config`` is the reference-shaped top-level dict (Dataset /
+    NeuralNetwork sections). Returns (train, val, test, minmax_graph,
+    minmax_node).
+    """
+    mm_g, mm_n = _prepare_samples(samples, config)
     train, val, test = split_dataset(
         samples,
-        perc_train,
-        stratify_splitting=ds_cfg.get("compositional_stratified_splitting", False),
+        config["NeuralNetwork"]["Training"]["perc_train"],
+        stratify_splitting=config["Dataset"].get(
+            "compositional_stratified_splitting", False
+        ),
     )
     return train, val, test, mm_g, mm_n
+
+
+def prepare_presplit_dataset(
+    train: List[GraphSample],
+    val: List[GraphSample],
+    test: List[GraphSample],
+    config: Dict,
+) -> Tuple[List[GraphSample], List[GraphSample], List[GraphSample], np.ndarray, np.ndarray]:
+    """Preparation for pre-defined splits (the reference's per-split
+    ``Dataset.path.{train,validate,test}`` layout,
+    hydragnn/preprocess/load_data.py:352-393): the same pipeline as
+    ``prepare_dataset`` with normalization statistics and edge-length
+    normalization computed over ALL splits together (the reference's
+    global min-max / max-edge reductions span the full dataset), but the
+    split membership preserved."""
+    counts = (len(train), len(val), len(test))
+    merged = list(train) + list(val) + list(test)
+    mm_g, mm_n = _prepare_samples(merged, config)
+    a, b = counts[0], counts[0] + counts[1]
+    return merged[:a], merged[a:b], merged[b:], mm_g, mm_n
 
 
 def load_raw_samples(config: Dict, path: str) -> List[GraphSample]:

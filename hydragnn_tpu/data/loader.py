@@ -18,6 +18,7 @@ concerns drive the design:
 from __future__ import annotations
 
 import math
+import os
 from typing import Iterator, List, Optional, Sequence
 
 import jax
@@ -87,6 +88,7 @@ class GraphLoader:
         edge_multiple: int = 8,
         drop_last: bool = False,
         cache_device_batches: bool = False,
+        prefetch: int = 2,
     ):
         if device_stack > 1 and batch_size % device_stack != 0:
             raise ValueError(
@@ -110,6 +112,7 @@ class GraphLoader:
         self.device_stack = device_stack
         self.drop_last = drop_last
         self.cache_device_batches = cache_device_batches
+        self.prefetch = int(os.environ.get("HYDRAGNN_NUM_PREFETCH", prefetch))
         self._cached_batches: Optional[List[GraphBatch]] = None
         self._sharding = None
         self._epoch = 0
@@ -196,8 +199,54 @@ class GraphLoader:
                 yield self._cached_batches[b]
             return
         order = self._order()
-        for b in range(nb):
-            yield self._make_batch(order[b * bs : (b + 1) * bs])
+        if self.prefetch <= 0:
+            for b in range(nb):
+                yield self._make_batch(order[b * bs : (b + 1) * bs])
+            return
+        # Background producer thread: batch assembly + H2D transfer
+        # overlap with device compute (the reference's HydraDataLoader
+        # thread-pool fetcher, hydragnn/preprocess/load_data.py:94-204 —
+        # affinity pinning is unnecessary here, XLA owns the host).
+        import queue
+        import threading
+
+        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
+        stop = threading.Event()
+        sentinel = object()
+
+        def put_stop_aware(item) -> bool:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.2)
+                    return True
+                except queue.Full:
+                    continue
+            return False  # consumer abandoned the generator
+
+        def producer():
+            try:
+                for b in range(nb):
+                    batch = self._make_batch(order[b * bs : (b + 1) * bs])
+                    if self._sharding is not None:
+                        batch = jax.device_put(batch, self._sharding)
+                    if not put_stop_aware(batch):
+                        return
+                put_stop_aware(sentinel)
+            except BaseException as exc:  # surfaced to the consumer
+                put_stop_aware(exc)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is sentinel:
+                    break
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            stop.set()
 
     def num_graphs_total(self) -> int:
         return len(self.samples)

@@ -280,6 +280,10 @@ def train_validate_test(
         out_dir = os.path.join(log_dir, log_name)
         os.makedirs(out_dir, exist_ok=True)
         metrics_path = os.path.join(out_dir, "metrics.jsonl")
+    # rank-0 tensorboard scalars (reference: train_validate_test.py:130-137)
+    from hydragnn_tpu.utils.tensorboard import get_summary_writer
+
+    writer = get_summary_writer(log_name, log_dir)
 
     # Visualization (reference: Visualizer wiring, train_validate_test.py:
     # 71-97,90-96: initial-solution scatter, per-epoch histograms, final
@@ -342,6 +346,13 @@ def train_validate_test(
             f"Epoch: {epoch:02d}, Train Loss: {train_loss:.8f}, "
             f"Val Loss: {val_loss:.8f}, Test Loss: {test_loss:.8f}",
         )
+        writer.add_scalar("train error", train_loss, epoch)
+        writer.add_scalar("validate error", val_loss, epoch)
+        writer.add_scalar("test error", test_loss, epoch)
+        for ivar in range(len(train_tasks)):
+            writer.add_scalar(
+                f"train error of task{ivar}", float(train_tasks[ivar]), epoch
+            )
         if metrics_path is not None:
             with open(metrics_path, "a") as f:
                 f.write(
@@ -363,6 +374,8 @@ def train_validate_test(
             print_distributed(verbosity, f"Early stopping at epoch {epoch}")
             break
     timer.stop()
+    writer.flush()
+    writer.close()
 
     # Final plots (reference: train_validate_test.py:173-215 rank-0 plots).
     if visualizer is not None:

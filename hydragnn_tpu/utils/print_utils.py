@@ -73,3 +73,20 @@ def log(*args) -> None:
         _logger.info(msg)
     elif _process_index() == 0:
         print(msg)
+
+
+def print_model(params, verbosity_level: int = 2) -> int:
+    """Per-parameter shape/size table + total (reference:
+    hydragnn/utils/model.py:112-120 print_model). ``params`` is a model
+    params pytree (e.g. ``state.params``). Returns total param count."""
+    import jax
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+    total = 0
+    for path, leaf in flat:
+        name = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+        size = int(getattr(leaf, "size", 0))
+        total += size
+        print_distributed(verbosity_level, f"{name}: {tuple(leaf.shape)} {size}")
+    print_distributed(verbosity_level, f"Total number of parameters: {total}")
+    return total

@@ -1,0 +1,42 @@
+"""Tensorboard scalar writer (rank-0), with a jsonl fallback.
+
+The reference creates a rank-0 SummaryWriter and logs total/per-task
+losses each epoch (reference: hydragnn/utils/model.py:57-61 and
+train_validate_test.py:130-137 — upstream has a bug where the writer is
+never returned, so scalars are silently skipped; here it works).
+When the tensorboard package is unavailable the writer degrades to a
+no-op (the epoch metrics are independently persisted to metrics.jsonl
+by the train loop).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+class _NullWriter:
+    def add_scalar(self, tag: str, value, step: int) -> None:
+        pass
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+def get_summary_writer(log_name: str, log_dir: str = "./logs/"):
+    """Rank-0 SummaryWriter under ``<log_dir>/<log_name>``; null writer on
+    other ranks or when tensorboard is not importable."""
+    import jax
+
+    if jax.process_index() != 0:
+        return _NullWriter()
+    try:
+        from torch.utils.tensorboard import SummaryWriter
+    except ImportError:
+        return _NullWriter()
+    path = os.path.join(log_dir, log_name)
+    os.makedirs(path, exist_ok=True)
+    return SummaryWriter(log_dir=path)

@@ -190,3 +190,74 @@ def pytest_mixed_precision_step_trains():
         assert leaf.dtype == jnp.float32
     for leaf in jax.tree_util.tree_leaves(state.batch_stats):
         assert leaf.dtype == jnp.float32
+
+
+def pytest_per_split_raw_paths(tmp_path):
+    """Dataset.path.{train,validate,test} layout: pre-defined split
+    membership, normalization spanning all splits (reference:
+    load_data.py:352-393)."""
+    from hydragnn_tpu.api import prepare_loaders_and_config
+    from hydragnn_tpu.data.synthetic import write_lsms_files
+
+    counts = {"train": 30, "validate": 10, "test": 10}
+    paths = {}
+    start = 0
+    for split_idx, (key, n) in enumerate(counts.items()):
+        d = tmp_path / key
+        write_lsms_files(str(d), number_configurations=n,
+                         configuration_start=start, seed=split_idx)
+        paths[key] = str(d)
+        start += n
+
+    config = {
+        "Verbosity": {"level": 0},
+        "Dataset": {
+            "name": "unit_test",
+            "format": "unit_test",
+            "path": paths,
+            "compositional_stratified_splitting": False,
+            "rotational_invariance": False,
+            "node_features": {
+                "name": ["x", "x2", "x3"],
+                "dim": [1, 1, 1],
+                "column_index": [0, 6, 7],
+            },
+            "graph_features": {
+                "name": ["sum_x_x2_x3"], "dim": [1], "column_index": [0],
+            },
+        },
+        "NeuralNetwork": {
+            "Architecture": {
+                "model_type": "GIN",
+                "radius": 2.0,
+                "max_neighbours": 100,
+                "hidden_dim": 8,
+                "num_conv_layers": 2,
+                "output_heads": {
+                    "graph": {
+                        "num_sharedlayers": 1, "dim_sharedlayers": 5,
+                        "num_headlayers": 1, "dim_headlayers": [10],
+                    }
+                },
+                "task_weights": [1.0],
+            },
+            "Variables_of_interest": {
+                "input_node_features": [0],
+                "output_names": ["sum_x_x2_x3"],
+                "output_index": [0],
+                "type": ["graph"],
+            },
+            "Training": {
+                "num_epoch": 1,
+                "perc_train": 0.7,
+                "loss_function_type": "mse",
+                "batch_size": 8,
+                "Optimizer": {"type": "AdamW", "learning_rate": 0.01},
+            },
+        },
+        "Visualization": {"create_plots": False},
+    }
+    train_loader, val_loader, test_loader, config = prepare_loaders_and_config(config)
+    assert train_loader.num_samples == counts["train"]
+    assert val_loader.num_samples == counts["validate"]
+    assert test_loader.num_samples == counts["test"]
