@@ -161,13 +161,14 @@ def train_with_loaders(
     freeze = bool(nn_config["Architecture"].get("freeze_conv_layers"))
     tx = select_optimizer(training, freeze_conv=freeze)
 
-    train_step = eval_step = eval_step_out = None
+    train_step = eval_step = eval_step_out = stats_step = None
     if sharded:
         from hydragnn_tpu.parallel import (
             DATA_AXIS,
             batch_sharding,
             make_mesh,
             make_sharded_eval_step,
+            make_sharded_stats_step,
             make_sharded_train_step,
             place_state,
         )
@@ -188,6 +189,7 @@ def train_with_loaders(
         )
         eval_step = make_sharded_eval_step(model, mesh)
         eval_step_out = make_sharded_eval_step(model, mesh, with_outputs=True)
+        stats_step = make_sharded_stats_step(model, mesh)
     else:
         model, variables = create_model_config(nn_config, example_one)
         state = create_train_state(variables, tx)
@@ -216,6 +218,7 @@ def train_with_loaders(
         train_step=train_step,
         eval_step=eval_step,
         eval_step_out=eval_step_out,
+        stats_step=stats_step,
     )
 
     save_model(state, log_name, log_dir, verbosity)

@@ -88,7 +88,7 @@ class GraphLoader:
         edge_multiple: int = 8,
         drop_last: bool = False,
         cache_device_batches: bool = False,
-        prefetch: int = 2,
+        prefetch: Optional[int] = None,
     ):
         if device_stack > 1 and batch_size % device_stack != 0:
             raise ValueError(
@@ -112,7 +112,16 @@ class GraphLoader:
         self.device_stack = device_stack
         self.drop_last = drop_last
         self.cache_device_batches = cache_device_batches
-        self.prefetch = int(os.environ.get("HYDRAGNN_NUM_PREFETCH", prefetch))
+        # an explicit argument wins; HYDRAGNN_NUM_PREFETCH sets the default
+        if prefetch is None:
+            raw = os.environ.get("HYDRAGNN_NUM_PREFETCH", "2")
+            try:
+                prefetch = int(raw)
+            except ValueError:
+                raise ValueError(
+                    f"HYDRAGNN_NUM_PREFETCH must be an integer, got {raw!r}"
+                ) from None
+        self.prefetch = prefetch
         self._cached_batches: Optional[List[GraphBatch]] = None
         self._sharding = None
         self._epoch = 0

@@ -203,8 +203,18 @@ class HydraModel(nn.Module):
         return conv(x, ctx)
 
     @nn.compact
-    def __call__(self, batch: GraphBatch, train: bool = False) -> List[jnp.ndarray]:
+    def __call__(
+        self,
+        batch: GraphBatch,
+        train: bool = False,
+        bn_train: Optional[bool] = None,
+    ) -> List[jnp.ndarray]:
+        """``train`` drives dropout; ``bn_train`` (default = ``train``)
+        drives BatchNorm batch-vs-running statistics separately, so
+        BatchNorm recalibration can run batch-stats forward passes with
+        dropout off (hydragnn_tpu/train/state.py:make_stats_step)."""
         cfg = self.cfg
+        bn = train if bn_train is None else bn_train
         ctx = self._conv_args(batch)
         x = batch.nodes
         n = x.shape[0]
@@ -225,7 +235,7 @@ class HydraModel(nn.Module):
             # Base.py:117-121 freezes self.convs only, not batch norms).
             conv = self._make_conv(width, concat=concat, name=f"conv_{layer}")
             x = self._apply_conv(conv, x, ctx, train)
-            x = MaskedBatchNorm(bn_width, axis_name=cfg.bn_axis_name)(x, mask=batch.node_mask, train=train)
+            x = MaskedBatchNorm(bn_width, axis_name=cfg.bn_axis_name)(x, mask=batch.node_mask, train=bn)
             x = nn.relu(x)
 
         # ---- masked global mean pool (reference: Base.py:256-258) ----
@@ -247,10 +257,11 @@ class HydraModel(nn.Module):
                 )
                 outputs.append(MLP(dims, name=f"graph_head_{ihead}")(graph_shared))
             else:
-                outputs.append(self._node_head(ihead, x, batch, ctx, train))
+                outputs.append(self._node_head(ihead, x, batch, ctx, train, bn))
         return outputs
 
-    def _node_head(self, ihead, x, batch: GraphBatch, ctx, train: bool):
+    def _node_head(self, ihead, x, batch: GraphBatch, ctx, train: bool, bn: Optional[bool] = None):
+        bn = train if bn is None else bn
         cfg = self.cfg
         nht = cfg.node_head_type
         dims_hidden = tuple(cfg.node_dim_headlayers[: cfg.node_num_headlayers])
@@ -273,11 +284,11 @@ class HydraModel(nn.Module):
                 conv = self._make_conv(dim, concat=True)
                 bn_width = dim * cfg.gat_heads if is_gat else dim
                 h = self._apply_conv(conv, h, ctx, train)
-                h = MaskedBatchNorm(bn_width, axis_name=cfg.bn_axis_name)(h, mask=batch.node_mask, train=train)
+                h = MaskedBatchNorm(bn_width, axis_name=cfg.bn_axis_name)(h, mask=batch.node_mask, train=bn)
                 h = nn.relu(h)
             conv = self._make_conv(out_dim, concat=False)
             h = self._apply_conv(conv, h, ctx, train)
-            h = MaskedBatchNorm(out_dim, axis_name=cfg.bn_axis_name)(h, mask=batch.node_mask, train=train)
+            h = MaskedBatchNorm(out_dim, axis_name=cfg.bn_axis_name)(h, mask=batch.node_mask, train=bn)
             return h
         raise ValueError(
             f"Unknown head NN structure for node features {nht}; currently only "

@@ -11,6 +11,7 @@ from hydragnn_tpu.parallel.mesh import (
 )
 from hydragnn_tpu.parallel.sharded import (
     make_sharded_eval_step,
+    make_sharded_stats_step,
     make_sharded_train_step,
     place_state,
 )

@@ -153,6 +153,40 @@ def make_sharded_train_step(
     return jax.jit(step, donate_argnums=(0,))
 
 
+def make_sharded_stats_step(
+    model: HydraModel, mesh: Mesh
+) -> Callable[[TrainState, GraphBatch], TrainState]:
+    """Sharded BatchNorm recalibration (see train.state.make_stats_step):
+    train-mode forward over the device mesh updating only the running
+    statistics (psum-synchronized by the BN layer's axis_name)."""
+
+    def per_device(params, batch_stats, batch: GraphBatch):
+        batch = jax.tree_util.tree_map(lambda x: x[0], batch)
+        # dropout off, BN in batch-stats mode (see make_stats_step)
+        _, mutated = model.apply(
+            {"params": params, "batch_stats": batch_stats},
+            batch,
+            train=False,
+            bn_train=True,
+            mutable=["batch_stats"],
+        )
+        return jax.lax.pmean(mutated["batch_stats"], DATA_AXIS)
+
+    fn = shard_map(
+        per_device,
+        mesh=mesh,
+        in_specs=(P(), P(), P(DATA_AXIS)),
+        out_specs=P(),
+        check_vma=False,
+    )
+
+    def step(state: TrainState, batch: GraphBatch):
+        new_stats = fn(state.params, state.batch_stats, batch)
+        return state.replace(batch_stats=new_stats)
+
+    return jax.jit(step)
+
+
 def make_sharded_eval_step(
     model: HydraModel, mesh: Mesh, with_outputs: bool = False
 ) -> Callable[..., Any]:

@@ -8,6 +8,7 @@ from hydragnn_tpu.train.state import (
     create_train_state,
     make_train_step,
     make_eval_step,
+    make_stats_step,
 )
 from hydragnn_tpu.train.loop import (
     EarlyStopping,

@@ -35,7 +35,12 @@ import numpy as np
 from hydragnn_tpu.graph.batch import GraphBatch
 from hydragnn_tpu.models.base import HydraModel, ModelConfig
 from hydragnn_tpu.train.optimizer import current_learning_rate, set_learning_rate
-from hydragnn_tpu.train.state import TrainState, make_eval_step, make_train_step
+from hydragnn_tpu.train.state import (
+    TrainState,
+    make_eval_step,
+    make_stats_step,
+    make_train_step,
+)
 from hydragnn_tpu.utils.print_utils import print_distributed, iterate_tqdm
 from hydragnn_tpu.utils.time_utils import Timer
 
@@ -242,6 +247,7 @@ def train_validate_test(
     train_step=None,
     eval_step=None,
     eval_step_out=None,
+    stats_step=None,
 ) -> Tuple[TrainState, Dict[str, Any]]:
     """Train for ``Training.num_epoch`` epochs with validation-driven LR
     plateau + early stopping; returns (final_state, history dict). ``config``
@@ -265,6 +271,8 @@ def train_validate_test(
     train_step = train_step or make_train_step(model, tx, compute_dtype=compute_dtype)
     eval_step = eval_step or make_eval_step(model)
     eval_step_out = eval_step_out or make_eval_step(model, with_outputs=True)
+    if stats_step is None and training.get("bn_recalibration", True):
+        stats_step = make_stats_step(model)
 
     history: Dict[str, List] = {
         "train_loss": [],
@@ -374,6 +382,15 @@ def train_validate_test(
             print_distributed(verbosity, f"Early stopping at epoch {epoch}")
             break
     timer.stop()
+
+    # BatchNorm recalibration: the in-training running-stat EMA trails
+    # the last few (noisy, small) batches; with frozen final parameters,
+    # two passes over the train set re-estimate faithful eval statistics.
+    if stats_step is not None and training.get("bn_recalibration", True):
+        for _ in range(2):
+            for b in train_loader:
+                state = stats_step(state, b)
+
     writer.flush()
     writer.close()
 

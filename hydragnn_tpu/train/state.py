@@ -107,6 +107,33 @@ def make_train_step(
     return jax.jit(step, donate_argnums=(0,))
 
 
+def make_stats_step(model: HydraModel) -> Callable[[TrainState, GraphBatch], TrainState]:
+    """Jitted BatchNorm-recalibration step: a train-mode forward that
+    updates ONLY the running statistics (params untouched, no grads).
+
+    Used after training to re-estimate the running stats at the final
+    parameters: the in-training EMA trails the last few noisy batches
+    (and BN's train-mode batch-feedback can leave it far from the
+    stationary statistics — observed as train-mode metrics converging
+    while eval-mode metrics diverge), so a few frozen-parameter passes
+    make eval faithful."""
+
+    def step(state: TrainState, batch: GraphBatch):
+        # dropout OFF (train=False), BatchNorm in batch-stats mode
+        # (bn_train=True): eval statistics must be estimated under the
+        # same deterministic forward eval itself uses
+        _, mutated = model.apply(
+            {"params": state.params, "batch_stats": state.batch_stats},
+            batch,
+            train=False,
+            bn_train=True,
+            mutable=["batch_stats"],
+        )
+        return state.replace(batch_stats=mutated["batch_stats"])
+
+    return jax.jit(step)
+
+
 def make_eval_step(
     model: HydraModel, with_outputs: bool = False
 ) -> Callable[..., Any]:

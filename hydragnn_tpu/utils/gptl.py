@@ -65,9 +65,10 @@ def nvtx_range(name: str):
     try:
         import jax
 
-        with jax.profiler.TraceAnnotation(name):
-            yield
+        annotation = jax.profiler.TraceAnnotation(name)
     except ImportError:  # pragma: no cover
+        annotation = contextlib.nullcontext()
+    with annotation:
         yield
 
 

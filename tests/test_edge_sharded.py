@@ -1,0 +1,94 @@
+"""Edge-sharded giant-graph aggregation on the 8-device CPU mesh:
+partitioned results must match the single-device reference exactly."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hydragnn_tpu.parallel import make_mesh
+from hydragnn_tpu.parallel.edge_sharded import (
+    edge_sharded_aggregate,
+    edge_sharded_gin_layer,
+    place_edge_shards,
+    shard_edges,
+)
+
+D = 8
+
+
+@pytest.fixture
+def giant_graph():
+    rng = np.random.default_rng(0)
+    n, e, h = 300, 5000, 16
+    nodes = rng.normal(size=(n, h)).astype(np.float32)
+    senders = rng.integers(0, n, e).astype(np.int32)
+    receivers = rng.integers(0, n, e).astype(np.int32)
+    return nodes, senders, receivers
+
+
+def pytest_edge_sharded_sum_matches_reference(giant_graph):
+    nodes, senders, receivers = giant_graph
+    n = nodes.shape[0]
+    mesh = make_mesh(D)
+    snd, rcv, _, mask = shard_edges(senders, receivers, None, D)
+    snd, rcv, mask = place_edge_shards(mesh, snd, rcv, mask)
+
+    agg = edge_sharded_aggregate(
+        mesh, lambda x_i, x_j: x_j, jnp.asarray(nodes), snd, rcv, mask
+    )
+    ref = jax.ops.segment_sum(nodes[senders], jnp.asarray(receivers), n)
+    np.testing.assert_allclose(np.asarray(agg), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+def pytest_edge_sharded_with_edge_data(giant_graph):
+    nodes, senders, receivers = giant_graph
+    n = nodes.shape[0]
+    rng = np.random.default_rng(1)
+    weights = rng.normal(size=(len(senders), 1)).astype(np.float32)
+    mesh = make_mesh(D)
+    snd, rcv, w, mask = shard_edges(senders, receivers, weights, D)
+    snd, rcv, w, mask = place_edge_shards(mesh, snd, rcv, w, mask)
+
+    agg = edge_sharded_aggregate(
+        mesh,
+        lambda x_i, x_j, ew: x_j * ew,
+        jnp.asarray(nodes),
+        snd,
+        rcv,
+        mask,
+        edge_data=w,
+    )
+    ref = jax.ops.segment_sum(
+        nodes[senders] * weights, jnp.asarray(receivers), n
+    )
+    np.testing.assert_allclose(np.asarray(agg), np.asarray(ref), rtol=1e-4, atol=1e-4)
+
+
+def pytest_edge_sharded_gin_layer_jits(giant_graph):
+    nodes, senders, receivers = giant_graph
+    h = nodes.shape[1]
+    rng = np.random.default_rng(2)
+    w1 = rng.normal(size=(h, h)).astype(np.float32) * 0.1
+    w2 = rng.normal(size=(h, h)).astype(np.float32) * 0.1
+    b1 = np.zeros(h, np.float32)
+    b2 = np.zeros(h, np.float32)
+    mesh = make_mesh(D)
+    snd, rcv, _, mask = shard_edges(senders, receivers, None, D)
+    snd, rcv, mask = place_edge_shards(mesh, snd, rcv, mask)
+
+    fn = jax.jit(
+        lambda nd: edge_sharded_gin_layer(
+            mesh, nd, snd, rcv, mask, w1, b1, w2, b2
+        )
+    )
+    out = fn(jnp.asarray(nodes))
+    assert out.shape == nodes.shape
+    assert np.isfinite(np.asarray(out)).all()
+    # eps-scaled self term dominates for isolated nodes: check a node with
+    # no incoming edges matches the pure-MLP path
+    iso = np.setdiff1d(np.arange(nodes.shape[0]), np.unique(receivers))
+    if len(iso):
+        i = int(iso[0])
+        ref = jax.nn.relu((101.0 * nodes[i]) @ w1 + b1) @ w2 + b2
+        np.testing.assert_allclose(np.asarray(out)[i], np.asarray(ref), rtol=1e-4)

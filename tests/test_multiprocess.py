@@ -80,7 +80,8 @@ got = sorted(float(ds.get(i).graph_y[0]) for i in range(len(ds)))
 want = sorted(r_ * 10.0 + i for r_ in range(nproc) for i in range(3))
 assert got == want, (got, want)
 
-# sharded loader: shards are disjoint and equal-length
+# sharded loader: shards cover every sample, with overlap limited to
+# the wrap-around remainder (ceil-equalized DistributedSampler contract)
 all_samples = ds.samples()
 loaders = [
     GraphLoader(all_samples, 2, num_shards=nproc, shard_rank=p)
@@ -88,6 +89,13 @@ loaders = [
 ]
 lens = {len(l.samples) for l in loaders}
 assert len(lens) == 1
+key = lambda s: float(s.graph_y[0])
+shard_keys = [sorted(key(s) for s in l.samples) for l in loaders]
+union = set().union(*[set(k) for k in shard_keys])
+assert union == {key(s) for s in all_samples}, "shards must cover the dataset"
+total = sum(len(k) for k in shard_keys)
+import math
+assert total == nproc * math.ceil(len(all_samples) / nproc)
 print(f"rank {rank}: OK")
 """
 
@@ -113,9 +121,18 @@ def pytest_two_process_distributed(tmp_path):
         for r in range(nproc)
     ]
     outs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=300)
-        outs.append(out)
+    try:
+        for p in procs:
+            try:
+                out, _ = p.communicate(timeout=300)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                out, _ = p.communicate()
+            outs.append(out)
+    finally:
+        for p in procs:  # never orphan a hung peer rank
+            if p.poll() is None:
+                p.kill()
     for r, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {r} failed:\n{out}"
         assert f"rank {r}: OK" in out

@@ -110,10 +110,22 @@ def make_config(model_type: str, multihead: bool, tmp_dir: str, num_epoch: int =
     }
 
 
-def unittest_train_model(model_type, multihead, tmp_path, num_epoch=40, n_conf=300):
+def unittest_train_model(
+    model_type,
+    multihead,
+    tmp_path,
+    num_epoch=40,
+    n_conf=300,
+    mutate=None,
+    thresholds=None,
+):
     """Train + predict + threshold assert (reference: unittest_train_model,
-    tests/test_graphs.py:24-171)."""
+    tests/test_graphs.py:24-171). ``mutate(config)`` adjusts the config in
+    place (e.g. edge-length features); ``thresholds`` overrides the
+    per-model (rmse, mae) table."""
     config = make_config(model_type, multihead, str(tmp_path), num_epoch)
+    if mutate is not None:
+        mutate(config)
     samples = deterministic_graph_data(number_configurations=n_conf, seed=0)
     log_dir = str(tmp_path) + "/logs/"
     model, state, history, full_config = run_training(
@@ -121,9 +133,11 @@ def unittest_train_model(model_type, multihead, tmp_path, num_epoch=40, n_conf=3
     )
 
     # training must have converged on the known function
-    thresholds = THRESHOLDS[model_type]
+    thresholds = thresholds or THRESHOLDS[model_type]
     samples2 = deterministic_graph_data(number_configurations=n_conf, seed=0)
     config2 = make_config(model_type, multihead, str(tmp_path), num_epoch)
+    if mutate is not None:
+        mutate(config2)
     error, error_rmse_task, true_values, predicted_values = run_prediction(
         config2, samples=samples2, log_dir=log_dir
     )
