@@ -181,8 +181,11 @@ def train_with_loaders(
             loader.set_sharding(batch_sharding(mesh))
         zero1 = bool(training.get("Optimizer", {}).get("use_zero_redundancy", False))
         state = create_train_state(variables, tx)
-        state = load_existing_model_config(state, training, log_dir)
+        # place BEFORE restoring: the restore target then carries the run's
+        # real (ZeRO-1) shardings, so orbax places shards directly and the
+        # msgpack path re-places onto them
         state = place_state(mesh, state, zero1=zero1)
+        state = load_existing_model_config(state, training, log_dir)
         compute_dtype = jax.numpy.bfloat16 if training.get("mixed_precision") else None
         train_step = make_sharded_train_step(
             model, tx, mesh, zero1=zero1, compute_dtype=compute_dtype

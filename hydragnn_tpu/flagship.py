@@ -90,6 +90,7 @@ def build_flagship(
     device_stack: int = 1,
     unit_cells: Tuple[int, int] = (2, 4),
     seed: int = 0,
+    cache_device_batches: bool = False,
 ):
     """Returns (config, model, variables, train_loader)."""
     config = flagship_config(hidden_dim, num_conv_layers, batch_size)
@@ -108,6 +109,7 @@ def build_flagship(
         shuffle=True,
         device_stack=device_stack,
         drop_last=True,
+        cache_device_batches=cache_device_batches,
     )
     import jax
 

@@ -3,12 +3,20 @@
 The reference saves one ``.pk`` file holding model+optimizer state dicts,
 written by rank 0 (after ZeRO consolidation), and supports config-driven
 continuation (reference: hydragnn/utils/model.py:41-86, config keys
-``Training.continue``/``startfrom``). TPU equivalent: the whole
-``TrainState`` pytree (params, batch_stats, optimizer state, step, rng) is
-serialized with flax msgpack into one file per run — process 0 writes,
-every process reads. Loading targets an already-constructed state, so the
-structure acts as the schema (the analog of ``load_state_dict``); sharded
-multi-host array state is pulled to host before writing.
+``Training.continue``/``startfrom``). Two TPU-native backends behind the
+same single-name "continue" UX:
+
+  - ``msgpack`` (default single-process): the whole ``TrainState``
+    pytree (params, batch_stats, optimizer state, step, rng) in one
+    flax-msgpack file; process 0 writes, every process reads. Sharded
+    arrays are consolidated to host first (the ZeRO-consolidation
+    analog).
+  - ``orbax`` (default multi-process): Orbax sharded checkpoint — every
+    host writes its addressable shards in parallel and restore places
+    shards directly onto the target sharding, so pod-scale ZeRO-1 state
+    never funnels through one host.
+
+``load_existing_model`` auto-detects which backend wrote a run.
 """
 
 from __future__ import annotations
@@ -38,9 +46,29 @@ def _to_host(x: Any) -> np.ndarray:
     return np.asarray(x)
 
 
-def save_model(state: Any, log_name: str, path: str = "./logs/", verbosity: int = 0) -> str:
-    """Write the TrainState to ``<path>/<log_name>/<log_name>.mp``
-    (process-0 write, like the reference's rank-0 save, model.py:41-54)."""
+def _orbax_dir(log_name: str, path: str) -> str:
+    return os.path.abspath(os.path.join(path, log_name, f"{log_name}.orbax"))
+
+
+def save_model(
+    state: Any,
+    log_name: str,
+    path: str = "./logs/",
+    verbosity: int = 0,
+    backend: str = "auto",
+) -> str:
+    """Write the TrainState under ``<path>/<log_name>/`` (reference:
+    rank-0 save, model.py:41-54). ``backend``: "msgpack", "orbax", or
+    "auto" (orbax when multi-process — parallel sharded writes)."""
+    if backend == "auto":
+        backend = "orbax" if jax.process_count() > 1 else "msgpack"
+    if backend == "orbax":
+        import orbax.checkpoint as ocp
+
+        ckpt_dir = _orbax_dir(log_name, path)
+        with ocp.StandardCheckpointer() as ckptr:
+            ckptr.save(ckpt_dir, state, force=True)
+        return ckpt_dir
     ckpt_path = _checkpoint_path(log_name, path)
     host_state = jax.tree_util.tree_map(_to_host, state)
     if jax.process_index() == 0:
@@ -53,12 +81,31 @@ def save_model(state: Any, log_name: str, path: str = "./logs/", verbosity: int 
 def load_existing_model(
     state: Any, log_name: str, path: str = "./logs/"
 ) -> Any:
-    """Restore a TrainState from the run's checkpoint file. ``state`` is the
-    freshly-constructed target (its pytree structure = the schema)."""
+    """Restore a TrainState from the run's checkpoint. ``state`` is the
+    freshly-constructed target (its pytree structure = the schema; with
+    sharded leaves, orbax restores shards onto their shardings directly).
+    The backend that wrote the run is auto-detected."""
+    orbax_dir = _orbax_dir(log_name, path)
+    if os.path.isdir(orbax_dir):
+        import orbax.checkpoint as ocp
+
+        with ocp.StandardCheckpointer() as ckptr:
+            target = jax.tree_util.tree_map(ocp.utils.to_shape_dtype_struct, state)
+            return ckptr.restore(orbax_dir, target)
     ckpt_path = _checkpoint_path(log_name, path)
     with open(ckpt_path, "rb") as f:
         data = f.read()
-    return serialization.from_bytes(state, data)
+    restored = serialization.from_bytes(state, data)
+
+    # preserve the target's placement: leaves restored as host arrays go
+    # back onto the sharding the caller's state carries (ZeRO-1 layouts
+    # survive a msgpack resume)
+    def _place(tgt, val):
+        if isinstance(tgt, jax.Array) and hasattr(tgt, "sharding"):
+            return jax.device_put(val, tgt.sharding)
+        return val
+
+    return jax.tree_util.tree_map(_place, state, restored)
 
 
 def load_existing_model_config(
@@ -73,4 +120,6 @@ def load_existing_model_config(
 
 
 def checkpoint_exists(log_name: str, path: str = "./logs/") -> bool:
-    return os.path.exists(_checkpoint_path(log_name, path))
+    return os.path.exists(_checkpoint_path(log_name, path)) or os.path.isdir(
+        _orbax_dir(log_name, path)
+    )
