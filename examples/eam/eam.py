@@ -120,9 +120,15 @@ def write_cfg(path: str, pos, z, cell, atomic_e, forces) -> None:
             )
     with open(path, "w") as f:
         f.write("\n".join(lines))
-    # .bulk sidecar: total energy (reference cfgdataset.py bulk pathway)
+    # .bulk sidecar (reference cfgdataset.py bulk pathway): columns are
+    # total_energy volume bulk_modulus — bulk modulus is a smooth
+    # composition blend (GPa-ish) so the bulk configs have a learnable
+    # graph target
+    frac_ni = float((z == NI).mean())
+    bulk_modulus = 180.0 * frac_ni + 170.0 * (1 - frac_ni) - 25.0 * frac_ni * (1 - frac_ni)
+    volume = float(abs(np.linalg.det(cell)))
     with open(os.path.splitext(path)[0] + ".bulk", "w") as f:
-        f.write(f"{atomic_e.sum():.8f}\n")
+        f.write(f"{atomic_e.sum():.8f} {volume:.8f} {bulk_modulus:.8f}\n")
 
 
 def generate_ninb(out_dir: str, n_config: int = 100, seed: int = 7,
@@ -164,12 +170,30 @@ def main() -> None:
 
     datasetname = config["Dataset"]["name"]
     raw_dir = os.path.join(_here, config["Dataset"]["path"]["total"])
-    container_dir = os.path.join(_here, "dataset", f"{datasetname}.hgc")
+    # container named per config: the packed targets depend on the
+    # config's Variables_of_interest, so different inputfiles must not
+    # share a container
+    config_stem = os.path.splitext(os.path.basename(args.inputfile))[0]
+    container_dir = os.path.join(_here, "dataset", f"{datasetname}_{config_stem}.hgc")
 
     if args.preonly:
         have_cfg = os.path.isdir(raw_dir) and any(
             f.endswith(".cfg") for f in os.listdir(raw_dir)
         )
+        if have_cfg:
+            # stale data from an older generator version: the .bulk
+            # sidecar must carry [total_energy volume bulk_modulus]
+            bulks = sorted(
+                f for f in os.listdir(raw_dir) if f.endswith(".bulk")
+            )
+            if bulks:
+                with open(os.path.join(raw_dir, bulks[0])) as f:
+                    if len(f.readline().split()) < 3:
+                        print("stale .bulk sidecars detected; regenerating dataset")
+                        import shutil
+
+                        shutil.rmtree(raw_dir)
+                        have_cfg = False
         if not have_cfg:
             print(f"raw CFG data not found at {raw_dir}; generating synthetic NiNb")
             generate_ninb(raw_dir, n_config=args.nconfig,
