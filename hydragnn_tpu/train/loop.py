@@ -23,6 +23,7 @@ and materialized once per epoch, so the hot loop never blocks on D2H.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import time
@@ -274,6 +275,16 @@ def train_validate_test(
     if stats_step is None and training.get("bn_recalibration", True):
         stats_step = make_stats_step(model)
 
+    # config-driven profiler (reference: Profiler setup from
+    # config["Profile"], train_validate_test.py:99-101)
+    if profiler is None and "Profile" in config:
+        from hydragnn_tpu.utils.profile import Profiler
+
+        profiler = Profiler(prefix=os.path.join(log_dir, log_name, "profile"))
+        profiler.setup(config["Profile"])
+        if not profiler.enable:
+            profiler = None
+
     history: Dict[str, List] = {
         "train_loss": [],
         "val_loss": [],
@@ -321,9 +332,12 @@ def train_validate_test(
         if profiler is not None:
             profiler.set_current_epoch(epoch)
 
-        state, train_loss, train_tasks = train_epoch(
-            train_loader, state, train_step, verbosity, profiler=profiler
-        )
+        # the profiler context closes an in-flight trace at epoch end even
+        # when the epoch has fewer steps than its schedule expects
+        with (profiler if profiler is not None else contextlib.nullcontext()):
+            state, train_loss, train_tasks = train_epoch(
+                train_loader, state, train_step, verbosity, profiler=profiler
+            )
         val_loss, val_tasks = evaluate_epoch(val_loader, state, eval_step, verbosity)
         collect = plot_hist_solution and visualizer is not None
         test_loss, test_tasks, true_values, predicted_values = test_epoch(

@@ -44,9 +44,10 @@ class Profiler:
 
     def setup(self, config: dict) -> None:
         """Configure from the ``Profile`` config section (reference keys:
-        ``enable``, ``target_epoch``; profile.py:32-42)."""
-        self.enable = config.get("enable", 0) == 1
-        self.target_epoch = config.get("target_epoch", 0)
+        ``enable``, ``target_epoch``; profile.py:32-42). ``enable``
+        accepts 1/"1"/True (JSON configs vary)."""
+        self.enable = str(config.get("enable", 0)).lower() in ("1", "true")
+        self.target_epoch = int(config.get("target_epoch", 0))
 
     def set_current_epoch(self, current_epoch: int) -> None:
         self.current_epoch = current_epoch

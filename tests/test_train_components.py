@@ -261,3 +261,51 @@ def pytest_per_split_raw_paths(tmp_path):
     assert train_loader.num_samples == counts["train"]
     assert val_loader.num_samples == counts["validate"]
     assert test_loader.num_samples == counts["test"]
+
+
+def pytest_config_gated_profiler_writes_trace(tmp_path):
+    """NeuralNetwork.Profile.enable drives an epoch-gated jax.profiler
+    trace from the train loop (reference: train_validate_test.py:99-101)."""
+    import glob
+
+    from hydragnn_tpu.api import run_training
+    from hydragnn_tpu.data.synthetic import deterministic_graph_data
+
+    # 200 configs -> ~140 train samples -> 18 batches/epoch, comfortably
+    # above the profiler schedule's wait+warmup+active = 11 steps
+    samples = deterministic_graph_data(number_configurations=200)
+    config = {
+        "Verbosity": {"level": 0},
+        "Dataset": {
+            "name": "prof",
+            "format": "unit_test",
+            "node_features": {"name": ["x", "x2", "x3"], "dim": [1, 1, 1],
+                              "column_index": [0, 6, 7]},
+            "graph_features": {"name": ["sum"], "dim": [1], "column_index": [0]},
+        },
+        "NeuralNetwork": {
+            "Profile": {"enable": 1, "target_epoch": 1},
+            "Architecture": {
+                "model_type": "GIN", "radius": 2.0, "max_neighbours": 100,
+                "hidden_dim": 8, "num_conv_layers": 1,
+                "output_heads": {"graph": {"num_sharedlayers": 1,
+                    "dim_sharedlayers": 5, "num_headlayers": 1,
+                    "dim_headlayers": [10]}},
+                "task_weights": [1.0],
+            },
+            "Variables_of_interest": {
+                "input_node_features": [0], "output_names": ["sum"],
+                "output_index": [0], "type": ["graph"],
+            },
+            "Training": {
+                "num_epoch": 2, "perc_train": 0.7, "loss_function_type": "mse",
+                "batch_size": 8, "Optimizer": {"type": "AdamW", "learning_rate": 0.01},
+            },
+        },
+        "Visualization": {"create_plots": False},
+    }
+    run_training(config, samples=samples, log_dir=str(tmp_path) + "/logs/")
+    artifacts = glob.glob(
+        str(tmp_path) + "/logs/**/profile/**/*", recursive=True
+    )
+    assert artifacts, "Profile.enable must produce profiler artifacts"
