@@ -118,15 +118,11 @@ def create_dataloaders(
 
 def _choose_device_stack(config: Dict[str, Any]) -> int:
     """Data-parallel width for this process: all local devices when the
-    batch size divides evenly, else single-device. Multi-host runs need
-    the distributed data plane (DDStore/ADIOS equivalents) and are
-    rejected until it lands — silently training unsynced replicas would
-    be worse (reference DDP all-reduces every step)."""
-    if jax.process_count() > 1:
-        raise NotImplementedError(
-            "multi-host run_training requires the distributed data plane; "
-            "single-host multi-device (data mesh) is supported"
-        )
+    per-process batch size divides evenly, else single-device. Multi-host
+    runs combine this with a global mesh over every process's devices
+    (each process feeds its own shard; ``globalize_batch`` assembles the
+    logical batch), so the reference's DDP-over-mpirun launch shape maps
+    to one process per host here."""
     n_local = jax.local_device_count()
     bs = int(config["NeuralNetwork"]["Training"]["batch_size"])
     return n_local if n_local > 1 and bs % n_local == 0 else 1
@@ -150,9 +146,12 @@ def train_with_loaders(
     save_config(config, log_name, log_dir)
 
     nn_config = config["NeuralNetwork"]
+    # Taken BEFORE any mesh is attached to the loaders, so the example is
+    # a host-local batch regardless of the distribution mode.
     example = next(iter(train_loader))
-    sharded = device_stack > 1
-    if sharded:
+    multihost = jax.process_count() > 1
+    sharded = device_stack > 1 or multihost
+    if device_stack > 1:
         example_one = jax.tree_util.tree_map(lambda x: x[0], example)
     else:
         example_one = example
@@ -176,9 +175,38 @@ def train_with_loaders(
         model, variables = create_model_config(
             nn_config, example_one, bn_axis_name=DATA_AXIS
         )
-        mesh = make_mesh(device_stack)
-        for loader in (train_loader, val_loader, test_loader):
-            loader.set_sharding(batch_sharding(mesh))
+        if multihost:
+            # Global mesh over every process's devices; each process feeds
+            # its shard of the logical batch (the reference's one-DDP-rank-
+            # per-GPU launch becomes one-process-per-host + a data mesh).
+            if device_stack != jax.local_device_count() and device_stack != 1:
+                raise ValueError(
+                    "multi-host device_stack must be 1 or local_device_count"
+                )
+            # Heterogeneous hosts can locally derive different widths
+            # (device_stack falls back to 1 when batch_size doesn't divide
+            # its local device count); meshes/batch shapes must agree
+            # everywhere or the collectives fail opaquely downstream.
+            from jax.experimental import multihost_utils
+
+            stacks = np.asarray(
+                multihost_utils.process_allgather(
+                    np.asarray([device_stack], dtype=np.int64)
+                )
+            ).reshape(-1)
+            if not (stacks == device_stack).all():
+                raise ValueError(
+                    f"device_stack must agree across processes, got {stacks.tolist()}"
+                )
+            from hydragnn_tpu.parallel import make_multihost_mesh
+
+            mesh = make_multihost_mesh(per_process=device_stack)
+            for loader in (train_loader, val_loader, test_loader):
+                loader.set_global_mesh(mesh)
+        else:
+            mesh = make_mesh(device_stack)
+            for loader in (train_loader, val_loader, test_loader):
+                loader.set_sharding(batch_sharding(mesh))
         zero1 = bool(training.get("Optimizer", {}).get("use_zero_redundancy", False))
         state = create_train_state(variables, tx)
         # place BEFORE restoring: the restore target then carries the run's

@@ -124,6 +124,7 @@ class GraphLoader:
         self.prefetch = prefetch
         self._cached_batches: Optional[List[GraphBatch]] = None
         self._sharding = None
+        self._global_mesh = None
         self._epoch = 0
         sub = batch_size // device_stack
         # Pad plan from the FULL dataset, not the local shard: all hosts
@@ -144,6 +145,15 @@ class GraphLoader:
         if self._cached_batches is not None and sharding is not self._sharding:
             self._cached_batches = None  # rebuild with the new placement
         self._sharding = sharding
+
+    def set_global_mesh(self, mesh) -> None:
+        """Multi-host mode: assemble each local [device_stack, ...] batch
+        into global jax.Arrays sharded over ``mesh``'s data axis (leading
+        axis = device_stack × process_count). The assembly runs in the
+        prefetch thread so cross-host batch formation overlaps compute."""
+        if self._cached_batches is not None and mesh is not self._global_mesh:
+            self._cached_batches = None
+        self._global_mesh = mesh
 
     def __len__(self) -> int:
         n = len(self.samples)
@@ -187,6 +197,24 @@ class GraphLoader:
                 subs.append(self._make_sub_batch(part))
         return jax.tree_util.tree_map(lambda *xs: np.stack(xs), *subs)
 
+    def _place(self, batch: GraphBatch) -> GraphBatch:
+        """Device placement for a freshly-built host batch: global-mesh
+        assembly (multi-host), explicit sharding (single-host mesh), or
+        pass-through (jit moves it)."""
+        if self._global_mesh is not None:
+            from hydragnn_tpu.parallel.mesh import globalize_batch
+
+            if self.device_stack == 1:
+                # the sharded steps expect a leading device axis even when
+                # each process contributes a single sub-batch
+                batch = jax.tree_util.tree_map(
+                    lambda x: np.asarray(x)[None], batch
+                )
+            return globalize_batch(self._global_mesh, batch)
+        if self._sharding is not None:
+            return jax.device_put(batch, self._sharding)
+        return batch
+
     def __iter__(self) -> Iterator[GraphBatch]:
         bs = self.batch_size
         nb = len(self)
@@ -194,9 +222,7 @@ class GraphLoader:
             if self._cached_batches is None:
                 base = np.arange(len(self.samples))
                 self._cached_batches = [
-                    jax.device_put(
-                        self._make_batch(base[b * bs : (b + 1) * bs]), self._sharding
-                    )
+                    self._place(self._make_batch(base[b * bs : (b + 1) * bs]))
                     for b in range(nb)
                 ]
             if self.shuffle:
@@ -210,7 +236,7 @@ class GraphLoader:
         order = self._order()
         if self.prefetch <= 0:
             for b in range(nb):
-                yield self._make_batch(order[b * bs : (b + 1) * bs])
+                yield self._place(self._make_batch(order[b * bs : (b + 1) * bs]))
             return
         # Background producer thread: batch assembly + H2D transfer
         # overlap with device compute (the reference's HydraDataLoader
@@ -235,9 +261,7 @@ class GraphLoader:
         def producer():
             try:
                 for b in range(nb):
-                    batch = self._make_batch(order[b * bs : (b + 1) * bs])
-                    if self._sharding is not None:
-                        batch = jax.device_put(batch, self._sharding)
+                    batch = self._place(self._make_batch(order[b * bs : (b + 1) * bs]))
                     if not put_stop_aware(batch):
                         return
                 put_stop_aware(sentinel)

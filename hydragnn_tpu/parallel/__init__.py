@@ -3,8 +3,11 @@ from hydragnn_tpu.parallel.mesh import (
     barrier,
     batch_sharding,
     get_comm_size_and_rank,
+    globalize_batch,
     local_device_count,
+    local_view,
     make_mesh,
+    make_multihost_mesh,
     nsplit,
     replicated_sharding,
     setup_distributed,

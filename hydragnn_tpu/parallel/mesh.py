@@ -99,6 +99,59 @@ def make_mesh(
     return Mesh(np.array(devices).reshape(shape), axis_names)
 
 
+def make_multihost_mesh(
+    per_process: int = 0, axis_names: Sequence[str] = (DATA_AXIS,)
+) -> Mesh:
+    """A 1-D mesh spanning every process: ``per_process`` devices from
+    EACH process (0 = all of them), ordered by (process, device id) so a
+    process's shards are contiguous on the data axis. This is the
+    multi-host "process group" — every process must contribute devices or
+    ``make_array_from_process_local_data`` has nowhere to place that
+    process's batch shard."""
+    by_proc: dict = {}
+    for d in jax.devices():
+        by_proc.setdefault(d.process_index, []).append(d)
+    picked = []
+    for proc in sorted(by_proc):
+        devs = sorted(by_proc[proc], key=lambda d: d.id)
+        n = per_process if per_process > 0 else len(devs)
+        if n > len(devs):
+            raise ValueError(
+                f"process {proc} has {len(devs)} devices, need {n}"
+            )
+        picked.extend(devs[:n])
+    shape = (len(picked),) + (1,) * (len(axis_names) - 1)
+    return Mesh(np.array(picked).reshape(shape), axis_names)
+
+
+def globalize_batch(mesh: Mesh, batch):
+    """Assemble per-process local ``[D_local, ...]`` batch leaves into
+    global ``jax.Array``s sharded ``P(data)`` over a multi-process mesh
+    (global leading axis = D_local × process_count). This is the moment a
+    multi-host batch becomes one logical array — the analog of the
+    reference's implicit "each DDP rank owns its own sub-batch" contract
+    (hydragnn/preprocess/load_data.py:229-231), expressed as a sharding
+    instead of per-rank processes."""
+    sh = NamedSharding(mesh, P(DATA_AXIS))
+    return jax.tree_util.tree_map(
+        lambda x: jax.make_array_from_process_local_data(sh, np.asarray(x)), batch
+    )
+
+
+def local_view(arr) -> np.ndarray:
+    """Host-local rows of an array whose leading axis is (possibly)
+    sharded across processes: for a non-fully-addressable ``jax.Array``
+    this concatenates the process's addressable shards in global index
+    order; numpy / fully-addressable arrays pass through. Used to align
+    sharded eval outputs with this process's slice of the batch."""
+    if isinstance(arr, jax.Array) and not arr.is_fully_addressable:
+        shards = sorted(
+            arr.addressable_shards, key=lambda s: s.index[0].start or 0
+        )
+        return np.concatenate([np.asarray(s.data) for s in shards], axis=0)
+    return np.asarray(arr)
+
+
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     """Sharding for loader output with a leading device axis [D, ...]."""
     return NamedSharding(mesh, P(DATA_AXIS))

@@ -180,19 +180,24 @@ def test_epoch(
             # Stacked multi-device batches carry a leading device axis on
             # masks/targets ([D, G]) while sharded eval outputs come back
             # device-concatenated ([D*G, d]); flattening aligns both.
-            gmask = np.asarray(batch.graph_mask).reshape(-1)
-            nmask = np.asarray(batch.node_mask).reshape(-1)
+            # ``local_view`` reduces multi-host global arrays to this
+            # process's rows (same order as its local sub-batches), so the
+            # cross-process concat below sees each sample exactly once.
+            from hydragnn_tpu.parallel.mesh import local_view
+
+            gmask = local_view(batch.graph_mask).reshape(-1)
+            nmask = local_view(batch.node_mask).reshape(-1)
             for ihead in range(cfg.num_heads):
                 name = cfg.output_names[ihead]
                 if cfg.output_type[ihead] == "graph":
-                    t = np.asarray(batch.graph_targets[name])
+                    t = local_view(batch.graph_targets[name])
                     tv = t.reshape(-1, t.shape[-1])[gmask]
-                    p = np.asarray(outputs[ihead])
+                    p = local_view(outputs[ihead])
                     pv = p.reshape(-1, p.shape[-1])[gmask]
                 else:
-                    t = np.asarray(batch.node_targets[name])
+                    t = local_view(batch.node_targets[name])
                     tv = t.reshape(-1, t.shape[-1])[nmask]
-                    p = np.asarray(outputs[ihead])
+                    p = local_view(outputs[ihead])
                     pv = p.reshape(-1, p.shape[-1])[nmask]
                 true_values[ihead].append(tv)
                 pred_values[ihead].append(pv)
@@ -368,6 +373,13 @@ def train_validate_test(
             f"Epoch: {epoch:02d}, Train Loss: {train_loss:.8f}, "
             f"Val Loss: {val_loss:.8f}, Test Loss: {test_loss:.8f}",
         )
+        if epoch == 0:
+            # post-first-epoch peak = steady-state footprint (weights +
+            # activations + optimizer state); the reference prints peak
+            # GPU memory around the train step (distributed.py:236-243)
+            from hydragnn_tpu.utils.print_utils import print_peak_memory
+
+            print_peak_memory(verbosity, prefix=f"epoch {epoch}")
         writer.add_scalar("train error", train_loss, epoch)
         writer.add_scalar("validate error", val_loss, epoch)
         writer.add_scalar("test error", test_loss, epoch)

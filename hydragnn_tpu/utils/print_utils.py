@@ -75,6 +75,28 @@ def log(*args) -> None:
         print(msg)
 
 
+def print_peak_memory(verbosity_level: int = 2, prefix: str = "") -> Optional[int]:
+    """Device peak/in-use memory print (reference: print_peak_memory,
+    hydragnn/utils/distributed.py:236-243, which reads
+    torch.cuda.max_memory_allocated). TPU/GPU backends expose
+    ``Device.memory_stats()``; CPU returns None silently."""
+    import jax
+
+    dev = jax.local_devices()[0]
+    stats = None
+    try:
+        stats = dev.memory_stats()
+    except (NotImplementedError, RuntimeError, AttributeError):
+        pass
+    if not stats:
+        return None
+    peak = stats.get("peak_bytes_in_use", stats.get("bytes_in_use", 0))
+    print_distributed(
+        verbosity_level, f"{prefix} peak device memory: {peak / 1e6:.1f} MB"
+    )
+    return int(peak)
+
+
 def print_model(params, verbosity_level: int = 2) -> int:
     """Per-parameter shape/size table + total (reference:
     hydragnn/utils/model.py:112-120 print_model). ``params`` is a model

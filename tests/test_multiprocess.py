@@ -100,6 +100,119 @@ print(f"rank {rank}: OK")
 """
 
 
+_TRAIN_WORKER = r"""
+import os, sys
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+import numpy as np
+import jax
+
+# env JAX_PLATFORMS does not stick under the axon image; pin the config
+# before any backend use (see .claude/skills/verify/SKILL.md)
+jax.config.update("jax_platforms", "cpu")
+
+rank = int(sys.argv[1])
+nproc = int(sys.argv[2])
+port = sys.argv[3]
+workdir = sys.argv[4]
+repo = sys.argv[5]
+
+jax.distributed.initialize(
+    coordinator_address=f"127.0.0.1:{port}", num_processes=nproc, process_id=rank
+)
+assert jax.process_count() == nproc
+assert jax.local_device_count() == 2
+assert len(jax.devices()) == 2 * nproc
+
+sys.path.insert(0, repo)
+sys.path.insert(0, os.path.join(repo, "tests"))
+from test_train_e2e import make_config
+from hydragnn_tpu.api import run_prediction, run_training
+from hydragnn_tpu.data.synthetic import deterministic_graph_data
+
+config = make_config("GIN", False, workdir, num_epoch=30)
+samples = deterministic_graph_data(number_configurations=300, seed=0)
+log_dir = os.path.join(workdir, "logs/")
+model, state, history, full_config = run_training(
+    config, samples=samples, log_dir=log_dir
+)
+
+# every process must hold identical (replicated, psum-synced) params
+from jax.experimental import multihost_utils
+leaves = jax.tree_util.tree_leaves(state.params)
+flat = np.concatenate([np.asarray(l).reshape(-1) for l in leaves])
+gathered = np.asarray(multihost_utils.process_allgather(flat))
+for p in range(1, nproc):
+    np.testing.assert_allclose(gathered[p], gathered[0], rtol=0, atol=0)
+
+losses = history["train_loss"]
+assert all(np.isfinite(losses)), losses
+assert losses[-1] < 0.5 * losses[0], f"no convergence: {losses[0]} -> {losses[-1]}"
+
+# multi-process checkpoint: orbax sharded dir written by all hosts
+import glob
+orbax_dirs = glob.glob(os.path.join(log_dir, "*", "*.orbax"))
+assert orbax_dirs, "expected an orbax checkpoint dir"
+
+# reload through run_prediction (orbax restore + per-process eval shards
+# + cross-process varlen gather); GIN thresholds (reference:
+# tests/test_graphs.py:131) with headroom for the shorter budget
+config2 = make_config("GIN", False, workdir, num_epoch=30)
+samples2 = deterministic_graph_data(number_configurations=300, seed=0)
+error, error_rmse_task, true_values, predicted_values = run_prediction(
+    config2, samples=samples2, log_dir=log_dir
+)
+rmse = float(error_rmse_task[0])
+mae = float(np.mean(np.abs(true_values[0] - predicted_values[0])))
+assert rmse < 0.35, f"RMSE {rmse}"
+assert mae < 0.30, f"MAE {mae}"
+print(f"rank {rank}: TRAIN-OK rmse={rmse:.4f} mae={mae:.4f}")
+"""
+
+
+def pytest_two_process_train_e2e(tmp_path):
+    """True multi-host training: 2 OS processes × 2 CPU devices each, one
+    global 4-device data mesh, full run_training + orbax checkpoint +
+    run_prediction reload — the analog of the reference CI's e2e tests
+    under ``mpirun -n 2`` (reference: .github/workflows/CI.yml)."""
+    port = _free_port()
+    script = tmp_path / "train_worker.py"
+    script.write_text(_TRAIN_WORKER)
+    nproc = 2
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    procs = [
+        subprocess.Popen(
+            [
+                sys.executable, str(script), str(r), str(nproc), str(port),
+                str(tmp_path), _REPO,
+            ],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for r in range(nproc)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            try:
+                out, _ = p.communicate(timeout=900)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                out, _ = p.communicate()
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {r} failed:\n{out}"
+        assert f"rank {r}: TRAIN-OK" in out
+
+
 def pytest_two_process_distributed(tmp_path):
     port = _free_port()
     script = tmp_path / "worker.py"

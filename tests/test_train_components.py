@@ -309,3 +309,19 @@ def pytest_config_gated_profiler_writes_trace(tmp_path):
         str(tmp_path) + "/logs/**/profile/**/*", recursive=True
     )
     assert artifacts, "Profile.enable must produce profiler artifacts"
+
+
+def pytest_print_peak_memory_smoke(capsys):
+    """print_peak_memory (reference: hydragnn/utils/distributed.py:236-243)
+    must return the peak byte count where the backend exposes memory_stats
+    and None (silently) where it doesn't — never raise. It's wired into
+    train_validate_test after epoch 0."""
+    from hydragnn_tpu.utils.print_utils import print_peak_memory
+
+    peak = print_peak_memory(verbosity_level=4, prefix="smoke")
+    out = capsys.readouterr().out
+    if peak is None:
+        assert "peak device memory" not in out
+    else:
+        assert peak >= 0
+        assert "peak device memory" in out
