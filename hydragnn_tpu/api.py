@@ -216,7 +216,12 @@ def train_with_loaders(
         state = load_existing_model_config(state, training, log_dir)
         compute_dtype = jax.numpy.bfloat16 if training.get("mixed_precision") else None
         train_step = make_sharded_train_step(
-            model, tx, mesh, zero1=zero1, compute_dtype=compute_dtype
+            model,
+            tx,
+            mesh,
+            zero1=zero1,
+            compute_dtype=compute_dtype,
+            remat=bool(training.get("remat", False)),
         )
         eval_step = make_sharded_eval_step(model, mesh)
         eval_step_out = make_sharded_eval_step(model, mesh, with_outputs=True)

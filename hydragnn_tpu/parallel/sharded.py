@@ -64,9 +64,15 @@ def _replicated_state_sharding(mesh: Mesh, state: TrainState) -> TrainState:
     return jax.tree_util.tree_map(lambda _: rep, state)
 
 
+def _state_sharding(mesh: Mesh, state: TrainState, zero1: bool) -> TrainState:
+    """The run's state layout — single source of truth shared by initial
+    placement and the per-step output constraint."""
+    return _zero1_sharding(mesh, state) if zero1 else _replicated_state_sharding(mesh, state)
+
+
 def place_state(mesh: Mesh, state: TrainState, zero1: bool = False) -> TrainState:
     """Place a host-built TrainState onto the mesh with the chosen layout."""
-    sh = _zero1_sharding(mesh, state) if zero1 else _replicated_state_sharding(mesh, state)
+    sh = _state_sharding(mesh, state, zero1)
     return jax.tree_util.tree_map(
         lambda x, s: jax.device_put(x, s), state, sh
     )
@@ -78,13 +84,16 @@ def make_sharded_train_step(
     mesh: Mesh,
     zero1: bool = False,
     compute_dtype=None,
+    remat: bool = False,
 ) -> Callable[[TrainState, GraphBatch], Tuple[TrainState, jnp.ndarray, jnp.ndarray]]:
     """Jitted ``(state, batch[D-leading]) -> (state, loss, tasks)``.
 
     ``batch`` leaves carry a leading device axis of size mesh['data']
     (GraphLoader(device_stack=D) output). ``compute_dtype=jnp.bfloat16``
     enables mixed precision exactly like the single-device step: bf16
-    forward/backward, f32 master params / grads / BN stats / loss."""
+    forward/backward, f32 master params / grads / BN stats / loss.
+    ``remat=True`` checkpoints the per-device forward (see
+    train.state.make_train_step)."""
     from hydragnn_tpu.train.state import _cast_floats
 
     def per_device_grads(params, batch_stats, dropout_rng, batch: GraphBatch):
@@ -111,7 +120,8 @@ def make_sharded_train_step(
             total, tasks = model_loss(model.cfg, outputs, batch)
             return total, (jnp.stack(tasks), mutated)
 
-        (loss, (tasks, mutated)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+        lf = jax.checkpoint(loss_fn) if remat else loss_fn
+        (loss, (tasks, mutated)), grads = jax.value_and_grad(lf, has_aux=True)(
             params
         )
         # DDP-equivalent gradient mean over the data axis (ICI collective).
@@ -132,8 +142,6 @@ def make_sharded_train_step(
         check_vma=False,
     )
 
-    state_sh = None  # resolved lazily at first call
-
     def step(state: TrainState, batch: GraphBatch):
         rng, dropout_rng = jax.random.split(state.rng)
         grads, new_stats, loss, tasks = sharded_grads(
@@ -147,6 +155,14 @@ def make_sharded_train_step(
             batch_stats=new_stats,
             opt_state=opt_state,
             rng=rng,
+        )
+        # Pin the documented layout (params/stats replicated, optimizer
+        # state data-sharded under ZeRO-1): without the constraint XLA may
+        # propagate the opt-state sharding into the updated params, which
+        # both changes layout across steps (recompile + donation churn)
+        # and leaves params unreadable from host code.
+        new_state = jax.lax.with_sharding_constraint(
+            new_state, _state_sharding(mesh, new_state, zero1)
         )
         return new_state, loss, tasks
 

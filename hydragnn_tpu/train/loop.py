@@ -274,7 +274,9 @@ def train_validate_test(
     compute_dtype = (
         jnp.bfloat16 if training.get("mixed_precision") else None
     )
-    train_step = train_step or make_train_step(model, tx, compute_dtype=compute_dtype)
+    train_step = train_step or make_train_step(
+        model, tx, compute_dtype=compute_dtype, remat=bool(training.get("remat", False))
+    )
     eval_step = eval_step or make_eval_step(model)
     eval_step_out = eval_step_out or make_eval_step(model, with_outputs=True)
     if stats_step is None and training.get("bn_recalibration", True):

@@ -61,13 +61,20 @@ def make_train_step(
     model: HydraModel,
     tx: optax.GradientTransformation,
     compute_dtype=None,
+    remat: bool = False,
 ) -> Callable[[TrainState, GraphBatch], Tuple[TrainState, jnp.ndarray, jnp.ndarray]]:
     """Returns jitted ``(state, batch) -> (state, loss, tasks_loss)``.
 
     ``compute_dtype=jnp.bfloat16`` enables mixed precision: params and
     batch features are cast to bf16 for the forward/backward (MXU-native
     on TPU), while the master params, optimizer state, BatchNorm
-    statistics, and the loss stay float32."""
+    statistics, and the loss stay float32.
+
+    ``remat=True`` (config ``Training.remat``) checkpoints the forward:
+    activations are recomputed during the backward pass instead of held in
+    HBM — the standard FLOPs-for-memory trade for deep conv stacks or
+    large padded graphs. No reference analog (torch would use
+    ``torch.utils.checkpoint``; the reference never does)."""
 
     def step(state: TrainState, batch: GraphBatch):
         rng, dropout_rng = jax.random.split(state.rng)
@@ -90,7 +97,8 @@ def make_train_step(
             total, tasks = model_loss(model.cfg, outputs, batch)
             return total, (jnp.stack(tasks), mutated)
 
-        (loss, (tasks, mutated)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+        lf = jax.checkpoint(loss_fn) if remat else loss_fn
+        (loss, (tasks, mutated)), grads = jax.value_and_grad(lf, has_aux=True)(
             state.params
         )
         updates, opt_state = tx.update(grads, state.opt_state, state.params)

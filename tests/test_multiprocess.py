@@ -132,6 +132,8 @@ from hydragnn_tpu.api import run_prediction, run_training
 from hydragnn_tpu.data.synthetic import deterministic_graph_data
 
 config = make_config("GIN", False, workdir, num_epoch=30)
+# pod-scale ZeRO-1: optimizer-state leaves shard over the global mesh
+config["NeuralNetwork"]["Training"]["Optimizer"]["use_zero_redundancy"] = True
 samples = deterministic_graph_data(number_configurations=300, seed=0)
 log_dir = os.path.join(workdir, "logs/")
 model, state, history, full_config = run_training(
@@ -167,7 +169,31 @@ rmse = float(error_rmse_task[0])
 mae = float(np.mean(np.abs(true_values[0] - predicted_values[0])))
 assert rmse < 0.35, f"RMSE {rmse}"
 assert mae < 0.30, f"MAE {mae}"
-print(f"rank {rank}: TRAIN-OK rmse={rmse:.4f} mae={mae:.4f}")
+
+# the replicated (non-ZeRO) multi-host step must also run and keep the
+# pinned layout (params host-readable after the update)
+from hydragnn_tpu.api import prepare_loaders_and_config
+from hydragnn_tpu.parallel import make_multihost_mesh, make_sharded_train_step, place_state
+from hydragnn_tpu.train import create_train_state, select_optimizer
+
+config3 = make_config("GIN", False, workdir, num_epoch=1)
+samples3 = deterministic_graph_data(number_configurations=300, seed=0)
+tl3, _, _, config3 = prepare_loaders_and_config(config3, samples3, device_stack=2)
+mesh3 = make_multihost_mesh(per_process=2)
+tl3.set_global_mesh(mesh3)
+tx3 = select_optimizer({"Optimizer": {"type": "SGD", "learning_rate": 0.001}})
+variables3 = {
+    "params": jax.device_get(state.params),
+    "batch_stats": jax.device_get(state.batch_stats),
+}
+st3 = place_state(mesh3, create_train_state(variables3, tx3), zero1=False)
+step3 = make_sharded_train_step(model, tx3, mesh3, zero1=False)
+st3, loss3, _ = step3(st3, next(iter(tl3)))
+assert np.isfinite(float(loss3)), float(loss3)
+_ = np.concatenate(
+    [np.asarray(l).reshape(-1) for l in jax.tree_util.tree_leaves(st3.params)]
+)
+print(f"rank {rank}: TRAIN-OK rmse={rmse:.4f} mae={mae:.4f} rep-step={float(loss3):.4f}")
 """
 
 

@@ -143,3 +143,24 @@ def pytest_sharded_eval_with_outputs(dp_problem):
     # node head values cover real nodes
     assert trues[1].shape == preds[1].shape
     assert trues[1].shape[0] > trues[0].shape[0]
+
+def pytest_sharded_remat_matches_plain(dp_problem):
+    """remat=True on the sharded step is numerically a no-op."""
+    cfg, model, variables, loader = dp_problem
+    mesh = make_mesh(D)
+    tx = select_optimizer({"Optimizer": {"type": "AdamW", "learning_rate": 0.01}})
+    stacked = next(iter(loader))
+
+    results = []
+    for remat in (False, True):
+        state = place_state(mesh, create_train_state(variables, tx))
+        step = make_sharded_train_step(model, tx, mesh, remat=remat)
+        state, loss, _ = step(state, stacked)
+        results.append((float(loss), jax.device_get(state.params)))
+    assert np.isfinite(results[0][0])
+    np.testing.assert_allclose(results[0][0], results[1][0], rtol=1e-6)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(results[0][1]),
+        jax.tree_util.tree_leaves(results[1][1]),
+    ):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)

@@ -325,3 +325,29 @@ def pytest_print_peak_memory_smoke(capsys):
     else:
         assert peak >= 0
         assert "peak device memory" in out
+
+
+def pytest_remat_step_matches_plain(small_problem):
+    """Training.remat trades FLOPs for memory; it must be numerically a
+    no-op: one rematerialized step produces the same loss and parameter
+    update as the plain step."""
+    import jax
+
+    cfg, model, variables, example = small_problem
+    tx = select_optimizer({"Optimizer": {"type": "AdamW", "learning_rate": 0.01}})
+
+    results = []
+    for remat in (False, True):
+        state = create_train_state(variables, tx, seed=0)
+        step = make_train_step(model, tx, remat=remat)
+        state, loss, tasks = step(state, example)
+        results.append((float(loss), state.params))
+    assert np.isfinite(results[0][0])
+    np.testing.assert_allclose(results[0][0], results[1][0], rtol=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7
+        ),
+        results[0][1],
+        results[1][1],
+    )
