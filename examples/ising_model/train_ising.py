@@ -23,6 +23,10 @@ import numpy as np
 _here = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, _here)
 sys.path.insert(0, os.path.dirname(os.path.dirname(_here)))  # repo root (no-install runs)
+
+from hydragnn_tpu.utils.platform import pin_platform_from_env
+
+pin_platform_from_env()  # honor JAX_PLATFORMS even under plugin images
 from create_configurations import create_dataset
 
 import hydragnn_tpu

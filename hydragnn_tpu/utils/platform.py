@@ -1,0 +1,41 @@
+"""Make the ``JAX_PLATFORMS`` env var actually effective.
+
+Some images register an accelerator PJRT plugin from ``sitecustomize``
+that wins over the env var, silently landing "CPU" runs on the real
+device (observed with the tunneled-TPU image this project develops on).
+Pinning the config before first backend use restores the documented env
+semantics; example drivers and subprocess tests call this at startup so
+``JAX_PLATFORMS=cpu python driver.py`` means what it says.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def pin_platform_from_env() -> None:
+    """If ``JAX_PLATFORMS`` is set, pin it via ``jax.config`` and verify
+    the backend actually honors it. Callers should invoke this before any
+    other jax use; if the backend initialized first (pin arrives too
+    late) the mismatch is loudly reported instead of silently landing the
+    run on the wrong device — the exact failure this module prevents."""
+    plat = os.environ.get("JAX_PLATFORMS")
+    if not plat:
+        return
+    import sys
+
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", plat)
+    except RuntimeError:
+        pass  # backend already up; the check below reports the mismatch
+    want = plat.split(",")[0].strip().lower()
+    got = jax.default_backend().lower()
+    if got != want:
+        print(
+            f"WARNING: JAX_PLATFORMS={plat!r} requested but the jax backend "
+            f"is {got!r} — the platform was pinned after backend "
+            "initialization; call pin_platform_from_env() earlier",
+            file=sys.stderr,
+        )
