@@ -75,6 +75,11 @@ class ModelConfig:
     num_gaussians: Optional[int] = None
     num_filters: Optional[int] = None
     radius: Optional[float] = None
+    # SchNet: rebuild the interaction graph inside the forward pass from
+    # positions (the reference's RadiusInteractionGraph, SCFStack.py:63-76)
+    # instead of consuming host-precomputed edges. Static-shape neighbor
+    # search; see ops/dynamic_radius.py for the O(N^2) trade.
+    inforward_radius: bool = False
     freeze_conv: bool = False
     initial_bias: Optional[float] = None
     # SyncBatchNorm equivalent: name of the mapped device axis to psum
@@ -97,6 +102,12 @@ class ModelConfig:
             )
         if self.node_head_type == "mlp_per_node" and not self.num_nodes:
             raise ValueError("num_nodes must be positive integer for mlp_per_node")
+        if self.inforward_radius and (self.radius is None or self.max_neighbours is None):
+            # an implicit cap default would silently diverge from the
+            # (uncapped-by-default) host pipeline's edge set
+            raise ValueError(
+                "radius_graph_in_forward requires explicit radius and max_neighbours"
+            )
         if self.model_type == "CGCNN" and self.hidden_dim != self.input_dim:
             raise ValueError("CGCNN preserves width: hidden_dim must equal input_dim")
         if self.model_type == "CGCNN" and self.node_head_type == "conv" and "node" in self.output_type:
@@ -174,6 +185,35 @@ class HydraModel(nn.Module):
         edge_attr = batch.edge_attr if cfg.use_edge_attr else None
         edge_weight = None
         if cfg.model_type == "SchNet":
+            if cfg.inforward_radius:
+                if batch.pos is None:
+                    raise ValueError(
+                        "radius_graph_in_forward requires node positions; "
+                        "this batch has pos=None"
+                    )
+                # in-forward interaction graph (reference: SCFStack.py:74
+                # RadiusInteractionGraph) — nearest-K within the cutoff,
+                # rebuilt from positions on every forward
+                from hydragnn_tpu.ops.dynamic_radius import radius_graph_in_forward
+
+                senders, receivers, edge_weight, edge_mask = radius_graph_in_forward(
+                    batch.pos,
+                    batch.node_graph,
+                    batch.node_mask,
+                    cfg.radius,
+                    cfg.max_neighbours,
+                )
+                edge_attr = C.gaussian_smearing(
+                    edge_weight, 0.0, cfg.radius, cfg.num_gaussians
+                )
+                return C.EdgeContext(
+                    senders=senders,
+                    receivers=receivers,
+                    edge_mask=edge_mask,
+                    node_mask=batch.node_mask,
+                    edge_attr=edge_attr,
+                    edge_weight=edge_weight,
+                )
             if cfg.use_edge_attr and batch.edge_attr is not None:
                 edge_weight = jnp.linalg.norm(batch.edge_attr, axis=-1)
             elif batch.pos is not None:

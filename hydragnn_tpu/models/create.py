@@ -43,6 +43,13 @@ def model_config_from_dict(
         pna_lin, pna_log = avg_degree_stats(arch["pna_deg"])
 
     model_type = arch["model_type"]
+    if arch.get("radius_graph_in_forward") and arch.get("periodic_boundary_conditions"):
+        # the in-forward builder is plain Euclidean; silently dropping
+        # cross-boundary images would train on physically wrong graphs
+        raise ValueError(
+            "radius_graph_in_forward does not support periodic_boundary_conditions; "
+            "use host-precomputed edges for PBC datasets"
+        )
     input_dim = int(arch["input_dim"])
     hidden_dim = int(arch["hidden_dim"])
     if model_type == "CGCNN":
@@ -76,6 +83,7 @@ def model_config_from_dict(
         num_gaussians=arch.get("num_gaussians"),
         num_filters=arch.get("num_filters"),
         radius=arch.get("radius"),
+        inforward_radius=bool(arch.get("radius_graph_in_forward", False)),
         freeze_conv=bool(arch.get("freeze_conv_layers", False)),
         initial_bias=arch.get("initial_bias"),
         bn_axis_name=bn_axis_name if arch.get("SyncBatchNorm") else None,

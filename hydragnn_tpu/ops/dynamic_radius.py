@@ -1,0 +1,71 @@
+"""Jittable in-forward radius graph for SchNet.
+
+The reference SchNet stack rebuilds its interaction graph INSIDE the
+forward pass from node positions (reference: hydragnn/models/SCFStack.py:
+63-76, ``RadiusInteractionGraph(radius, max_neighbours)``). Dynamic
+neighbor search with data-dependent edge counts does not jit; this is the
+static-shape equivalent: every node gets exactly ``max_neighbours`` edge
+slots, filled with its nearest same-graph neighbors within the cutoff and
+masked beyond, so the edge buffer is [N*K] with a boolean mask instead of
+a ragged [E].
+
+Semantics match the host-side cell-list builder
+(hydragnn_tpu/data/radius_graph.py): per-receiver nearest-K cap, no
+self-loops, receiver-major ordering (receivers ascending — segment ops
+downstream see sorted ids).
+
+Cost is the dense [N, N] distance matrix + top_k — O(N^2) in the padded
+node count, the right trade for molecule-scale graphs (the reference only
+uses in-forward graphs for SchNet on molecular data); large-graph runs
+should precompute edges host-side (the default path).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def radius_graph_in_forward(
+    pos: jnp.ndarray,
+    node_graph: jnp.ndarray,
+    node_mask: jnp.ndarray,
+    radius: float,
+    max_neighbours: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Fixed-shape radius graph from positions.
+
+    Args:
+      pos: [N, 3] node positions (padded slots arbitrary).
+      node_graph: [N] graph id per node.
+      node_mask: [N] bool, True on real nodes.
+      radius: cutoff distance.
+      max_neighbours: K edge slots per receiver.
+
+    Returns ``(senders, receivers, dist, edge_mask)``, each [N*K];
+    ``receivers`` is ascending (receiver-major). Masked slots carry
+    ``dist = 2 * radius`` so downstream smearing/cutoff math stays finite.
+    """
+    n = pos.shape[0]
+    k = int(min(max_neighbours, max(n - 1, 1)))
+    pos = pos.astype(jnp.float32)
+    diff = pos[:, None, :] - pos[None, :, :]
+    d2 = jnp.sum(diff * diff, axis=-1)  # [N, N] receiver-major rows
+    ok = (
+        (node_graph[:, None] == node_graph[None, :])
+        & (node_mask[:, None] & node_mask[None, :])
+        & ~jnp.eye(n, dtype=bool)
+        & (d2 <= jnp.asarray(radius, jnp.float32) ** 2)
+    )
+    masked = jnp.where(ok, d2, jnp.inf)
+    neg_d2, idx = jax.lax.top_k(-masked, k)  # nearest k per receiver row
+    edge_mask = jnp.isfinite(neg_d2)
+    receivers = jnp.repeat(jnp.arange(n, dtype=jnp.int32), k)
+    senders = idx.astype(jnp.int32).reshape(-1)
+    dist = jnp.sqrt(jnp.maximum(-neg_d2, 0.0)).reshape(-1)
+    dist = jnp.where(edge_mask.reshape(-1), dist, 2.0 * radius)
+    # masked slots: point the gather at node 0 (contribution zeroed by mask)
+    senders = jnp.where(edge_mask.reshape(-1), senders, 0)
+    return senders, receivers, dist, edge_mask.reshape(-1)

@@ -30,9 +30,11 @@ def pin_platform_from_env() -> None:
         jax.config.update("jax_platforms", plat)
     except RuntimeError:
         pass  # backend already up; the check below reports the mismatch
-    want = plat.split(",")[0].strip().lower()
+    # JAX_PLATFORMS may be a priority list ("tpu,cpu"); any entry is a
+    # legitimate outcome (jax falls back down the list)
+    wants = [p.strip().lower() for p in plat.split(",") if p.strip()]
     got = jax.default_backend().lower()
-    if got != want:
+    if got not in wants:
         print(
             f"WARNING: JAX_PLATFORMS={plat!r} requested but the jax backend "
             f"is {got!r} — the platform was pinned after backend "

@@ -201,3 +201,97 @@ def test_initial_bias():
     model, variables = create_model(cfg, batch)
     bias = variables["params"]["graph_head_0"]["Dense_2"]["bias"]
     np.testing.assert_allclose(np.asarray(bias), 7.5)
+
+
+def test_dynamic_radius_matches_host_builder():
+    """The jittable in-forward radius graph must produce the same edge set
+    as the host cell-list builder (same cutoff, nearest-K cap) on a padded
+    multi-graph batch."""
+    from hydragnn_tpu.data.radius_graph import radius_graph
+    from hydragnn_tpu.ops.dynamic_radius import radius_graph_in_forward
+
+    rng = np.random.RandomState(7)
+    radius, cap = 0.8, 6
+    graphs = []
+    for gi in range(3):
+        n = rng.randint(4, 8)
+        pos = rng.rand(n, 3).astype(np.float32)
+        ei = radius_graph(pos, radius, max_num_neighbors=cap)
+        graphs.append(
+            {
+                "x": rng.rand(n, 2).astype(np.float32),
+                "senders": ei[0].astype(np.int32),
+                "receivers": ei[1].astype(np.int32),
+                "pos": pos,
+                "graph_targets": {"energy": np.array([0.0])},
+                "node_targets": {"charge": np.zeros((n, 1), np.float32)},
+            }
+        )
+    batch = batch_graphs(graphs, n_node_pad=32, n_edge_pad=256, n_graph_pad=4)
+
+    senders, receivers, dist, emask = jax.jit(
+        lambda b: radius_graph_in_forward(
+            b.pos, b.node_graph, b.node_mask, radius, cap
+        )
+    )(batch)
+    got = {
+        (int(s), int(r))
+        for s, r, m in zip(np.asarray(senders), np.asarray(receivers), np.asarray(emask))
+        if m
+    }
+    want = {
+        (int(s), int(r))
+        for s, r, m in zip(
+            np.asarray(batch.senders), np.asarray(batch.receivers), np.asarray(batch.edge_mask)
+        )
+        if m
+    }
+    assert got == want
+    # distances on real slots must match the geometry
+    pos = np.asarray(batch.pos)
+    for s, r, d, m in zip(
+        np.asarray(senders), np.asarray(receivers), np.asarray(dist), np.asarray(emask)
+    ):
+        if m:
+            np.testing.assert_allclose(
+                d, np.linalg.norm(pos[s] - pos[r]), rtol=1e-5, atol=1e-6
+            )
+
+
+def test_schnet_inforward_matches_precomputed():
+    """SchNet with radius_graph_in_forward=True must produce the same
+    outputs as the precomputed-edge path when the host edges were built
+    with the same cutoff and cap."""
+    import dataclasses
+
+    from hydragnn_tpu.data.radius_graph import radius_graph
+
+    rng = np.random.RandomState(11)
+    radius, cap = 0.8, 6
+    graphs = []
+    for gi in range(3):
+        n = rng.randint(4, 8)
+        pos = rng.rand(n, 3).astype(np.float32)
+        ei = radius_graph(pos, radius, max_num_neighbors=cap)
+        graphs.append(
+            {
+                "x": rng.rand(n, 2).astype(np.float32),
+                "senders": ei[0].astype(np.int32),
+                "receivers": ei[1].astype(np.int32),
+                "pos": pos,
+                "graph_targets": {"energy": np.array([rng.rand()])},
+                "node_targets": {"charge": rng.rand(n, 1).astype(np.float32)},
+            }
+        )
+    batch = batch_graphs(graphs, n_node_pad=32, n_edge_pad=256, n_graph_pad=4)
+
+    cfg = make_cfg("SchNet")
+    cfg = dataclasses.replace(cfg, radius=radius, max_neighbours=cap)
+    cfg_dyn = dataclasses.replace(cfg, inforward_radius=True)
+
+    model, variables = create_model(cfg, batch)
+    model_dyn = HydraModel(cfg_dyn)
+    out_static = model.apply(variables, batch, train=False)
+    out_dyn = model_dyn.apply(variables, batch, train=False)
+    for a, b in zip(out_static, out_dyn):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5)

@@ -124,3 +124,17 @@ def pytest_matrix_vector_output(tmp_path):
     mae = float(np.mean(np.abs(true_values[0] - predicted_values[0])))
     assert mae < 0.15
     assert true_values[0].shape[-1] == 2  # genuinely a vector head
+
+
+def pytest_matrix_schnet_inforward_radius(tmp_path):
+    """SchNet with the in-forward interaction graph (the reference's
+    RadiusInteractionGraph mode, SCFStack.py:63-76) trains to the same
+    thresholds as the precomputed-edge path."""
+
+    def mutate(config):
+        _ref_budget(config)
+        config["NeuralNetwork"]["Architecture"]["radius_graph_in_forward"] = True
+
+    unittest_train_model(
+        "SchNet", False, tmp_path, num_epoch=_EPOCHS, mutate=mutate
+    )
