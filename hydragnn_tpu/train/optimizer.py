@@ -78,12 +78,36 @@ def select_optimizer(
             tx = optax.chain(tx, optax.masked(optax.set_to_zero(), _frozen_conv_mask))
         return tx
 
-    return optax.inject_hyperparams(make)(learning_rate=lr)
+    tx = optax.inject_hyperparams(make)(learning_rate=lr)
+
+    # Training.grad_accum_steps: average gradients over k micro-batches
+    # before each parameter update (effective batch = k x batch_size) —
+    # a memory lever for large padded graphs. Absent from the reference
+    # (SURVEY §2.2 "explicitly absent: gradient accumulation").
+    accum = int(training_config.get("grad_accum_steps", 1))
+    if accum > 1:
+        tx = optax.MultiSteps(tx, every_k_schedule=accum).gradient_transformation()
+    return tx
+
+
+def _hyperparam_state(opt_state):
+    """Walk wrapper states (e.g. MultiSteps) down to the
+    inject_hyperparams state that owns the dynamic learning rate."""
+    s = opt_state
+    while not hasattr(s, "hyperparams"):
+        if hasattr(s, "inner_opt_state"):
+            s = s.inner_opt_state
+        else:
+            raise AttributeError(
+                f"no hyperparams state found in {type(opt_state).__name__}"
+            )
+    return s
 
 
 def current_learning_rate(opt_state) -> float:
-    """Read the dynamic learning rate out of an inject_hyperparams state."""
-    return float(opt_state.hyperparams["learning_rate"])
+    """Read the dynamic learning rate out of an inject_hyperparams state
+    (possibly nested under gradient-accumulation wrappers)."""
+    return float(_hyperparam_state(opt_state).hyperparams["learning_rate"])
 
 
 def set_learning_rate(opt_state, lr: float):
@@ -91,6 +115,14 @@ def set_learning_rate(opt_state, lr: float):
     the next jitted step picks it up as a donated input, no recompile)."""
     import jax.numpy as jnp
 
-    hyper = dict(opt_state.hyperparams)
-    hyper["learning_rate"] = jnp.asarray(lr, dtype=jnp.asarray(hyper["learning_rate"]).dtype)
-    return opt_state._replace(hyperparams=hyper)
+    if hasattr(opt_state, "hyperparams"):
+        hyper = dict(opt_state.hyperparams)
+        hyper["learning_rate"] = jnp.asarray(
+            lr, dtype=jnp.asarray(hyper["learning_rate"]).dtype
+        )
+        return opt_state._replace(hyperparams=hyper)
+    if hasattr(opt_state, "inner_opt_state"):
+        return opt_state._replace(
+            inner_opt_state=set_learning_rate(opt_state.inner_opt_state, lr)
+        )
+    raise AttributeError(f"no hyperparams state found in {type(opt_state).__name__}")
