@@ -64,28 +64,58 @@ def main() -> None:
         import jax.numpy as jnp
 
         compute_dtype = jnp.bfloat16
-    step = make_train_step(model, tx, compute_dtype=compute_dtype)
-
-    batches = list(loader)
-    if not batches:
-        raise RuntimeError("empty bench loader")
     graphs_per_batch = batch_size
 
-    # compile + warmup
-    state, loss, _ = step(state, batches[0])
-    jax.block_until_ready(loss)
+    if os.environ.get("BENCH_SCAN", "0") == "1":
+        # whole-epoch lax.scan dispatch (Training.scan_epoch path): one
+        # host->device round trip per epoch instead of per step. Off by
+        # default: on the tunneled bench chip the scan executable hits a
+        # server-side ~0.5s/dispatch pathology (the same step body
+        # dispatched per-step is ~0.6 ms), so the per-step path measures
+        # reliably there; on directly-attached pods scan amortizes
+        # dispatch latency and is the faster mode.
+        import jax.numpy as jnp
 
-    done = 0
-    t0 = time.perf_counter()
-    while done < measure_steps:
-        for b in batches:
-            state, loss, _ = step(state, b)
-            done += 1
-            if done >= measure_steps:
-                break
-    jax.block_until_ready(loss)
-    dt = time.perf_counter() - t0
-    graphs_per_sec = done * graphs_per_batch / dt
+        from hydragnn_tpu.train import make_scan_epoch
+
+        scan_fn = make_scan_epoch(model, tx, compute_dtype=compute_dtype)
+        nb = len(loader)
+        if nb == 0:
+            raise RuntimeError("empty bench loader")
+        stacked = loader.stacked_device_batches()
+        order = jnp.arange(nb, dtype=jnp.int32)
+        state, losses, _, _ = scan_fn(state, stacked, order)  # compile
+        jax.block_until_ready(losses)
+        done = 0
+        t0 = time.perf_counter()
+        while done < measure_steps:
+            state, losses, _, _ = scan_fn(state, stacked, order)
+            done += nb
+        jax.block_until_ready(losses)
+        dt = time.perf_counter() - t0
+        graphs_per_sec = done * graphs_per_batch / dt
+    else:
+        step = make_train_step(model, tx, compute_dtype=compute_dtype)
+
+        batches = list(loader)
+        if not batches:
+            raise RuntimeError("empty bench loader")
+
+        # compile + warmup
+        state, loss, _ = step(state, batches[0])
+        jax.block_until_ready(loss)
+
+        done = 0
+        t0 = time.perf_counter()
+        while done < measure_steps:
+            for b in batches:
+                state, loss, _ = step(state, b)
+                done += 1
+                if done >= measure_steps:
+                    break
+        jax.block_until_ready(loss)
+        dt = time.perf_counter() - t0
+        graphs_per_sec = done * graphs_per_batch / dt
 
     baseline = None
     for fname in ("BENCH_r1.json", "BENCH_BASELINE.json"):

@@ -123,6 +123,7 @@ class GraphLoader:
                 ) from None
         self.prefetch = prefetch
         self._cached_batches: Optional[List[GraphBatch]] = None
+        self._stacked: Optional[GraphBatch] = None
         self._sharding = None
         self._global_mesh = None
         self._epoch = 0
@@ -142,8 +143,9 @@ class GraphLoader:
         data mesh for device_stack > 1, so cached batches live on their
         target devices instead of being resharded from device 0 each step).
         Must be set before the first iteration builds the cache."""
-        if self._cached_batches is not None and sharding is not self._sharding:
+        if sharding is not self._sharding:
             self._cached_batches = None  # rebuild with the new placement
+            self._stacked = None
         self._sharding = sharding
 
     def set_global_mesh(self, mesh) -> None:
@@ -151,8 +153,9 @@ class GraphLoader:
         into global jax.Arrays sharded over ``mesh``'s data axis (leading
         axis = device_stack × process_count). The assembly runs in the
         prefetch thread so cross-host batch formation overlaps compute."""
-        if self._cached_batches is not None and mesh is not self._global_mesh:
+        if mesh is not self._global_mesh:
             self._cached_batches = None
+            self._stacked = None
         self._global_mesh = mesh
 
     def __len__(self) -> int:
@@ -283,6 +286,22 @@ class GraphLoader:
 
     def num_graphs_total(self) -> int:
         return len(self.samples)
+
+    def stacked_device_batches(self) -> GraphBatch:
+        """Every batch of an epoch stacked on a new leading axis [B, ...]
+        and placed on device — the input for the scan-over-epoch train
+        path (train.state.make_scan_epoch). Batch membership is fixed
+        (like ``cache_device_batches``); per-epoch shuffling happens
+        device-side by permuting the batch axis. Built once and cached."""
+        if self._stacked is None:
+            bs = self.batch_size
+            base = np.arange(len(self.samples))
+            host = [
+                self._make_batch(base[b * bs : (b + 1) * bs]) for b in range(len(self))
+            ]
+            stacked = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *host)
+            self._stacked = jax.device_put(stacked, self._sharding)
+        return self._stacked
 
 
 def _mask_out(batch: GraphBatch) -> GraphBatch:

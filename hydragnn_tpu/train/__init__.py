@@ -6,6 +6,7 @@ from hydragnn_tpu.train.optimizer import (
 from hydragnn_tpu.train.state import (
     TrainState,
     create_train_state,
+    make_scan_epoch,
     make_train_step,
     make_eval_step,
     make_stats_step,

@@ -39,6 +39,7 @@ from hydragnn_tpu.train.optimizer import current_learning_rate, set_learning_rat
 from hydragnn_tpu.train.state import (
     TrainState,
     make_eval_step,
+    make_scan_epoch,
     make_stats_step,
     make_train_step,
 )
@@ -109,11 +110,25 @@ def _reduce_mean_across_processes(values: np.ndarray) -> np.ndarray:
     return values
 
 
+def _finalize_weighted(
+    weighted_losses, weighted_tasks, counts
+) -> Tuple[float, np.ndarray]:
+    """Count-weighted mean of per-batch metrics (already multiplied by
+    their counts), mean-reduced across processes — the reference's
+    num_graphs weighting + all-reduce
+    (train_validate_test.py:284-289,364-367)."""
+    total = max(float(jnp.stack(counts).sum()), 1.0)
+    avg_loss = float(jnp.stack(weighted_losses).sum()) / total
+    avg_tasks = np.asarray(jnp.stack(weighted_tasks).sum(axis=0)) / total
+    avg_loss = float(_reduce_mean_across_processes(np.asarray([avg_loss]))[0])
+    avg_tasks = _reduce_mean_across_processes(avg_tasks)
+    return avg_loss, avg_tasks
+
+
 class _MetricAccum:
     """Accumulates per-batch (loss, tasks) weighted by the real graph count
     as device scalars (no per-batch D2H sync); ``finalize`` materializes
-    once and mean-reduces across processes (the reference's num_graphs
-    weighting + all-reduce, train_validate_test.py:284-289,364-367)."""
+    once via ``_finalize_weighted``."""
 
     def __init__(self):
         self._losses: List[jnp.ndarray] = []
@@ -126,12 +141,7 @@ class _MetricAccum:
         self._counts.append(n)
 
     def finalize(self) -> Tuple[float, np.ndarray]:
-        total = max(float(jnp.stack(self._counts).sum()), 1.0)
-        avg_loss = float(jnp.stack(self._losses).sum()) / total
-        avg_tasks = np.asarray(jnp.stack(self._tasks).sum(axis=0)) / total
-        avg_loss = float(_reduce_mean_across_processes(np.asarray([avg_loss]))[0])
-        avg_tasks = _reduce_mean_across_processes(avg_tasks)
-        return avg_loss, avg_tasks
+        return _finalize_weighted(self._losses, self._tasks, self._counts)
 
 
 def train_epoch(
@@ -145,6 +155,30 @@ def train_epoch(
         if profiler is not None:
             profiler.step()
     avg_loss, avg_tasks = acc.finalize()
+    return state, avg_loss, avg_tasks
+
+
+def train_epoch_scan(
+    loader, state: TrainState, scan_fn, epoch: int
+) -> Tuple[TrainState, float, np.ndarray]:
+    """One training epoch as a single device dispatch (``Training.
+    scan_epoch``): lax.scan over the loader's device-resident stacked
+    batches, shuffled device-side by an epoch-seeded permutation of the
+    batch axis. Same weighted-metric semantics as ``train_epoch``."""
+    stacked = loader.stacked_device_batches()
+    nb = len(loader)
+    if loader.shuffle:
+        order = np.random.default_rng(loader.seed + epoch).permutation(nb)
+    else:
+        order = np.arange(nb)
+    state, losses, tasks, counts = scan_fn(
+        state, stacked, jnp.asarray(order, dtype=jnp.int32)
+    )
+    avg_loss, avg_tasks = _finalize_weighted(
+        [(losses * counts).sum()],
+        [(tasks * counts[:, None]).sum(axis=0)],
+        [counts.sum()],
+    )
     return state, avg_loss, avg_tasks
 
 
@@ -274,6 +308,17 @@ def train_validate_test(
     compute_dtype = (
         jnp.bfloat16 if training.get("mixed_precision") else None
     )
+    # Training.scan_epoch: whole-epoch lax.scan dispatch (single-device
+    # path only — sharded callers pass their own train_step). Requires the
+    # train split stacked in HBM; per-step profiler hooks don't fire.
+    scan_fn = None
+    if training.get("scan_epoch") and train_step is None:
+        scan_fn = make_scan_epoch(
+            model,
+            tx,
+            compute_dtype=compute_dtype,
+            remat=bool(training.get("remat", False)),
+        )
     train_step = train_step or make_train_step(
         model, tx, compute_dtype=compute_dtype, remat=bool(training.get("remat", False))
     )
@@ -342,9 +387,14 @@ def train_validate_test(
         # the profiler context closes an in-flight trace at epoch end even
         # when the epoch has fewer steps than its schedule expects
         with (profiler if profiler is not None else contextlib.nullcontext()):
-            state, train_loss, train_tasks = train_epoch(
-                train_loader, state, train_step, verbosity, profiler=profiler
-            )
+            if scan_fn is not None:
+                state, train_loss, train_tasks = train_epoch_scan(
+                    train_loader, state, scan_fn, epoch
+                )
+            else:
+                state, train_loss, train_tasks = train_epoch(
+                    train_loader, state, train_step, verbosity, profiler=profiler
+                )
         val_loss, val_tasks = evaluate_epoch(val_loader, state, eval_step, verbosity)
         collect = plot_hist_solution and visualizer is not None
         test_loss, test_tasks, true_values, predicted_values = test_epoch(

@@ -57,24 +57,14 @@ def _cast_floats(tree: Any, dtype) -> Any:
     )
 
 
-def make_train_step(
+def _train_step_body(
     model: HydraModel,
     tx: optax.GradientTransformation,
     compute_dtype=None,
     remat: bool = False,
 ) -> Callable[[TrainState, GraphBatch], Tuple[TrainState, jnp.ndarray, jnp.ndarray]]:
-    """Returns jitted ``(state, batch) -> (state, loss, tasks_loss)``.
-
-    ``compute_dtype=jnp.bfloat16`` enables mixed precision: params and
-    batch features are cast to bf16 for the forward/backward (MXU-native
-    on TPU), while the master params, optimizer state, BatchNorm
-    statistics, and the loss stay float32.
-
-    ``remat=True`` (config ``Training.remat``) checkpoints the forward:
-    activations are recomputed during the backward pass instead of held in
-    HBM — the standard FLOPs-for-memory trade for deep conv stacks or
-    large padded graphs. No reference analog (torch would use
-    ``torch.utils.checkpoint``; the reference never does)."""
+    """The un-jitted per-batch training body shared by the jitted
+    single-step path and the scan-over-epoch path."""
 
     def step(state: TrainState, batch: GraphBatch):
         rng, dropout_rng = jax.random.split(state.rng)
@@ -112,7 +102,70 @@ def make_train_step(
         )
         return new_state, loss, tasks
 
-    return jax.jit(step, donate_argnums=(0,))
+    return step
+
+
+def make_train_step(
+    model: HydraModel,
+    tx: optax.GradientTransformation,
+    compute_dtype=None,
+    remat: bool = False,
+) -> Callable[[TrainState, GraphBatch], Tuple[TrainState, jnp.ndarray, jnp.ndarray]]:
+    """Returns jitted ``(state, batch) -> (state, loss, tasks_loss)``.
+
+    ``compute_dtype=jnp.bfloat16`` enables mixed precision: params and
+    batch features are cast to bf16 for the forward/backward (MXU-native
+    on TPU), while the master params, optimizer state, BatchNorm
+    statistics, and the loss stay float32.
+
+    ``remat=True`` (config ``Training.remat``) checkpoints the forward:
+    activations are recomputed during the backward pass instead of held in
+    HBM — the standard FLOPs-for-memory trade for deep conv stacks or
+    large padded graphs. No reference analog (torch would use
+    ``torch.utils.checkpoint``; the reference never does)."""
+    return jax.jit(
+        _train_step_body(model, tx, compute_dtype=compute_dtype, remat=remat),
+        donate_argnums=(0,),
+    )
+
+
+def make_scan_epoch(
+    model: HydraModel,
+    tx: optax.GradientTransformation,
+    compute_dtype=None,
+    remat: bool = False,
+) -> Callable[..., Tuple[TrainState, jnp.ndarray, jnp.ndarray, jnp.ndarray]]:
+    """Whole-epoch training as ONE dispatch: ``lax.scan`` of the train
+    step over device-resident stacked batches.
+
+    Per-step dispatch costs a host->device round trip (~0.6 ms through a
+    tunneled chip — comparable to the flagship's entire step compute);
+    scanning the epoch inside one jitted program amortizes it to one
+    dispatch per epoch. Requires every batch of the epoch stacked on a
+    leading axis and resident in HBM (GraphLoader.stacked_device_batches),
+    so it suits datasets that fit on-device; the streaming per-step path
+    remains the default.
+
+    Returns jitted ``(state, stacked_batches, order) -> (state, losses[B],
+    tasks[B, H], counts[B])`` where ``order`` is an int32 permutation of
+    the batch axis (the per-epoch reshuffle, device-side gather) and
+    ``counts`` the real-graph count per batch for weighted averaging.
+    """
+    body = _train_step_body(model, tx, compute_dtype=compute_dtype, remat=remat)
+
+    def epoch(state: TrainState, stacked: GraphBatch, order: jnp.ndarray):
+        # Scan over the PERMUTATION, dynamic-indexing one batch out of the
+        # closed-over stack per iteration: a full permuted copy of the
+        # train split as scan xs would double the feature's HBM footprint.
+        def scan_body(state: TrainState, i: jnp.ndarray):
+            batch = jax.tree_util.tree_map(lambda x: x[i], stacked)
+            new_state, loss, tasks = body(state, batch)
+            return new_state, (loss, tasks, batch.graph_mask.sum().astype(jnp.float32))
+
+        state, (losses, tasks, counts) = jax.lax.scan(scan_body, state, order)
+        return state, losses, tasks, counts
+
+    return jax.jit(epoch, donate_argnums=(0,))
 
 
 def make_stats_step(model: HydraModel) -> Callable[[TrainState, GraphBatch], TrainState]:
