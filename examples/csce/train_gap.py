@@ -103,7 +103,17 @@ def datasets_load(datafile, sampling=None, seed=None, frac=(0.94, 0.02, 0.04)):
             values_all.append([float(row[-2])])
     print("Total:", len(smiles_all), len(values_all))
     n = len(smiles_all)
-    ix = np.split(np.arange(n), [int(frac[0] * n), int((frac[0] + frac[1]) * n)])
+    if n < 3:
+        raise SystemExit(
+            f"datafile yielded only {n} molecules"
+            + (f" at sampling={sampling}" if sampling is not None else "")
+            + "; need >= 3 for train/val/test splits"
+        )
+    # every split must be non-empty for the container write + training:
+    # clamp the cut points to 1 <= lo < hi < n
+    lo = min(max(int(frac[0] * n), 1), max(n - 2, 1))
+    hi = min(max(int((frac[0] + frac[1]) * n), lo + 1), max(n - 1, lo + 1))
+    ix = np.split(np.arange(n), [lo, hi])
     return (
         [[smiles_all[i] for i in part] for part in ix],
         [np.asarray([values_all[i] for i in part], dtype=np.float32) for part in ix],

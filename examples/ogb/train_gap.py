@@ -106,14 +106,24 @@ def datasets_load(datafile: str, sampling=None, seed=None):
     rng = np.random.default_rng(seed)
     smiles = {"train": [], "val": [], "test": []}
     values = {"train": [], "val": [], "test": []}
+    first = {}  # per-split fallback so heavy sampling can't empty a split
     with open(datafile) as f:
         reader = csv.reader(f)
         next(reader)
+        # one rng draw per row in file order (seed-for-seed parity with
+        # the reference sampling, reference ogb train_gap.py:80-113);
+        # memory stays proportional to the KEPT sample
         for row in reader:
+            split, s, v = row[1], row[0], [float(row[-1])]
+            first.setdefault(split, (s, v))
             if sampling is not None and rng.random() > sampling:
                 continue
-            smiles[row[1]].append(row[0])
-            values[row[1]].append([float(row[-1])])
+            smiles[split].append(s)
+            values[split].append(v)
+    for split, (s, v) in first.items():
+        if not smiles[split]:
+            smiles[split].append(s)
+            values[split].append(v)
     return ([smiles[k] for k in ("train", "val", "test")],
             [np.asarray(values[k], dtype=np.float32) for k in ("train", "val", "test")])
 
