@@ -38,8 +38,26 @@ def pytest_examples_train(subdir, script, args):
     _run_example(subdir, script, *args)
 
 
-def pytest_example_ising_preonly_then_train(tmp_path):
-    """The container (preonly) pipeline end to end on the smallest lattice."""
-    _run_example("ising_model", "train_ising.py", "--preonly", "--natom", "2",
-                 "--cutoff", "6")
-    _run_example("ising_model", "train_ising.py", "--natom", "2", "--cutoff", "6")
+@pytest.mark.parametrize(
+    "subdir,script,args",
+    [
+        ("ising_model", "train_ising.py", ["--natom", "2", "--cutoff", "6"]),
+        ("lsms", "lsms.py", ["--nconfig", "40"]),
+        ("eam", "eam.py", ["--nconfig", "30"]),
+        ("ogb", "train_gap.py", ["--sampling", "0.05"]),
+        ("csce", "train_gap.py", ["--sampling", "0.2"]),
+    ],
+)
+def pytest_example_preonly_then_train(subdir, script, args):
+    """Container (--preonly) pipelines of the scalable-data examples end
+    to end on their synthetic fallbacks, incl. heavy sampling that must
+    not empty a split (reference pipeline shape:
+    examples/ogb/train_gap.py:238-378)."""
+    import shutil
+
+    # drivers skip synthetic generation when raw data already exists;
+    # clear it so the tiny test sizes actually take effect
+    shutil.rmtree(os.path.join(_REPO, "examples", subdir, "dataset"),
+                  ignore_errors=True)
+    _run_example(subdir, script, "--preonly", *args)
+    _run_example(subdir, script, *args)
