@@ -4,6 +4,7 @@ example runs offline on its synthetic fallback dataset with tiny sizes.
 """
 
 import os
+import shutil
 import subprocess
 import sys
 
@@ -12,9 +13,24 @@ import pytest
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _run_example(subdir: str, script: str, *args: str) -> None:
-    path = os.path.join(_REPO, "examples", subdir)
-    env = dict(os.environ, JAX_PLATFORMS="cpu")
+def _example_copy(subdir: str, tmp_path) -> str:
+    """Hermetic working copy of the example dir: runs never touch (or
+    depend on) datasets/artifacts in the repo tree — a developer's real
+    downloaded data under examples/<subdir>/dataset stays untouched and
+    the tiny test sizes always take effect."""
+    dst = os.path.join(str(tmp_path), subdir)
+    if not os.path.isdir(dst):
+        shutil.copytree(
+            os.path.join(_REPO, "examples", subdir),
+            dst,
+            ignore=shutil.ignore_patterns("dataset", "logs", "__pycache__"),
+        )
+    return dst
+
+
+def _run_example(subdir: str, script: str, *args: str, workdir: str = None) -> None:
+    path = workdir or os.path.join(_REPO, "examples", subdir)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=_REPO)
     env.pop("XLA_FLAGS", None)  # single-device run is enough for a smoke test
     ret = subprocess.run(
         [sys.executable, script, *args],
@@ -34,8 +50,8 @@ def _run_example(subdir: str, script: str, *args: str) -> None:
         ("md17", "md17.py", ["--maxframes", "150"]),
     ],
 )
-def pytest_examples_train(subdir, script, args):
-    _run_example(subdir, script, *args)
+def pytest_examples_train(subdir, script, args, tmp_path):
+    _run_example(subdir, script, *args, workdir=_example_copy(subdir, tmp_path))
 
 
 @pytest.mark.parametrize(
@@ -48,16 +64,12 @@ def pytest_examples_train(subdir, script, args):
         ("csce", "train_gap.py", ["--sampling", "0.2"]),
     ],
 )
-def pytest_example_preonly_then_train(subdir, script, args):
+def pytest_example_preonly_then_train(subdir, script, args, tmp_path):
     """Container (--preonly) pipelines of the scalable-data examples end
     to end on their synthetic fallbacks, incl. heavy sampling that must
     not empty a split (reference pipeline shape:
-    examples/ogb/train_gap.py:238-378)."""
-    import shutil
-
-    # drivers skip synthetic generation when raw data already exists;
-    # clear it so the tiny test sizes actually take effect
-    shutil.rmtree(os.path.join(_REPO, "examples", subdir, "dataset"),
-                  ignore_errors=True)
-    _run_example(subdir, script, "--preonly", *args)
-    _run_example(subdir, script, *args)
+    examples/ogb/train_gap.py:238-378). Both phases share one hermetic
+    working copy (preonly writes the containers the train run reads)."""
+    workdir = _example_copy(subdir, tmp_path)
+    _run_example(subdir, script, "--preonly", *args, workdir=workdir)
+    _run_example(subdir, script, *args, workdir=workdir)
