@@ -7,6 +7,7 @@ from hydragnn_tpu.train.state import (
     TrainState,
     create_train_state,
     make_scan_epoch,
+    make_scan_eval,
     make_train_step,
     make_eval_step,
     make_stats_step,

@@ -40,6 +40,7 @@ from hydragnn_tpu.train.state import (
     TrainState,
     make_eval_step,
     make_scan_epoch,
+    make_scan_eval,
     make_stats_step,
     make_train_step,
 )
@@ -158,6 +159,16 @@ def train_epoch(
     return state, avg_loss, avg_tasks
 
 
+def _finalize_scan(losses, tasks, counts) -> Tuple[float, np.ndarray]:
+    """Weighted finalize for per-batch metric arrays coming out of a
+    scan ([B], [B, H], [B])."""
+    return _finalize_weighted(
+        [(losses * counts).sum()],
+        [(tasks * counts[:, None]).sum(axis=0)],
+        [counts.sum()],
+    )
+
+
 def train_epoch_scan(
     loader, state: TrainState, scan_fn, epoch: int
 ) -> Tuple[TrainState, float, np.ndarray]:
@@ -174,11 +185,7 @@ def train_epoch_scan(
     state, losses, tasks, counts = scan_fn(
         state, stacked, jnp.asarray(order, dtype=jnp.int32)
     )
-    avg_loss, avg_tasks = _finalize_weighted(
-        [(losses * counts).sum()],
-        [(tasks * counts[:, None]).sum(axis=0)],
-        [counts.sum()],
-    )
+    avg_loss, avg_tasks = _finalize_scan(losses, tasks, counts)
     return state, avg_loss, avg_tasks
 
 
@@ -190,6 +197,14 @@ def evaluate_epoch(
         loss, task_losses = eval_step(state, batch)
         acc.add(loss, task_losses, batch.graph_mask.sum())
     return acc.finalize()
+
+
+def evaluate_epoch_scan(loader, state: TrainState, scan_eval_fn) -> Tuple[float, np.ndarray]:
+    """Whole-split evaluation in one dispatch (``Training.scan_epoch``'s
+    eval-side companion); same weighted-metric semantics as
+    ``evaluate_epoch``."""
+    losses, tasks, counts = scan_eval_fn(state, loader.stacked_device_batches())
+    return _finalize_scan(losses, tasks, counts)
 
 
 def test_epoch(
@@ -311,7 +326,7 @@ def train_validate_test(
     # Training.scan_epoch: whole-epoch lax.scan dispatch (single-device
     # path only — sharded callers pass their own train_step). Requires the
     # train split stacked in HBM; per-step profiler hooks don't fire.
-    scan_fn = None
+    scan_fn = scan_eval_fn = None
     if training.get("scan_epoch") and train_step is None:
         scan_fn = make_scan_epoch(
             model,
@@ -319,6 +334,8 @@ def train_validate_test(
             compute_dtype=compute_dtype,
             remat=bool(training.get("remat", False)),
         )
+        if eval_step is None:  # a caller-supplied eval_step keeps priority
+            scan_eval_fn = make_scan_eval(model)
     train_step = train_step or make_train_step(
         model, tx, compute_dtype=compute_dtype, remat=bool(training.get("remat", False))
     )
@@ -395,7 +412,10 @@ def train_validate_test(
                 state, train_loss, train_tasks = train_epoch(
                     train_loader, state, train_step, verbosity, profiler=profiler
                 )
-        val_loss, val_tasks = evaluate_epoch(val_loader, state, eval_step, verbosity)
+        if scan_eval_fn is not None:
+            val_loss, val_tasks = evaluate_epoch_scan(val_loader, state, scan_eval_fn)
+        else:
+            val_loss, val_tasks = evaluate_epoch(val_loader, state, eval_step, verbosity)
         collect = plot_hist_solution and visualizer is not None
         test_loss, test_tasks, true_values, predicted_values = test_epoch(
             test_loader,

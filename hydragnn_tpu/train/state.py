@@ -168,6 +168,30 @@ def make_scan_epoch(
     return jax.jit(epoch, donate_argnums=(0,))
 
 
+def make_scan_eval(
+    model: HydraModel,
+) -> Callable[..., Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]]:
+    """Whole-split evaluation as ONE dispatch: ``lax.scan`` of the eval
+    step over device-resident stacked batches (the eval-side companion of
+    :func:`make_scan_epoch`; same HBM-residency requirement). Returns
+    jitted ``(state, stacked) -> (losses[B], tasks[B, H], counts[B])``."""
+
+    def scan_body(state: TrainState, batch: GraphBatch):
+        outputs = model.apply(
+            {"params": state.params, "batch_stats": state.batch_stats},
+            batch,
+            train=False,
+        )
+        loss, tasks = model_loss(model.cfg, outputs, batch)
+        return state, (loss, jnp.stack(tasks), batch.graph_mask.sum().astype(jnp.float32))
+
+    def evaluate(state: TrainState, stacked: GraphBatch):
+        _, (losses, tasks, counts) = jax.lax.scan(scan_body, state, stacked)
+        return losses, tasks, counts
+
+    return jax.jit(evaluate)
+
+
 def make_stats_step(model: HydraModel) -> Callable[[TrainState, GraphBatch], TrainState]:
     """Jitted BatchNorm-recalibration step: a train-mode forward that
     updates ONLY the running statistics (params untouched, no grads).

@@ -451,3 +451,22 @@ def pytest_scan_epoch_run_training(tmp_path):
     losses = history["train_loss"]
     assert all(np.isfinite(losses))
     assert min(losses) < 0.5 * losses[0], losses
+
+
+def pytest_scan_eval_matches_sequential(small_problem):
+    """One scan-eval dispatch must equal per-batch evaluation."""
+    from hydragnn_tpu.train import make_eval_step
+    from hydragnn_tpu.train.state import make_scan_eval
+    from hydragnn_tpu.train.loop import evaluate_epoch, evaluate_epoch_scan
+
+    cfg, model, variables, _ = small_problem
+    samples = deterministic_graph_data(number_configurations=40, seed=3)
+    train, _, _, _, _ = prepare_dataset(samples, base_config(multihead=False))
+    loader = GraphLoader(train, 8, shuffle=False)
+    tx = select_optimizer({"Optimizer": {"type": "AdamW", "learning_rate": 0.01}})
+    state = create_train_state(variables, tx, seed=0)
+
+    seq_loss, seq_tasks = evaluate_epoch(loader, state, make_eval_step(model))
+    scan_loss, scan_tasks = evaluate_epoch_scan(loader, state, make_scan_eval(model))
+    np.testing.assert_allclose(scan_loss, seq_loss, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(scan_tasks, seq_tasks, rtol=1e-5, atol=1e-6)
