@@ -363,6 +363,31 @@ def train_validate_test(
         "test_tasks": [],
         "lr": [],
     }
+    # Per-epoch checkpointing + exact resume (beyond the reference's
+    # restore-model-and-start-over: epoch index, plateau scheduler, and
+    # early-stop counters survive the restart). The TrainState itself is
+    # restored by the caller via Training.continue/startfrom.
+    ckpt_every = int(training.get("checkpoint_every", 0))
+    start_epoch = 0
+    if training.get("continue") == 1:
+        from hydragnn_tpu.utils.checkpoint import load_train_meta
+
+        if "startfrom" not in training:
+            raise ValueError("Training.continue=1 requires Training.startfrom")
+        meta = load_train_meta(training["startfrom"], log_dir)
+        if meta is not None:
+            # an early-stopped run resumes to a no-op (the stop decision
+            # is honored, not replayed into extra epochs); a completed or
+            # interrupted run continues from its recorded epoch — which
+            # also supports the reference's extend-training workflow
+            # (continue with a larger num_epoch)
+            start_epoch = num_epoch if meta.get("early_stopped") else int(meta["epoch"])
+            scheduler.best = float(meta["scheduler"]["best"])
+            scheduler.num_bad_epochs = int(meta["scheduler"]["num_bad_epochs"])
+            if stopper is not None and "stopper" in meta:
+                stopper.count = int(meta["stopper"]["count"])
+                stopper.min_loss = float(meta["stopper"]["min_loss"])
+            history = meta["history"]
     metrics_path = None
     if jax.process_index() == 0:
         out_dir = os.path.join(log_dir, log_name)
@@ -392,9 +417,32 @@ def train_validate_test(
         )
         visualizer.create_scatter_plots(tv, pv, iepoch=-1)
 
+    def _write_checkpoint(ckpt_state, epoch_next: int, early_stopped: bool) -> None:
+        from hydragnn_tpu.utils.checkpoint import save_model, save_train_meta
+
+        save_model(ckpt_state, log_name, log_dir, verbosity)
+        save_train_meta(
+            {
+                "epoch": epoch_next,
+                "early_stopped": early_stopped,
+                "scheduler": {
+                    "best": scheduler.best,
+                    "num_bad_epochs": scheduler.num_bad_epochs,
+                },
+                "stopper": {
+                    "count": stopper.count if stopper else 0,
+                    "min_loss": stopper.min_loss if stopper else float("inf"),
+                },
+                "history": history,
+            },
+            log_name,
+            log_dir,
+        )
+
     timer = Timer("train_validate_test")
     timer.start()
-    for epoch in range(num_epoch):
+    epochs_done = start_epoch
+    for epoch in range(start_epoch, num_epoch):
         for loader in (train_loader, val_loader, test_loader):
             if hasattr(loader, "set_epoch"):
                 loader.set_epoch(epoch)
@@ -476,7 +524,13 @@ def train_validate_test(
                     + "\n"
                 )
 
-        if stopper is not None and stopper(val_loss):
+        stop = stopper is not None and stopper(val_loss)
+        epochs_done = epoch + 1
+
+        if ckpt_every and (epoch + 1) % ckpt_every == 0:
+            _write_checkpoint(state, epoch + 1, early_stopped=False)
+
+        if stop:
             print_distributed(verbosity, f"Early stopping at epoch {epoch}")
             break
     timer.stop()
@@ -488,6 +542,16 @@ def train_validate_test(
         for _ in range(2):
             for b in train_loader:
                 state = stats_step(state, b)
+
+    # Final checkpoint+meta pair AFTER BN recalibration: the model file
+    # and the loop-state sidecar must describe the same state (a mid-run
+    # meta against the final recalibrated weights would make a later
+    # continue run replay epochs on the wrong state); an early-stopped
+    # run is marked so resume honors the stop instead of training on.
+    if ckpt_every:
+        _write_checkpoint(
+            state, epochs_done, early_stopped=bool(stopper and stopper.count >= stopper.patience)
+        )
 
     writer.flush()
     writer.close()

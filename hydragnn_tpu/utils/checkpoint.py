@@ -73,8 +73,12 @@ def save_model(
     host_state = jax.tree_util.tree_map(_to_host, state)
     if jax.process_index() == 0:
         os.makedirs(os.path.dirname(ckpt_path), exist_ok=True)
-        with open(ckpt_path, "wb") as f:
+        # atomic replace: a crash mid-write (the exact scenario per-epoch
+        # checkpointing exists for) must not destroy the previous good file
+        tmp = ckpt_path + ".tmp"
+        with open(tmp, "wb") as f:
             f.write(serialization.to_bytes(host_state))
+        os.replace(tmp, ckpt_path)
     return ckpt_path
 
 
@@ -108,14 +112,43 @@ def load_existing_model(
     return jax.tree_util.tree_map(_place, state, restored)
 
 
+def save_train_meta(meta: dict, log_name: str, path: str = "./logs/") -> None:
+    """Rank-0 JSON sidecar with host-side training-loop state (epoch,
+    scheduler, early-stop counters, history) so a resumed run continues
+    exactly where it left off. The reference restores only
+    model+optimizer (SURVEY §5: resume "not epoch/scheduler/sampler
+    state"); this closes that gap."""
+    if jax.process_index() != 0:
+        return
+    import json
+
+    out_dir = os.path.join(path, log_name)
+    os.makedirs(out_dir, exist_ok=True)
+    tmp = os.path.join(out_dir, f"{log_name}.meta.json.tmp")
+    with open(tmp, "w") as f:
+        json.dump(meta, f)
+    os.replace(tmp, os.path.join(out_dir, f"{log_name}.meta.json"))
+
+
+def load_train_meta(log_name: str, path: str = "./logs/") -> Optional[dict]:
+    import json
+
+    p = os.path.join(path, log_name, f"{log_name}.meta.json")
+    if not os.path.exists(p):
+        return None
+    with open(p) as f:
+        return json.load(f)
+
+
 def load_existing_model_config(
     state: Any, training_config: dict, path: str = "./logs/"
 ) -> Any:
     """Config-driven continue (reference: model.py:64-67, keys
     ``Training.continue`` and ``Training.startfrom``)."""
     if "continue" in training_config and training_config["continue"] == 1:
-        model_name = training_config["startfrom"]
-        return load_existing_model(state, model_name, path)
+        if "startfrom" not in training_config:
+            raise ValueError("Training.continue=1 requires Training.startfrom")
+        return load_existing_model(state, training_config["startfrom"], path)
     return state
 
 

@@ -470,3 +470,57 @@ def pytest_scan_eval_matches_sequential(small_problem):
     scan_loss, scan_tasks = evaluate_epoch_scan(loader, state, make_scan_eval(model))
     np.testing.assert_allclose(scan_loss, seq_loss, rtol=1e-5, atol=1e-6)
     np.testing.assert_allclose(scan_tasks, seq_tasks, rtol=1e-5, atol=1e-6)
+
+
+def pytest_checkpoint_resume_exact(tmp_path):
+    """Per-epoch checkpointing (Training.checkpoint_every) + continue must
+    resume EXACTLY: an interrupted-at-3-then-resumed-to-6 run reproduces
+    the uninterrupted 6-epoch run's history and parameters (rng chain,
+    epoch-seeded shuffles, scheduler and early-stop counters all survive
+    the restart). The reference restores only model+optimizer and restarts
+    epoch numbering (SURVEY §5)."""
+    from hydragnn_tpu.api import run_training
+    from hydragnn_tpu.utils.config import get_log_name_config
+    from test_train_e2e import make_config
+
+    def fresh_samples():
+        # the ingest pipeline mutates the sample list in place; every run
+        # gets an identical fresh copy (same seed)
+        return deterministic_graph_data(number_configurations=80, seed=0)
+
+    def cfg_for(num_epoch):
+        c = make_config("GIN", False, str(tmp_path), num_epoch=num_epoch)
+        t = c["NeuralNetwork"]["Training"]
+        t["bn_recalibration"] = False  # final recal would diverge from the mid-run save
+        t["checkpoint_every"] = 1
+        return c
+
+    # uninterrupted reference run
+    _, state_a, hist_a, _ = run_training(
+        cfg_for(6), samples=fresh_samples(), log_dir=str(tmp_path) + "/a/"
+    )
+
+    # interrupted at 3 ...
+    _, _, hist_b, full_b = run_training(
+        cfg_for(3), samples=fresh_samples(), log_dir=str(tmp_path) + "/b/"
+    )
+    name_b = get_log_name_config(full_b)
+
+    # ... resumed to 6 in the same log dir
+    cfg_c = cfg_for(6)
+    cfg_c["NeuralNetwork"]["Training"]["continue"] = 1
+    cfg_c["NeuralNetwork"]["Training"]["startfrom"] = name_b
+    _, state_c, hist_c, _ = run_training(
+        cfg_c, samples=fresh_samples(), log_dir=str(tmp_path) + "/b/"
+    )
+
+    assert len(hist_c["train_loss"]) == 6
+    np.testing.assert_allclose(hist_c["train_loss"][:3], hist_b["train_loss"], rtol=1e-6)
+    np.testing.assert_allclose(
+        hist_c["train_loss"], hist_a["train_loss"], rtol=1e-5, atol=1e-7
+    )
+    for a, b in zip(
+        jax.tree_util.tree_leaves(jax.device_get(state_a.params)),
+        jax.tree_util.tree_leaves(jax.device_get(state_c.params)),
+    ):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-7)
