@@ -297,16 +297,25 @@ def run_prediction(
 ) -> Tuple[float, np.ndarray, List[np.ndarray], List[np.ndarray]]:
     """Load data + trained weights, run the full test pass, optionally
     denormalize; returns (error, error_rmse_task, true_values,
-    predicted_values) (reference: run_prediction.py:27-83)."""
+    predicted_values) (reference: run_prediction.py:27-83). Single-host
+    multi-device runs shard the eval over the local data mesh, like
+    training."""
     config = load_config(config_file_or_dict)
     verbosity = config.get("Verbosity", {}).get("level", 0)
 
-    _, _, test_loader, config = prepare_loaders_and_config(config, samples)
+    device_stack = _choose_device_stack(config) if jax.process_count() == 1 else 1
+    _, _, test_loader, config = prepare_loaders_and_config(
+        config, samples, device_stack=device_stack
+    )
     log_name = get_log_name_config(config)
 
     nn_config = config["NeuralNetwork"]
     example = next(iter(test_loader))
-    model, variables = create_model_config(nn_config, example)
+    if device_stack > 1:
+        example_one = jax.tree_util.tree_map(lambda x: x[0], example)
+    else:
+        example_one = example
+    model, variables = create_model_config(nn_config, example_one)
     # Same optimizer chain as training: freeze_conv changes the opt_state
     # pytree structure, and the checkpoint schema must match to deserialize.
     tx = select_optimizer(
@@ -315,8 +324,26 @@ def run_prediction(
     )
     state = create_train_state(variables, tx)
     state = load_existing_model(state, log_name, log_dir)
+    # Eval never reads the optimizer state (restored only because the
+    # checkpoint schema includes it — e.g. ZeRO-1-trained runs whose
+    # opt_state would not even FIT replicated); drop it before any
+    # placement so it never occupies the mesh.
+    state = state.replace(opt_state=())
 
-    eval_step = make_eval_step(model, with_outputs=True)
+    if device_stack > 1:
+        from hydragnn_tpu.parallel import (
+            batch_sharding,
+            make_mesh,
+            make_sharded_eval_step,
+            place_state,
+        )
+
+        mesh = make_mesh(device_stack)
+        test_loader.set_sharding(batch_sharding(mesh))
+        state = place_state(mesh, state)
+        eval_step = make_sharded_eval_step(model, mesh, with_outputs=True)
+    else:
+        eval_step = make_eval_step(model, with_outputs=True)
     error, error_rmse_task, true_values, predicted_values = test_epoch(
         test_loader, state, eval_step, model.cfg, verbosity, return_samples=True
     )
