@@ -116,6 +116,14 @@ def create_dataloaders(
     return train_loader, val_loader, test_loader
 
 
+def _example_for_init(example, device_stack: int):
+    """Strip the leading device axis off a loader example when the loader
+    stacks sub-batches, so model init sees one sub-batch's shapes."""
+    if device_stack > 1:
+        return jax.tree_util.tree_map(lambda x: x[0], example)
+    return example
+
+
 def _choose_device_stack(config: Dict[str, Any]) -> int:
     """Data-parallel width for this process: all local devices when the
     per-process batch size divides evenly, else single-device. Multi-host
@@ -151,10 +159,7 @@ def train_with_loaders(
     example = next(iter(train_loader))
     multihost = jax.process_count() > 1
     sharded = device_stack > 1 or multihost
-    if device_stack > 1:
-        example_one = jax.tree_util.tree_map(lambda x: x[0], example)
-    else:
-        example_one = example
+    example_one = _example_for_init(example, device_stack)
 
     training = nn_config["Training"]
     freeze = bool(nn_config["Architecture"].get("freeze_conv_layers"))
@@ -311,10 +316,7 @@ def run_prediction(
 
     nn_config = config["NeuralNetwork"]
     example = next(iter(test_loader))
-    if device_stack > 1:
-        example_one = jax.tree_util.tree_map(lambda x: x[0], example)
-    else:
-        example_one = example
+    example_one = _example_for_init(example, device_stack)
     model, variables = create_model_config(nn_config, example_one)
     # Same optimizer chain as training: freeze_conv changes the opt_state
     # pytree structure, and the checkpoint schema must match to deserialize.
@@ -322,12 +324,14 @@ def run_prediction(
         nn_config["Training"],
         freeze_conv=bool(nn_config["Architecture"].get("freeze_conv_layers")),
     )
-    state = create_train_state(variables, tx)
+    # Eval never reads the optimizer state; the restore target carries it
+    # as HOST arrays only (create_eval_state), so a ZeRO-1-trained
+    # checkpoint whose optimizer state cannot fit un-sharded on a device
+    # restores fine, and the drop below keeps it off the mesh entirely.
+    from hydragnn_tpu.train import create_eval_state
+
+    state = create_eval_state(variables, tx)
     state = load_existing_model(state, log_name, log_dir)
-    # Eval never reads the optimizer state (restored only because the
-    # checkpoint schema includes it — e.g. ZeRO-1-trained runs whose
-    # opt_state would not even FIT replicated); drop it before any
-    # placement so it never occupies the mesh.
     state = state.replace(opt_state=())
 
     if device_stack > 1:

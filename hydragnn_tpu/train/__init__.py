@@ -5,6 +5,7 @@ from hydragnn_tpu.train.optimizer import (
 )
 from hydragnn_tpu.train.state import (
     TrainState,
+    create_eval_state,
     create_train_state,
     make_scan_epoch,
     make_scan_eval,

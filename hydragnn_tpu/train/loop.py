@@ -411,6 +411,11 @@ def train_validate_test(
             head_names=cfg.output_names,
             log_dir=log_dir,
         )
+    if visualizer is not None and hasattr(test_loader, "all_samples"):
+        # test-set node-count histogram at setup (reference: Visualizer
+        # num_nodes_plot wiring, train_validate_test.py:71-97);
+        # all_samples = the full split, not this process's shard
+        visualizer.num_nodes_plot([s.num_nodes for s in test_loader.all_samples])
     if visualizer is not None and plot_init_solution:
         _, _, tv, pv = test_epoch(
             test_loader, state, eval_step_out, cfg, verbosity, return_samples=True

@@ -47,6 +47,31 @@ def create_train_state(
     )
 
 
+def create_eval_state(
+    variables: Dict[str, Any], tx: optax.GradientTransformation, seed: int = 0
+) -> TrainState:
+    """TrainState with the full checkpoint SCHEMA but no device-side
+    optimizer state: opt leaves are host zero-arrays shaped by
+    ``jax.eval_shape(tx.init)``. Restoring a checkpoint for eval through
+    this target never materializes the optimizer on any device — required
+    for ZeRO-1-trained runs whose optimizer state cannot fit un-sharded."""
+    import numpy as np
+
+    params = jax.tree_util.tree_map(jnp.copy, variables["params"])
+    batch_stats = jax.tree_util.tree_map(jnp.copy, variables.get("batch_stats", {}))
+    opt_shapes = jax.eval_shape(tx.init, params)
+    opt_state = jax.tree_util.tree_map(
+        lambda s: np.zeros(s.shape, s.dtype), opt_shapes
+    )
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        batch_stats=batch_stats,
+        opt_state=opt_state,
+        rng=jax.random.PRNGKey(seed),
+    )
+
+
 def _cast_floats(tree: Any, dtype) -> Any:
     """Cast float32 leaves to ``dtype`` (ints/bools untouched)."""
     return jax.tree_util.tree_map(
