@@ -12,6 +12,11 @@ from hydragnn_tpu.parallel.mesh import (
     replicated_sharding,
     setup_distributed,
 )
+from hydragnn_tpu.parallel.edge_sharded import (
+    make_dp_edge_train_step,
+    place_dp_edge_batch,
+    place_giant_batch,
+)
 from hydragnn_tpu.parallel.sharded import (
     make_sharded_eval_step,
     make_sharded_stats_step,

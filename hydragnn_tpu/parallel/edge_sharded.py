@@ -149,6 +149,106 @@ def place_giant_batch(mesh: Mesh, batch):
     return jax.device_put(batch, edge_axis_shardings(mesh, batch))
 
 
+def place_dp_edge_batch(mesh: Mesh, batch):
+    """Place a device-stacked batch ([D_data, ...] leaves from
+    ``GraphLoader(device_stack=D_data)``) on a 2-D ``(data, edge)`` mesh:
+    axis 0 shards over ``data``; leaves whose SECOND axis is the edge
+    axis additionally shard it over ``edge``. Companion of
+    :func:`make_dp_edge_train_step`."""
+    d_edge = int(mesh.shape["edge"])
+    e = batch.senders.shape[1]
+    if e % d_edge:
+        raise ValueError(
+            f"the edge-axis size ({d_edge}) must divide the stacked edge "
+            f"pad ({e}); build the loader with edge_multiple={d_edge} "
+            "(or a multiple of it)"
+        )
+
+    dp = NamedSharding(mesh, P(DATA_AXIS))
+    dp_edge = NamedSharding(mesh, P(DATA_AXIS, "edge"))
+
+    def pick(x):
+        if hasattr(x, "ndim") and x.ndim >= 2 and x.shape[1] == e:
+            return dp_edge
+        return dp
+
+    return jax.device_put(batch, jax.tree_util.tree_map(pick, batch))
+
+
+def make_dp_edge_train_step(
+    model, tx, mesh: Mesh
+):
+    """Data-parallel x edge-sharded training on a 2-D ``(data, edge)``
+    mesh: sub-batches vmap over the data axis (each holding its own
+    graphs) while every sub-batch's edge arrays shard over the edge axis
+    — GSPMD partitions both (the giant-graph analog of composing DP with
+    sequence parallelism). Parameters stay replicated; the weighted-loss
+    gradient over shared params is the DP gradient mean.
+
+    Returns jitted ``(state, batch[D_data-leading]) -> (state, loss,
+    tasks)`` matching ``make_sharded_train_step``'s contract."""
+    import optax
+
+    from hydragnn_tpu.models.base import model_loss
+    from hydragnn_tpu.train.state import TrainState  # noqa: F401
+
+    from hydragnn_tpu.parallel.sharded import _state_sharding
+
+    def step(state, batch):
+        rng, dropout_rng = jax.random.split(state.rng)
+        d_data = batch.graph_mask.shape[0]
+
+        def loss_fn(params):
+            def per_shard(batch_d, rng_d):
+                outputs, mutated = model.apply(
+                    {"params": params, "batch_stats": state.batch_stats},
+                    batch_d,
+                    train=True,
+                    mutable=["batch_stats"],
+                    rngs={"dropout": rng_d},
+                )
+                total, tasks = model_loss(model.cfg, outputs, batch_d)
+                n = batch_d.graph_mask.sum().astype(jnp.float32)
+                return total, jnp.stack(tasks), mutated["batch_stats"], n
+
+            rngs = jax.random.split(dropout_rng, d_data)
+            # axis_name binds SyncBatchNorm's psum, like shard_map's mesh
+            losses, tasks, stats, ns = jax.vmap(
+                per_shard, axis_name=DATA_AXIS
+            )(batch, rngs)
+            # Gradient target is the UNWEIGHTED mean over shards — the
+            # shard_map step pmean's per-device grads (DDP semantics,
+            # sharded.py); reported metrics stay real-graph-weighted.
+            loss_grad = losses.mean()
+            w = ns / jnp.maximum(ns.sum(), 1.0)
+            loss_rep = (losses * w).sum()
+            tasks_rep = (tasks * w[:, None]).sum(axis=0)
+            new_stats = jax.tree_util.tree_map(lambda s: s.mean(axis=0), stats)
+            return loss_grad, (loss_rep, tasks_rep, new_stats)
+
+        (_, (loss, tasks, new_stats)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(state.params)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        new_state = state.replace(
+            step=state.step + 1,
+            params=params,
+            batch_stats=new_stats,
+            opt_state=opt_state,
+            rng=rng,
+        )
+        # pin the replicated state layout (see sharded.py: without it the
+        # batch's (data, edge) sharding can propagate into params,
+        # churning layouts across donated steps)
+        new_state = jax.lax.with_sharding_constraint(
+            new_state, _state_sharding(mesh, new_state, zero1=False)
+        )
+        return new_state, loss, tasks
+
+    return jax.jit(step, donate_argnums=(0,))
+
+
 def edge_sharded_gin_layer(
     mesh: Mesh,
     nodes: jnp.ndarray,
