@@ -91,6 +91,7 @@ def build_flagship(
     unit_cells: Tuple[int, int] = (2, 4),
     seed: int = 0,
     cache_device_batches: bool = False,
+    edge_multiple: int = 8,
 ):
     """Returns (config, model, variables, train_loader)."""
     config = flagship_config(hidden_dim, num_conv_layers, batch_size)
@@ -110,6 +111,7 @@ def build_flagship(
         device_stack=device_stack,
         drop_last=True,
         cache_device_batches=cache_device_batches,
+        edge_multiple=edge_multiple,
     )
     import jax
 

@@ -190,7 +190,6 @@ def make_dp_edge_train_step(
     import optax
 
     from hydragnn_tpu.models.base import model_loss
-    from hydragnn_tpu.train.state import TrainState  # noqa: F401
 
     from hydragnn_tpu.parallel.sharded import _state_sharding
 
