@@ -45,11 +45,20 @@ def normalize_rotation(samples: Sequence[GraphSample]) -> None:
     reference's PyG ``NormalizeRotation`` transform, used at
     serialized_dataset_loader.py:128-130). Edge lengths are invariant."""
     for s in samples:
+        in_dtype = np.asarray(s.pos).dtype
         pos = np.asarray(s.pos, dtype=np.float64)
         pos = pos - pos.mean(axis=0, keepdims=True)
-        # right singular vectors = principal axes
-        _, _, vt = np.linalg.svd(pos, full_matrices=False)
-        s.pos = (pos @ vt.T).astype(np.float32)
+        # right singular vectors = principal axes. Reduced SVD gives the
+        # full (3,3) vt for n >= 3; only n < 3 needs full_matrices (and
+        # only then — full mode materializes a discarded n x n U, which
+        # is O(n^2) memory on big graphs)
+        _, _, vt = np.linalg.svd(pos, full_matrices=pos.shape[0] < 3)
+        # preserve a floating input dtype (the reference's transform does;
+        # a float64 dataset keeps float64 fidelity through normalization);
+        # non-float positions (e.g. integer lattice coordinates) must not
+        # be truncated back to ints
+        out_dtype = in_dtype if np.issubdtype(in_dtype, np.floating) else np.float32
+        s.pos = (pos @ vt.T).astype(out_dtype)
 
 
 def build_edges(

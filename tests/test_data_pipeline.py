@@ -228,3 +228,73 @@ def pytest_loader_sharding():
     assert l0.num_samples == 21 and l1.num_samples == 21
     assert len(l0) == len(l1) == 3
     assert (l0.pad_nodes, l0.pad_edges) == (l1.pad_nodes, l1.pad_edges)
+
+
+def pytest_rotational_invariance():
+    """Edge sets and edge lengths must be invariant under an arbitrary
+    rigid rotation + translation when rotation normalization is applied
+    (reference: tests/test_rotational_invariance.py:52-112 — float32 tol
+    1e-4, float64 tol 1e-14)."""
+    from hydragnn_tpu.data.dataset import GraphSample
+    from hydragnn_tpu.data.ingest import normalize_rotation
+    from hydragnn_tpu.data.radius_graph import edge_lengths, radius_graph
+
+    rng = np.random.RandomState(13)
+    n, radius = 24, 0.9
+
+    def random_rotation():
+        q, _ = np.linalg.qr(rng.normal(size=(3, 3)))
+        if np.linalg.det(q) < 0:
+            q[:, 0] = -q[:, 0]
+        return q
+
+    for dtype, tol in ((np.float32, 1e-4), (np.float64, 1e-14)):
+        pos = rng.rand(n, 3).astype(dtype)
+        rot = (random_rotation() @ pos.astype(np.float64).T).T + rng.normal(size=3)
+        s_a = GraphSample(x=np.zeros((n, 1), np.float32), pos=pos.astype(dtype))
+        s_b = GraphSample(x=np.zeros((n, 1), np.float32), pos=rot.astype(dtype))
+        normalize_rotation([s_a, s_b])
+        assert s_a.pos.dtype == dtype  # dtype preserved through normalization
+
+        # normalization must actually ALIGN the copies: same canonical
+        # coordinates per node, up to SVD's per-axis sign ambiguity (a
+        # broken/no-op normalize_rotation would fail this even though
+        # distances below are invariant under any rigid transform)
+        pa = s_a.pos.astype(np.float64)
+        pb = s_b.pos.astype(np.float64)
+        for axis in range(3):
+            col_a, col_b = pa[:, axis], pb[:, axis]
+            err = min(np.abs(col_a - col_b).max(), np.abs(col_a + col_b).max())
+            # coordinates accumulate a few ulps more SVD round-off than
+            # the derived edge lengths the reference bounds at `tol`;
+            # broken normalization errs at O(1), far above 100x tol
+            assert err < 100 * tol, f"axis {axis} not aligned ({dtype}): {err}"
+
+        ei_a = radius_graph(pa, radius)
+        ei_b = radius_graph(pb, radius)
+        set_a = {(int(u), int(v)) for u, v in ei_a.T}
+        set_b = {(int(u), int(v)) for u, v in ei_b.T}
+        assert set_a == set_b, f"edge sets differ under rotation ({dtype})"
+
+        # edge lengths in full float64 (the helper casts to f32, which
+        # would make the 1e-14 band vacuous)
+        len_a = np.sort(np.linalg.norm(pa[ei_a[0]] - pa[ei_a[1]], axis=1))
+        len_b = np.sort(np.linalg.norm(pb[ei_b[0]] - pb[ei_b[1]], axis=1))
+        np.testing.assert_allclose(len_a, len_b, rtol=tol, atol=tol)
+
+
+def pytest_rotation_keeps_dimensions_for_tiny_graphs():
+    """Graphs with fewer than 3 nodes must keep 3-D positions through
+    rotation normalization (regression: reduced SVD projected a 2-node
+    graph down to 2-D and broke the in-place write)."""
+    from hydragnn_tpu.data.dataset import GraphSample
+    from hydragnn_tpu.data.ingest import normalize_rotation
+
+    for n in (1, 2):
+        s = GraphSample(
+            x=np.zeros((n, 1), np.float32),
+            pos=np.arange(3 * n, dtype=np.float32).reshape(n, 3),
+        )
+        normalize_rotation([s])
+        assert s.pos.shape == (n, 3)
+        assert np.isfinite(s.pos).all()
