@@ -298,3 +298,25 @@ def pytest_rotation_keeps_dimensions_for_tiny_graphs():
         normalize_rotation([s])
         assert s.pos.shape == (n, 3)
         assert np.isfinite(s.pos).all()
+
+
+def pytest_periodic_bcc_supercell():
+    """5x5x5 BCC Cr supercell (a=3.6, radius=5.0): every atom must see
+    exactly its 8 first-shell + 6 second-shell periodic neighbors — 14
+    without self-loops, 15 with (reference:
+    tests/test_periodic_boundary_conditions.py pytest_periodic_bcc_large,
+    built there with ase.build; constructed directly here)."""
+    a, reps, radius = 3.6, 5, 5.0
+    basis = np.array([[0.0, 0.0, 0.0], [a / 2, a / 2, a / 2]])
+    shifts = np.array(
+        [[i, j, k] for i in range(reps) for j in range(reps) for k in range(reps)]
+    ) * a
+    pos = (basis[None, :, :] + shifts[:, None, :]).reshape(-1, 3)
+    cell = np.eye(3) * (reps * a)
+    n = pos.shape[0]
+    assert n == 250
+
+    ei = radius_graph_pbc(pos, radius, cell, loop=False)
+    assert ei.shape[1] == 14 * n, ei.shape
+    ei_loops = radius_graph_pbc(pos, radius, cell, loop=True)
+    assert ei_loops.shape[1] == 15 * n, ei_loops.shape
