@@ -11,6 +11,61 @@ semantics; example drivers and subprocess tests call this at startup so
 from __future__ import annotations
 
 import os
+import re
+
+_COUNT_FLAG = "--xla_force_host_platform_device_count"
+
+
+def pin_virtual_cpu_mesh(n_devices: int = 8) -> None:
+    """Force jax onto a virtual CPU mesh of at least ``n_devices`` devices.
+
+    The single source of the recipe used by ``tests/conftest.py`` and
+    ``__graft_entry__.dryrun_multichip``: set ``JAX_PLATFORMS=cpu``,
+    ensure ``XLA_FLAGS`` requests >= ``n_devices`` host devices (raising
+    a pre-existing smaller count, since XLA honors whatever value is
+    present when the backend initializes), and pin ``jax_platforms`` via
+    config so the sitecustomize-registered accelerator plugin cannot win.
+
+    Must be called before the jax backend initializes. This module
+    imports no jax at module level precisely so callers can import it
+    (by path if needed) before jax.
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(_COUNT_FLAG + r"=(\d+)", flags)
+    if m is None:
+        os.environ["XLA_FLAGS"] = (flags + f" {_COUNT_FLAG}={n_devices}").strip()
+    elif int(m.group(1)) < n_devices:
+        os.environ["XLA_FLAGS"] = flags.replace(
+            m.group(0), f"{_COUNT_FLAG}={n_devices}"
+        )
+
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except RuntimeError:
+        pass  # backend already up; require_virtual_cpu_mesh diagnoses it
+
+
+def require_virtual_cpu_mesh(n_devices: int) -> None:
+    """Fail fast (explicit raise — survives ``python -O``) if jax did not
+    land on a CPU backend with >= ``n_devices`` devices, i.e. the backend
+    initialized before :func:`pin_virtual_cpu_mesh` took effect."""
+    import jax
+
+    if jax.default_backend() != "cpu":
+        raise RuntimeError(
+            "expected the virtual CPU mesh but the jax backend is "
+            f"{jax.default_backend()!r} — jax initialized before "
+            "pin_virtual_cpu_mesh() was called"
+        )
+    if len(jax.devices()) < n_devices:
+        raise RuntimeError(
+            f"need {n_devices} virtual CPU devices, got {len(jax.devices())} "
+            "— XLA_FLAGS was read before "
+            f"{_COUNT_FLAG} took effect (backend initialized too early)"
+        )
 
 
 def pin_platform_from_env() -> None:

@@ -9,15 +9,8 @@ Must run before jax is imported anywhere.
 """
 
 import os
+import sys
 
-# Unconditional: the ambient environment may pre-set JAX_PLATFORMS to the
-# real TPU backend, and tests must run on the virtual CPU mesh.
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 # Persistent compilation cache: repeated test runs skip recompilation.
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_test_cache")
@@ -25,7 +18,15 @@ os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
 
 # The ambient image registers a remote-TPU ("axon") PJRT plugin through
 # sitecustomize and pre-sets JAX_PLATFORMS=axon; if that backend wins, test
-# runs hang retrying the tunnel. Pin the config itself, not just the env.
-import jax  # noqa: E402
+# runs hang retrying the tunnel. pin_virtual_cpu_mesh pins the config
+# itself, not just the env; require_ fails fast (instead of hanging) if
+# some earlier-loaded plugin already initialized the backend. The platform
+# helper is loaded by file path (via the jax-free __graft_entry__ loader)
+# because importing it through the package would execute
+# hydragnn_tpu/__init__, which imports jax before the pin.
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir))
+from __graft_entry__ import _load_platform_module  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+_platform = _load_platform_module()
+_platform.pin_virtual_cpu_mesh(8)
+_platform.require_virtual_cpu_mesh(8)
