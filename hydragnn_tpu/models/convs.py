@@ -87,14 +87,27 @@ class MFConv(nn.Module):
         deg = S.node_degree(ctx.receivers, n, mask=ctx.edge_mask).astype(jnp.int32)
         deg = jnp.clip(deg, 0, self.max_degree)
 
-        init = nn.initializers.lecun_normal()
+        # init parity with the reference: PyG MFConv holds one torch
+        # Linear per degree — lins_l with kaiming-uniform weights
+        # (var 1/(3 fan_in)) + uniform(-1/sqrt(fan_in), .) bias, lins_r
+        # with bias=False. batch_axis=0 keeps fan_in = fin for the
+        # stacked per-degree weights (otherwise jax counts ndeg*fin).
+        # With flax's lecun_normal + zero bias the same training budget
+        # lands ~0.28 MAE on the deterministic dataset vs the 0.20 bar.
+        init = nn.initializers.variance_scaling(
+            1.0 / 3.0, "fan_in", "uniform", batch_axis=0
+        )
+        bound = 1.0 / float(fin) ** 0.5
+
+        def bias_init(key, shape, dtype=jnp.float32):
+            return jax.random.uniform(key, shape, dtype, -bound, bound)
+
         w_l = self.param("w_l", init, (ndeg, fin, self.out_dim))
-        b_l = self.param("b_l", nn.initializers.zeros, (ndeg, self.out_dim))
+        b_l = self.param("b_l", bias_init, (ndeg, self.out_dim))
         w_r = self.param("w_r", init, (ndeg, fin, self.out_dim))
-        b_r = self.param("b_r", nn.initializers.zeros, (ndeg, self.out_dim))
 
         out = jnp.einsum("ni,nio->no", agg, w_l[deg]) + b_l[deg]
-        out = out + jnp.einsum("ni,nio->no", x, w_r[deg]) + b_r[deg]
+        out = out + jnp.einsum("ni,nio->no", x, w_r[deg])
         return out
 
 
