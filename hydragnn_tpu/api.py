@@ -109,6 +109,7 @@ def create_dataloaders(
         shard_rank=rank,
         device_stack=device_stack,
         cache_device_batches=bool(training.get("cache_device_batches", False)),
+        scan_reshuffle_every=int(training.get("scan_reshuffle_every", 0)),
     )
     train_loader = GraphLoader(train, bs, shuffle=True, **kw)
     val_loader = GraphLoader(val, bs, **kw)
@@ -133,7 +134,19 @@ def _choose_device_stack(config: Dict[str, Any]) -> int:
     to one process per host here."""
     n_local = jax.local_device_count()
     bs = int(config["NeuralNetwork"]["Training"]["batch_size"])
-    return n_local if n_local > 1 and bs % n_local == 0 else 1
+    if n_local > 1 and bs % n_local != 0:
+        import warnings
+
+        warnings.warn(
+            f"batch_size={bs} is not divisible by local_device_count="
+            f"{n_local}; falling back to SINGLE-DEVICE execution "
+            f"(~{n_local}x throughput loss). Use a batch_size divisible "
+            f"by {n_local} to engage all local devices.",
+            RuntimeWarning,
+            stacklevel=2,
+        )
+        return 1
+    return n_local
 
 
 def train_with_loaders(
@@ -184,21 +197,28 @@ def train_with_loaders(
             # Global mesh over every process's devices; each process feeds
             # its shard of the logical batch (the reference's one-DDP-rank-
             # per-GPU launch becomes one-process-per-host + a data mesh).
-            if device_stack != jax.local_device_count() and device_stack != 1:
-                raise ValueError(
-                    "multi-host device_stack must be 1 or local_device_count"
-                )
             # Heterogeneous hosts can locally derive different widths
             # (device_stack falls back to 1 when batch_size doesn't divide
             # its local device count); meshes/batch shapes must agree
             # everywhere or the collectives fail opaquely downstream.
+            # Gather every process's (validity, width) BEFORE raising: if
+            # only some processes raised, the rest would block forever
+            # inside this collective.
             from jax.experimental import multihost_utils
 
-            stacks = np.asarray(
+            ok = device_stack in (1, jax.local_device_count())
+            info = np.asarray(
                 multihost_utils.process_allgather(
-                    np.asarray([device_stack], dtype=np.int64)
+                    np.asarray([int(ok), device_stack], dtype=np.int64)
                 )
-            ).reshape(-1)
+            ).reshape(-1, 2)
+            if not info[:, 0].all():
+                bad = [int(s) for o, s in info.tolist() if not o]
+                raise ValueError(
+                    "multi-host device_stack must be 1 or local_device_count; "
+                    f"invalid widths across processes: {bad}"
+                )
+            stacks = info[:, 1]
             if not (stacks == device_stack).all():
                 raise ValueError(
                     f"device_stack must agree across processes, got {stacks.tolist()}"

@@ -89,6 +89,7 @@ class GraphLoader:
         drop_last: bool = False,
         cache_device_batches: bool = False,
         prefetch: Optional[int] = None,
+        scan_reshuffle_every: int = 0,
     ):
         if device_stack > 1 and batch_size % device_stack != 0:
             raise ValueError(
@@ -112,6 +113,7 @@ class GraphLoader:
         self.device_stack = device_stack
         self.drop_last = drop_last
         self.cache_device_batches = cache_device_batches
+        self.scan_reshuffle_every = scan_reshuffle_every
         # an explicit argument wins; HYDRAGNN_NUM_PREFETCH sets the default
         if prefetch is None:
             raw = os.environ.get("HYDRAGNN_NUM_PREFETCH", "2")
@@ -124,6 +126,7 @@ class GraphLoader:
         self.prefetch = prefetch
         self._cached_batches: Optional[List[GraphBatch]] = None
         self._stacked: Optional[GraphBatch] = None
+        self._stacked_key: Optional[int] = None
         self._sharding = None
         self._global_mesh = None
         self._epoch = 0
@@ -287,20 +290,33 @@ class GraphLoader:
     def num_graphs_total(self) -> int:
         return len(self.samples)
 
-    def stacked_device_batches(self) -> GraphBatch:
+    def stacked_device_batches(self, epoch: int = 0) -> GraphBatch:
         """Every batch of an epoch stacked on a new leading axis [B, ...]
         and placed on device — the input for the scan-over-epoch train
-        path (train.state.make_scan_epoch). Batch membership is fixed
-        (like ``cache_device_batches``); per-epoch shuffling happens
-        device-side by permuting the batch axis. Built once and cached."""
-        if self._stacked is None:
+        path (train.state.make_scan_epoch). By default batch membership is
+        fixed (like ``cache_device_batches``) and per-epoch shuffling
+        happens device-side by permuting the batch axis — a deliberate
+        divergence from the reference DataLoader(shuffle=True), which
+        re-forms batches every epoch. ``scan_reshuffle_every=k`` restores
+        membership-level reshuffling by rebuilding the stack host-side
+        every k epochs (one extra H2D transfer per rebuild)."""
+        k = self.scan_reshuffle_every
+        key = (epoch // k) if (self.shuffle and k > 0) else None
+        if self._stacked is None or key != self._stacked_key:
             bs = self.batch_size
-            base = np.arange(len(self.samples))
+            if key is None:
+                base = np.arange(len(self.samples))
+            else:
+                # sample-level permutation, seeded like the __iter__ path
+                base = np.random.default_rng(self.seed + key).permutation(
+                    len(self.samples)
+                )
             host = [
                 self._make_batch(base[b * bs : (b + 1) * bs]) for b in range(len(self))
             ]
             stacked = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *host)
             self._stacked = jax.device_put(stacked, self._sharding)
+            self._stacked_key = key
         return self._stacked
 
 

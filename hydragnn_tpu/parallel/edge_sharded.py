@@ -167,12 +167,18 @@ def place_dp_edge_batch(mesh: Mesh, batch):
     dp = NamedSharding(mesh, P(DATA_AXIS))
     dp_edge = NamedSharding(mesh, P(DATA_AXIS, "edge"))
 
-    def pick(x):
-        if hasattr(x, "ndim") and x.ndim >= 2 and x.shape[1] == e:
-            return dp_edge
-        return dp
+    # Edge leaves are selected by GraphBatch field NAME, not by shape:
+    # a node- or graph-axis leaf whose pad coincidentally equals the edge
+    # pad must stay data-sharded only.
+    import dataclasses as _dc
 
-    return jax.device_put(batch, jax.tree_util.tree_map(pick, batch))
+    edge_fields = {"senders", "receivers", "edge_mask", "edge_attr"}
+    shardings = {}
+    for f in _dc.fields(batch):
+        v = getattr(batch, f.name)
+        sh = dp_edge if f.name in edge_fields else dp
+        shardings[f.name] = jax.tree_util.tree_map(lambda _: sh, v)
+    return jax.device_put(batch, type(batch)(**shardings))
 
 
 def make_dp_edge_train_step(

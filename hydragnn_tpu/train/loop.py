@@ -175,8 +175,11 @@ def train_epoch_scan(
     """One training epoch as a single device dispatch (``Training.
     scan_epoch``): lax.scan over the loader's device-resident stacked
     batches, shuffled device-side by an epoch-seeded permutation of the
-    batch axis. Same weighted-metric semantics as ``train_epoch``."""
-    stacked = loader.stacked_device_batches()
+    batch axis (sample-to-batch membership reshuffles only when the
+    loader's ``scan_reshuffle_every`` is set — see
+    ``GraphLoader.stacked_device_batches``). Same weighted-metric
+    semantics as ``train_epoch``."""
+    stacked = loader.stacked_device_batches(epoch)
     nb = len(loader)
     if loader.shuffle:
         order = np.random.default_rng(loader.seed + epoch).permutation(nb)
@@ -376,6 +379,47 @@ def train_validate_test(
             raise ValueError("Training.continue=1 requires Training.startfrom")
         meta = load_train_meta(training["startfrom"], log_dir)
         if meta is not None:
+            # The model file and the meta sidecar are written sequentially
+            # (each atomic, the pair not): a crash between them leaves meta
+            # one interval older than the weights. The meta carries the
+            # optimizer step it described; on mismatch, re-derive the epoch
+            # from the restored weights instead of replaying epochs.
+            meta_step = meta.get("step")
+            state_step = int(jax.device_get(state.step))
+            if meta_step is not None and int(meta_step) != state_step:
+                steps_per_epoch = max(len(train_loader), 1)
+                derived = min(num_epoch, state_step // steps_per_epoch)
+                print_distributed(
+                    verbosity,
+                    f"WARNING: checkpoint meta (step {meta_step}) does not "
+                    f"match restored weights (step {state_step}) — the run "
+                    "likely crashed between the weight and meta writes; "
+                    f"resuming from epoch {derived} derived from the "
+                    f"weights, not meta epoch {meta['epoch']}",
+                )
+                # Repair the whole sidecar, not just the epoch: the stale
+                # history would misalign epoch indices for everything
+                # appended after it, and the stale scheduler/stopper
+                # counters describe an older state than the weights (the
+                # weights' own opt_state already carries the live LR).
+                hist = meta.get("history", {})
+                for k, v in hist.items():
+                    v = v[:derived]
+                    while v and len(v) < derived:
+                        v.append(v[-1])  # unknown epochs: carry the last
+                    hist[k] = v
+                meta = {
+                    "epoch": derived,
+                    "step": state_step,
+                    "early_stopped": False,
+                    "scheduler": {"best": float("inf"), "num_bad_epochs": 0},
+                    "stopper": {"count": 0, "min_loss": float("inf")},
+                    "history": hist,
+                }
+                # rewrite once so future resumes see a consistent pair
+                from hydragnn_tpu.utils.checkpoint import save_train_meta
+
+                save_train_meta(meta, log_name, log_dir)
             # an early-stopped run resumes to a no-op (the stop decision
             # is honored, not replayed into extra epochs); a completed or
             # interrupted run continues from its recorded epoch — which
@@ -429,6 +473,9 @@ def train_validate_test(
         save_train_meta(
             {
                 "epoch": epoch_next,
+                # the optimizer step ties this sidecar to the weight file
+                # it was written with (resume verifies the pair matches)
+                "step": int(jax.device_get(ckpt_state.step)),
                 "early_stopped": early_stopped,
                 "scheduler": {
                     "best": scheduler.best,
@@ -540,10 +587,21 @@ def train_validate_test(
             break
     timer.stop()
 
+    # A resume that trained zero epochs (e.g. continuing an early-stopped
+    # or completed run) must be a pure no-op: re-running BN recalibration
+    # would mutate batch_stats and rewriting the checkpoint would change
+    # the saved model file without any training having happened.
+    ran_epochs = epochs_done > start_epoch
+    resumed_noop = training.get("continue") == 1 and not ran_epochs
+
     # BatchNorm recalibration: the in-training running-stat EMA trails
     # the last few (noisy, small) batches; with frozen final parameters,
     # two passes over the train set re-estimate faithful eval statistics.
-    if stats_step is not None and training.get("bn_recalibration", True):
+    if (
+        stats_step is not None
+        and training.get("bn_recalibration", True)
+        and not resumed_noop
+    ):
         for _ in range(2):
             for b in train_loader:
                 state = stats_step(state, b)
@@ -553,7 +611,7 @@ def train_validate_test(
     # meta against the final recalibrated weights would make a later
     # continue run replay epochs on the wrong state); an early-stopped
     # run is marked so resume honors the stop instead of training on.
-    if ckpt_every:
+    if ckpt_every and not resumed_noop:
         _write_checkpoint(
             state, epochs_done, early_stopped=bool(stopper and stopper.count >= stopper.patience)
         )

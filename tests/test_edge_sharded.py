@@ -229,3 +229,56 @@ def pytest_dp_edge_composed_matches_data_parallel():
         jax.tree_util.tree_leaves(jax.device_get(state_b.params)),
     ):
         np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6)
+
+
+def pytest_dp_edge_placement_by_field_name():
+    """place_dp_edge_batch selects edge leaves by GraphBatch field name:
+    a graph- or node-axis leaf whose pad coincidentally equals the edge
+    pad must NOT get the (data, edge) sharding."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from hydragnn_tpu.data.synthetic import deterministic_graph_data
+    from hydragnn_tpu.data.ingest import prepare_dataset
+    from hydragnn_tpu.data.loader import GraphLoader
+    from hydragnn_tpu.parallel.edge_sharded import place_dp_edge_batch
+    from test_data_pipeline import base_config
+
+    d_data, d_edge = 2, 2
+    samples = deterministic_graph_data(number_configurations=16, seed=5)
+    train, _, _, _, _ = prepare_dataset(samples, base_config(multihead=False))
+    loader = GraphLoader(train, 8, shuffle=False, device_stack=d_data, edge_multiple=2)
+    batch = next(iter(loader))
+    e_pad = batch.senders.shape[1]
+
+    # force the collision: pad the graph axis out to the edge pad
+    import dataclasses
+
+    g = batch.graph_mask.shape[1]
+    grow = e_pad - g
+    assert grow > 0
+
+    def pad_graph_axis(x):
+        return np.concatenate(
+            [np.asarray(x), np.zeros((x.shape[0], grow) + x.shape[2:], x.dtype)],
+            axis=1,
+        )
+
+    batch = dataclasses.replace(
+        batch,
+        graph_mask=pad_graph_axis(batch.graph_mask),
+        n_node=pad_graph_axis(batch.n_node),
+        n_edge=pad_graph_axis(batch.n_edge),
+        graph_targets={k: pad_graph_axis(v) for k, v in batch.graph_targets.items()},
+    )
+    assert batch.graph_mask.shape[1] == e_pad  # collision in place
+
+    devs = np.array(jax.devices()[: d_data * d_edge]).reshape(d_data, d_edge)
+    mesh = Mesh(devs, ("data", "edge"))
+    placed = place_dp_edge_batch(mesh, batch)
+
+    assert placed.senders.sharding.spec == P("data", "edge")
+    assert placed.edge_mask.sharding.spec == P("data", "edge")
+    # the colliding graph-axis leaves stay data-sharded only
+    assert placed.graph_mask.sharding.spec == P("data")
+    for v in placed.graph_targets.values():
+        assert v.sharding.spec == P("data")

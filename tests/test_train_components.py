@@ -524,3 +524,134 @@ def pytest_checkpoint_resume_exact(tmp_path):
         jax.tree_util.tree_leaves(jax.device_get(state_c.params)),
     ):
         np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-7)
+
+
+def pytest_device_stack_fallback_warns():
+    """A batch size that doesn't divide the local device count must fall
+    back to single-device LOUDLY (silent 8x throughput loss otherwise)."""
+    from hydragnn_tpu.api import _choose_device_stack
+
+    n_local = jax.local_device_count()
+    assert n_local > 1  # conftest pins the 8-device CPU mesh
+
+    cfg = {"NeuralNetwork": {"Training": {"batch_size": n_local + 1}}}
+    with pytest.warns(RuntimeWarning, match="SINGLE-DEVICE"):
+        assert _choose_device_stack(cfg) == 1
+
+    cfg_ok = {"NeuralNetwork": {"Training": {"batch_size": 2 * n_local}}}
+    assert _choose_device_stack(cfg_ok) == n_local
+
+
+def pytest_scan_reshuffle_membership():
+    """scan_reshuffle_every=k rebuilds sample-to-batch membership every k
+    epochs (reference DataLoader(shuffle=True) parity for the scan path);
+    the default keeps the one-time stack."""
+    samples = deterministic_graph_data(number_configurations=40, seed=3)
+    train, _, _, _, _ = prepare_dataset(samples, base_config(multihead=False))
+
+    frozen = GraphLoader(train, 8, shuffle=True)
+    s0 = frozen.stacked_device_batches(0)
+    s1 = frozen.stacked_device_batches(1)
+    assert s0 is s1  # built once, membership fixed
+
+    reshuf = GraphLoader(train, 8, shuffle=True, scan_reshuffle_every=1)
+    r0 = reshuf.stacked_device_batches(0)
+    r1 = reshuf.stacked_device_batches(1)
+    assert r0 is not r1
+    assert not np.array_equal(np.asarray(r0.nodes), np.asarray(r1.nodes))
+    # same epoch -> same membership (cached, no rebuild churn)
+    assert reshuf.stacked_device_batches(1) is r1
+    # every sample appears exactly once regardless of membership shuffle
+    for st in (r0, r1):
+        n_real = int(np.asarray(st.node_mask).sum())
+        assert n_real == sum(s.num_nodes for s in train)
+
+
+def pytest_resume_noop_is_pure(tmp_path):
+    """Resuming a completed run (start_epoch >= num_epoch) must not touch
+    the saved checkpoint: no BN recalibration, no rewrite."""
+    import os
+
+    from hydragnn_tpu.api import run_training
+    from hydragnn_tpu.utils.config import get_log_name_config
+    from test_train_e2e import make_config
+
+    def fresh_samples():
+        return deterministic_graph_data(number_configurations=80, seed=0)
+
+    cfg = make_config("GIN", False, str(tmp_path), num_epoch=3)
+    cfg["NeuralNetwork"]["Training"]["checkpoint_every"] = 1
+    _, _, hist, full = run_training(
+        cfg, samples=fresh_samples(), log_dir=str(tmp_path) + "/logs/"
+    )
+    name = get_log_name_config(full)
+    model_files = [
+        os.path.join(str(tmp_path), "logs", name, f)
+        for f in os.listdir(os.path.join(str(tmp_path), "logs", name))
+        if f.endswith((".msgpack", ".meta.json"))
+    ]
+    assert model_files
+    before = {p: open(p, "rb").read() for p in model_files}
+
+    cfg2 = make_config("GIN", False, str(tmp_path), num_epoch=3)
+    cfg2["NeuralNetwork"]["Training"]["checkpoint_every"] = 1
+    cfg2["NeuralNetwork"]["Training"]["continue"] = 1
+    cfg2["NeuralNetwork"]["Training"]["startfrom"] = name
+    _, _, hist2, _ = run_training(
+        cfg2, samples=fresh_samples(), log_dir=str(tmp_path) + "/logs/"
+    )
+    assert len(hist2["train_loss"]) == len(hist["train_loss"])
+    for p, content in before.items():
+        assert open(p, "rb").read() == content, f"no-op resume rewrote {p}"
+
+
+def pytest_meta_step_mismatch_rederives_epoch(tmp_path):
+    """A meta sidecar older than the weights (crash between the two
+    writes) must not replay epochs on the newer weights: resume derives
+    the epoch from the weights' optimizer step instead."""
+    import json
+    import os
+
+    from hydragnn_tpu.api import run_training
+    from hydragnn_tpu.utils.config import get_log_name_config
+    from test_train_e2e import make_config
+
+    def fresh_samples():
+        return deterministic_graph_data(number_configurations=80, seed=0)
+
+    cfg = make_config("GIN", False, str(tmp_path), num_epoch=4)
+    cfg["NeuralNetwork"]["Training"]["checkpoint_every"] = 1
+    cfg["NeuralNetwork"]["Training"]["bn_recalibration"] = False
+    _, state, hist, full = run_training(
+        cfg, samples=fresh_samples(), log_dir=str(tmp_path) + "/logs/"
+    )
+    name = get_log_name_config(full)
+    meta_path = os.path.join(str(tmp_path), "logs", name, f"{name}.meta.json")
+    meta = json.load(open(meta_path))
+
+    # simulate the crash: meta describes epoch 2 / half the steps, while
+    # the weight file stays at its final (epoch-4) state
+    meta["epoch"] = 2
+    meta["step"] = meta["step"] // 2
+    meta["history"] = {k: v[:2] for k, v in meta["history"].items()}
+    json.dump(meta, open(meta_path, "w"))
+
+    cfg2 = make_config("GIN", False, str(tmp_path), num_epoch=4)
+    cfg2["NeuralNetwork"]["Training"]["checkpoint_every"] = 1
+    cfg2["NeuralNetwork"]["Training"]["bn_recalibration"] = False
+    cfg2["NeuralNetwork"]["Training"]["continue"] = 1
+    cfg2["NeuralNetwork"]["Training"]["startfrom"] = name
+    _, state2, hist2, _ = run_training(
+        cfg2, samples=fresh_samples(), log_dir=str(tmp_path) + "/logs/"
+    )
+    # epoch re-derived from the weights' step (4 full epochs) -> no replay
+    for a, b in zip(
+        jax.tree_util.tree_leaves(jax.device_get(state.params)),
+        jax.tree_util.tree_leaves(jax.device_get(state2.params)),
+    ):
+        np.testing.assert_array_equal(a, b)
+    # history re-aligned to the derived epoch and the sidecar repaired
+    assert len(hist2["train_loss"]) == 4
+    repaired = json.load(open(meta_path))
+    assert repaired["epoch"] == 4
+    assert repaired["step"] == meta["step"] * 2
