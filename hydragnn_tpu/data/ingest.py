@@ -68,6 +68,7 @@ def build_edges(
     periodic_boundary_conditions: bool = False,
     rotational_invariance: bool = False,
     spherical_coordinates: bool = False,
+    point_pair_features: bool = False,
     max_edge_length: Optional[float] = None,
 ) -> float:
     """Compute radius-graph edges and normalized edge-length attributes for
@@ -99,6 +100,8 @@ def build_edges(
 
     if spherical_coordinates:
         _append_spherical(samples)
+    if point_pair_features:
+        _append_point_pair(samples, max_edge_length)
     return max_edge_length
 
 
@@ -118,6 +121,39 @@ def _append_spherical(samples: Sequence[GraphSample]) -> None:
             [s.edge_attr, theta[:, None].astype(np.float32), phi[:, None].astype(np.float32)],
             axis=1,
         )
+
+
+def _append_point_pair(samples: Sequence[GraphSample], max_edge_length: float) -> None:
+    """Append PointPairFeatures to the edge attributes (PyG
+    ``PointPairFeatures`` transform equivalent; reference usage:
+    hydragnn/utils/abstractrawdataset.py:380-383). Per edge (i -> j) with
+    per-node normals n: [rho, angle(n_i, d), angle(n_j, d),
+    angle(n_i, n_j)], angles in radians via atan2(|cross|, dot). Like the
+    spherical descriptor, rho is normalized by the global max edge length
+    (the raw-length column PyG would duplicate is already present,
+    normalized). Normals come from ``sample.meta['norm']`` ([N, 3]) — the
+    same contract as PyG's required ``data.norm``."""
+
+    def angle(v1, v2):
+        cross = np.linalg.norm(np.cross(v1, v2), axis=1)
+        dot = (v1 * v2).sum(axis=1)
+        return np.arctan2(cross, dot)
+
+    for s in samples:
+        norm = s.meta.get("norm") if s.meta else None
+        if norm is None:
+            raise ValueError(
+                "PointPairFeatures requires per-node normals in "
+                "sample.meta['norm'] (the PyG transform's data.norm contract)"
+            )
+        norm = np.asarray(norm, dtype=np.float64)
+        d = (s.pos[s.edge_index[1]] - s.pos[s.edge_index[0]]).astype(np.float64)
+        rho = np.linalg.norm(d, axis=1) / max_edge_length
+        ni, nj = norm[s.edge_index[0]], norm[s.edge_index[1]]
+        feats = np.stack(
+            [rho, angle(ni, d), angle(nj, d), angle(ni, nj)], axis=1
+        ).astype(np.float32)
+        s.edge_attr = np.concatenate([s.edge_attr, feats], axis=1)
 
 
 def _prepare_samples(
@@ -142,6 +178,7 @@ def _prepare_samples(
         periodic_boundary_conditions=arch.get("periodic_boundary_conditions", False),
         rotational_invariance=ds_cfg.get("rotational_invariance", False),
         spherical_coordinates=desc.get("SphericalCoordinates", False),
+        point_pair_features=desc.get("PointPairFeatures", False),
     )
 
     update_predicted_values(
@@ -167,6 +204,7 @@ def prepare_dataset(
     minmax_node).
     """
     mm_g, mm_n = _prepare_samples(samples, config)
+    samples = _maybe_subsample(samples, config)
     train, val, test = split_dataset(
         samples,
         config["NeuralNetwork"]["Training"]["perc_train"],
@@ -175,6 +213,25 @@ def prepare_dataset(
         ),
     )
     return train, val, test, mm_g, mm_n
+
+
+def _maybe_subsample(samples: List[GraphSample], config: Dict) -> List[GraphSample]:
+    """Variables_of_interest.subsample_percentage: stratified downselect
+    after preparation, before splitting (reference: the __build_edge tail,
+    hydragnn/utils/abstractrawdataset.py:396-403).
+
+    Like the reference (which subsamples after __update_atom_features),
+    this runs after input-feature selection: the stratification category
+    reads x[:, 0] of the SELECTED features, so composition stratification
+    requires the composition/type column listed first in
+    ``input_node_features`` — otherwise the categories quietly degrade to
+    whatever feature 0 is."""
+    frac = config["NeuralNetwork"]["Variables_of_interest"].get("subsample_percentage")
+    if frac is None:
+        return samples
+    from hydragnn_tpu.data.splitting import stratified_subsample
+
+    return stratified_subsample(samples, float(frac))
 
 
 def prepare_presplit_dataset(
@@ -194,7 +251,15 @@ def prepare_presplit_dataset(
     merged = list(train) + list(val) + list(test)
     mm_g, mm_n = _prepare_samples(merged, config)
     a, b = counts[0], counts[0] + counts[1]
-    return merged[:a], merged[a:b], merged[b:], mm_g, mm_n
+    # per-split subsample preserves the predefined membership (the
+    # reference's serialized loader subsamples each split it loads)
+    return (
+        _maybe_subsample(merged[:a], config),
+        _maybe_subsample(merged[a:b], config),
+        _maybe_subsample(merged[b:], config),
+        mm_g,
+        mm_n,
+    )
 
 
 def load_raw_samples(config: Dict, path: str) -> List[GraphSample]:

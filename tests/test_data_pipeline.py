@@ -3,6 +3,8 @@ splitting, loader shapes. Mirrors the reference's unit-test strategy of a
 deterministic dataset with known closed-form structure (reference:
 tests/deterministic_graph_data.py, tests/test_periodic_boundary_conditions.py)."""
 
+from collections import Counter
+
 import numpy as np
 import pytest
 
@@ -320,3 +322,105 @@ def pytest_periodic_bcc_supercell():
     assert ei.shape[1] == 14 * n, ei.shape
     ei_loops = radius_graph_pbc(pos, radius, cell, loop=True)
     assert ei_loops.shape[1] == 15 * n, ei_loops.shape
+
+
+def pytest_stratified_subsample():
+    """Variables_of_interest.subsample_percentage downselects with
+    composition stratification (reference: stratified_sampling,
+    abstractrawdataset.py:412-452): ~the requested fraction overall,
+    every multi-member category still represented."""
+    from hydragnn_tpu.data.splitting import (
+        stratified_subsample,
+        subsample_categories,
+    )
+
+    samples = deterministic_graph_data(number_configurations=200, seed=2)
+    sub = stratified_subsample(list(samples), 0.3)
+    assert 0.2 * len(samples) <= len(sub) <= 0.45 * len(samples)
+    cats_all = Counter(subsample_categories(list(samples)))
+    cats_sub = set(subsample_categories(sub))
+    # floor allocation guarantees representation once frac * n >= 1
+    for c, n in cats_all.items():
+        if 0.3 * n >= 1:
+            assert c in cats_sub
+
+    with pytest.raises(ValueError):
+        stratified_subsample(list(samples), 0.0)
+    assert len(stratified_subsample(list(samples), 1.0)) == len(samples)
+
+
+def pytest_subsample_through_prepare_dataset():
+    config = base_config()
+    config["NeuralNetwork"]["Variables_of_interest"]["subsample_percentage"] = 0.5
+    # plain split: the stratified splitter would re-inflate the count by
+    # duplicating singleton categories (its own reference-parity behavior)
+    config["Dataset"]["compositional_stratified_splitting"] = False
+    samples = deterministic_graph_data(number_configurations=100, seed=5)
+    train, val, test, _, _ = prepare_dataset(samples, config)
+    assert len(train) + len(val) + len(test) == 50
+
+
+def pytest_point_pair_features():
+    """PointPairFeatures descriptor (reference usage:
+    abstractrawdataset.py:380-383; PyG transform semantics): 4 extra
+    edge-attr columns [rho_norm, angle(n_i,d), angle(n_j,d),
+    angle(n_i,n_j)], rotation-invariant, requiring meta['norm']."""
+    from hydragnn_tpu.data.ingest import build_edges
+
+    samples = deterministic_graph_data(number_configurations=6, seed=3)
+    for s in samples:
+        rng = np.random.default_rng(s.num_nodes)
+        n = rng.normal(size=(s.num_nodes, 3))
+        s.meta["norm"] = n / np.linalg.norm(n, axis=1, keepdims=True)
+    build_edges(samples, radius=2.0, max_neighbours=100, point_pair_features=True)
+    for s in samples:
+        assert s.edge_attr.shape[1] == 5  # length + 4 PPF columns
+        ppf = s.edge_attr[:, 1:]
+        assert (ppf[:, 0] >= 0).all() and (ppf[:, 0] <= 1.0 + 1e-6).all()
+        # angles in [0, pi]
+        assert (ppf[:, 1:] >= 0).all() and (ppf[:, 1:] <= np.pi + 1e-6).all()
+        # angle(n_i, n_j) symmetric in edge direction: the reversed edge
+        # (present in an undirected radius graph) has the same value
+        fwd = {(int(a), int(b)): v for a, b, v in zip(*s.edge_index, ppf[:, 3])}
+        for (a, b), v in fwd.items():
+            assert abs(fwd[(b, a)] - v) < 1e-5
+
+    # missing normals is a clear error, not a crash downstream
+    bad = deterministic_graph_data(number_configurations=2, seed=3)
+    with pytest.raises(ValueError, match="norm"):
+        build_edges(bad, radius=2.0, max_neighbours=100, point_pair_features=True)
+
+
+def pytest_descriptors_grow_edge_dim():
+    config = base_config()
+    config["NeuralNetwork"]["Architecture"]["model_type"] = "PNA"
+    config["NeuralNetwork"]["Architecture"]["edge_features"] = ["lengths"]
+    config["Dataset"]["Descriptors"] = {
+        "SphericalCoordinates": True,
+        "PointPairFeatures": True,
+    }
+    samples = deterministic_graph_data(number_configurations=30, seed=5)
+    for s in samples:
+        s.meta["norm"] = np.ones((s.num_nodes, 3), dtype=np.float32) / np.sqrt(3.0)
+    train, val, test, _, _ = prepare_dataset(samples, config)
+    config = update_config(config, train, val, test)
+    assert config["NeuralNetwork"]["Architecture"]["edge_dim"] == 1 + 2 + 4
+    for s in train:
+        assert s.edge_attr.shape[1] == 1 + 2 + 4
+
+    # the model consumes the widened edge attributes end-to-end
+    from hydragnn_tpu.models.create import create_model_config
+
+    loader = GraphLoader(train, 8)
+    example = next(iter(loader))
+    model, variables = create_model_config(config["NeuralNetwork"], example)
+    outputs = model.apply(variables, example, train=False)
+    assert all(np.isfinite(np.asarray(o)).all() for o in outputs)
+
+    # descriptors without edge_features: loud config error
+    config2 = base_config()
+    config2["Dataset"]["Descriptors"] = {"SphericalCoordinates": True}
+    samples2 = deterministic_graph_data(number_configurations=30, seed=5)
+    train2, val2, test2, _, _ = prepare_dataset(samples2, config2)
+    with pytest.raises(ValueError, match="edge_features"):
+        update_config(config2, train2, val2, test2)
