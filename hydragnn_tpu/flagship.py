@@ -92,9 +92,14 @@ def build_flagship(
     seed: int = 0,
     cache_device_batches: bool = False,
     edge_multiple: int = 8,
+    edge_lengths: bool = False,
 ):
-    """Returns (config, model, variables, train_loader)."""
+    """Returns (config, model, variables, train_loader). ``edge_lengths``
+    adds the reference's length edge feature (Architecture.edge_features,
+    QM9-style edge_dim=1 attributes through every conv)."""
     config = flagship_config(hidden_dim, num_conv_layers, batch_size)
+    if edge_lengths:
+        config["NeuralNetwork"]["Architecture"]["edge_features"] = ["lengths"]
     samples = deterministic_graph_data(
         number_configurations=n_samples,
         unit_cell_x_range=unit_cells,
