@@ -240,6 +240,9 @@ def _load_baseline(here: str) -> float | None:
                 rec.get("unit") == "graphs/sec"
                 and rec.get("value")
                 and rec.get("timing") == "d2h-sync"
+                # partial rounds (BENCH_CONFIGS=qm9 etc.) publish under
+                # their own metric name — never the flagship baseline
+                and rec.get("metric") == "flagship_pna_multihead_train_throughput"
             ):
                 return float(rec["value"])
         except (json.JSONDecodeError, KeyError, TypeError, ValueError, AttributeError):

@@ -93,10 +93,14 @@ def build_flagship(
     cache_device_batches: bool = False,
     edge_multiple: int = 8,
     edge_lengths: bool = False,
+    bn_axis_name: Optional[str] = None,
 ):
     """Returns (config, model, variables, train_loader). ``edge_lengths``
     adds the reference's length edge feature (Architecture.edge_features,
-    QM9-style edge_dim=1 attributes through every conv)."""
+    QM9-style edge_dim=1 attributes through every conv). ``bn_axis_name``
+    enables SyncBN over that mesh axis — required for a sharded step to
+    be numerically equivalent to the single-device step (each shard
+    otherwise normalizes with its local batch statistics)."""
     config = flagship_config(hidden_dim, num_conv_layers, batch_size)
     if edge_lengths:
         config["NeuralNetwork"]["Architecture"]["edge_features"] = ["lengths"]
@@ -123,5 +127,7 @@ def build_flagship(
     example = next(iter(loader))
     if device_stack > 1:
         example = jax.tree_util.tree_map(lambda x: x[0], example)
-    model, variables = create_model_config(config["NeuralNetwork"], example)
+    model, variables = create_model_config(
+        config["NeuralNetwork"], example, bn_axis_name=bn_axis_name
+    )
     return config, model, variables, loader

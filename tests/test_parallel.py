@@ -164,3 +164,19 @@ def pytest_sharded_remat_matches_plain(dp_problem):
         jax.tree_util.tree_leaves(results[1][1]),
     ):
         np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+
+
+def pytest_scaling_harness_loss_parity(monkeypatch):
+    """bench_scaling's harness on the virtual 8-device mesh: every mesh
+    width's first-step loss equals the 1-device run (DDP equivalence),
+    and the artifact has the full per-size schema."""
+    import bench_scaling
+
+    monkeypatch.setenv("BENCH_SMOKE", "1")
+    rec = bench_scaling.run(sizes=[1, 2, 4, 8])
+    assert rec["virtual_cpu_mesh"] is True
+    for d in ("1", "2", "4", "8"):
+        size = rec["sizes"][d]
+        assert size["loss_matches_serial"], (d, size)
+        assert size["graphs_per_sec"] > 0
+        assert size["parallel_efficiency"] > 0
