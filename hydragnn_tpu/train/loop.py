@@ -455,11 +455,17 @@ def train_validate_test(
             head_names=cfg.output_names,
             log_dir=log_dir,
         )
-    if visualizer is not None and hasattr(test_loader, "all_samples"):
+    # all_samples = the full split, not this process's shard; also reused
+    # by the final per-node plot dispatch
+    viz_nodes_per_graph = (
+        [s.num_nodes for s in test_loader.all_samples]
+        if visualizer is not None and hasattr(test_loader, "all_samples")
+        else None
+    )
+    if viz_nodes_per_graph is not None:
         # test-set node-count histogram at setup (reference: Visualizer
-        # num_nodes_plot wiring, train_validate_test.py:71-97);
-        # all_samples = the full split, not this process's shard
-        visualizer.num_nodes_plot([s.num_nodes for s in test_loader.all_samples])
+        # num_nodes_plot wiring, train_validate_test.py:71-97)
+        visualizer.num_nodes_plot(viz_nodes_per_graph)
     if visualizer is not None and plot_init_solution:
         _, _, tv, pv = test_epoch(
             test_loader, state, eval_step_out, cfg, verbosity, return_samples=True
@@ -626,6 +632,12 @@ def train_validate_test(
         )
         visualizer.create_scatter_plots(tv, pv)
         visualizer.create_plot_global(tv, pv)
+        # vector parity grids, per-node diagnostics (fixed-size graphs),
+        # and the scalar/vector global-analysis figures (reference:
+        # visualizer.py:134-280, 387-613)
+        visualizer.create_reference_plot_suite(
+            tv, pv, cfg.output_type, viz_nodes_per_graph
+        )
         visualizer.plot_history(history)
 
     return state, history

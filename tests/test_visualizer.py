@@ -51,4 +51,59 @@ def pytest_train_loop_writes_plots(tmp_path):
     assert any(f.startswith("scatter_") for f in pngs)
     assert any(f.startswith("errhist_") for f in pngs)
     assert any(f.startswith("global_") for f in pngs)
+    assert any(f.startswith("global_analysis_") for f in pngs)
     assert "history.png" in pngs
+
+
+def pytest_visualizer_vector_and_pernode(tmp_path):
+    """The reference plot families added in r02 (visualizer.py:134-280,
+    387-613): vector parity grids, per-node error histograms, per-node
+    vector parity grids, global-analysis figures — asserted on an
+    LSMS-style multihead layout (fixed 4-node graphs, scalar + 3-vector
+    nodal heads) with non-empty axes data."""
+    import matplotlib.pyplot as plt
+
+    rng = np.random.default_rng(1)
+    n_samples, n_nodes = 30, 4
+    viz = Visualizer(
+        "vtest2", num_heads=2, head_names=["charge", "moment"], log_dir=str(tmp_path)
+    )
+
+    # scalar nodal head: rows node-major [S * n_nodes, 1]
+    t_scalar = rng.normal(size=(n_samples * n_nodes, 1))
+    p_scalar = t_scalar + 0.05 * rng.normal(size=t_scalar.shape)
+    # 3-vector nodal head
+    t_vec = rng.normal(size=(n_samples * n_nodes, 3))
+    p_vec = t_vec + 0.05 * rng.normal(size=t_vec.shape)
+
+    paths = viz.create_reference_plot_suite(
+        [t_scalar, t_vec],
+        [p_scalar, p_vec],
+        output_types=["node", "node"],
+        nodes_per_graph=[n_nodes] * n_samples,
+    )
+    assert len(paths) >= 5  # vector grid, 2x per-node, 2x global analysis
+    for path in paths:
+        assert os.path.exists(path) and os.path.getsize(path) > 0
+
+    names = [os.path.basename(p) for p in paths]
+    assert "vector_moment.png" in names
+    assert "errhist_pernode_charge.png" in names
+    assert "parity_pernode_moment.png" in names
+    assert "global_analysis_charge.png" in names
+    assert "global_analysis_moment.png" in names
+
+    # non-empty axes data: re-render one figure and inspect its artists
+    fig_path = viz.create_parity_plot_vector("moment", t_vec, p_vec, 3)
+    assert os.path.getsize(fig_path) > 0
+    fig, ax = plt.subplots()
+    viz._parity_panel(ax, t_vec[:, 0], p_vec[:, 0])
+    assert ax.collections and ax.collections[0].get_offsets().shape[0] == len(t_vec)
+    plt.close(fig)
+
+    # ragged graph sizes: per-node panels correctly skipped, rest written
+    ragged = viz.create_reference_plot_suite(
+        [t_scalar], [p_scalar], output_types=["node"],
+        nodes_per_graph=[3, 4] * (n_samples * 2 // 2),
+    )
+    assert not any("pernode" in os.path.basename(p) for p in ragged)
