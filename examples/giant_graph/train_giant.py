@@ -119,9 +119,7 @@ def check_edge_residency(placed, n_devices: int) -> dict:
         acct[name] = {
             "global_rows": int(arr.shape[0]),
             "rows_per_device": int(shard_rows),
-            "bytes_per_device": int(
-                np.asarray(arr.addressable_shards[0].data).nbytes
-            ),
+            "bytes_per_device": int(arr.addressable_shards[0].data.nbytes),
         }
     # node features stay replicated: full rows on every device
     assert placed.nodes.addressable_shards[0].data.shape[0] == placed.nodes.shape[0]

@@ -274,36 +274,36 @@ class ContainerDataset:
         off = self._offsets[fname]
         return self._views[fname][off[idx] : off[idx + 1]]
 
-    def get(self, idx: int) -> GraphSample:
-        if not 0 <= idx < self.ndata:
-            raise IndexError(idx)
-        x = np.array(self.field_rows("x", idx))
-        sample = GraphSample(x=x)
+    def _assemble(self, rows) -> GraphSample:
+        """Build one GraphSample from a ``rows(fname) -> ndarray``
+        accessor (shared by the per-sample and bulk read paths)."""
+        sample = GraphSample(x=np.array(rows("x")))
         if "pos" in self._views:
-            sample.pos = np.array(self.field_rows("pos", idx))
+            sample.pos = np.array(rows("pos"))
         if "edge_index" in self._views:
-            sample.edge_index = np.ascontiguousarray(
-                self.field_rows("edge_index", idx).T
-            )
+            sample.edge_index = np.ascontiguousarray(rows("edge_index").T)
         if "edge_attr" in self._views:
-            sample.edge_attr = np.array(self.field_rows("edge_attr", idx))
+            sample.edge_attr = np.array(rows("edge_attr"))
         if "graph_y" in self._views:
-            sample.graph_y = np.array(self.field_rows("graph_y", idx)).reshape(-1)
+            sample.graph_y = np.array(rows("graph_y")).reshape(-1)
         for fname in self._views:
             if fname.startswith("gt_"):
-                sample.graph_targets[fname[3:]] = np.array(
-                    self.field_rows(fname, idx)
-                ).reshape(-1)
+                sample.graph_targets[fname[3:]] = np.array(rows(fname)).reshape(-1)
             elif fname.startswith("nt_"):
-                sample.node_targets[fname[3:]] = np.array(self.field_rows(fname, idx))
+                sample.node_targets[fname[3:]] = np.array(rows(fname))
         if "meta" in self._views:
-            raw = np.array(self.field_rows("meta", idx)).reshape(-1).tobytes()
+            raw = np.array(rows("meta")).reshape(-1).tobytes()
             if raw:
                 sample.meta = json.loads(raw.decode())
                 # PBC cells round-trip as arrays (ingest requires them)
                 if "cell" in sample.meta:
                     sample.meta["cell"] = np.asarray(sample.meta["cell"])
         return sample
+
+    def get(self, idx: int) -> GraphSample:
+        if not 0 <= idx < self.ndata:
+            raise IndexError(idx)
+        return self._assemble(lambda f: self.field_rows(f, idx))
 
     def __getitem__(self, idx: int) -> GraphSample:
         return self.get(idx)
@@ -312,6 +312,32 @@ class ContainerDataset:
         if indices is None:
             indices = range(self.ndata)
         return [self.get(i) for i in indices]
+
+    def fetch_samples(self, indices: Sequence[int]) -> List[GraphSample]:
+        """Materialize an index list with ONE bulk read per field — the
+        reference AdiosDataset's experimental bulk preflight/populate
+        loader (reference: hydragnn/utils/adiosdataset.py:389-437), here
+        backed by the native threaded ragged gather (hgc_gather) instead
+        of per-sample reads: each field's rows for ALL requested samples
+        arrive in a single packed buffer, then slice into GraphSamples."""
+        idx = [int(i) for i in indices]
+        for i in idx:
+            if not 0 <= i < self.ndata:
+                raise IndexError(i)
+        packed: Dict[str, np.ndarray] = {}
+        offs: Dict[str, np.ndarray] = {}
+        for fname in self._views:
+            rows, cnt = self.fetch_rows(fname, idx)
+            packed[fname] = rows
+            offs[fname] = np.concatenate([[0], np.cumsum(cnt)])
+        out: List[GraphSample] = []
+        for k in range(len(idx)):
+            out.append(
+                self._assemble(
+                    lambda f, k=k: packed[f][offs[f][k] : offs[f][k + 1]]
+                )
+            )
+        return out
 
     def fetch_rows(self, fname: str, indices: Sequence[int]) -> Tuple[np.ndarray, np.ndarray]:
         """Bulk ragged gather via the native threaded core: returns

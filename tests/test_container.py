@@ -142,3 +142,32 @@ def pytest_container_feeds_training(built_samples, tmp_path):
     _, loss, _ = make_train_step(model, tx)(state, batch)
     assert np.isfinite(float(loss))
     ds.close()
+
+
+def pytest_fetch_samples_bulk_matches_get(built_samples, tmp_path):
+    """fetch_samples materializes an index list in one bulk read per
+    field (reference: AdiosDataset bulk preflight loader,
+    adiosdataset.py:389-437) — must equal per-sample get() exactly, in
+    every mode, including out-of-order and repeated indices."""
+    samples, _, _ = built_samples
+    path = str(tmp_path / "bulk.hgc")
+    w = ContainerWriter(path)
+    w.add(samples[:12])
+    w.save()
+
+    for mode in ("mmap", "preload"):
+        ds = ContainerDataset(path, mode=mode)
+        idx = [7, 0, 3, 7, 11]
+        bulk = ds.fetch_samples(idx)
+        assert len(bulk) == len(idx)
+        for want_i, got in zip(idx, bulk):
+            ref = ds.get(want_i)
+            np.testing.assert_array_equal(got.x, ref.x)
+            np.testing.assert_array_equal(got.edge_index, ref.edge_index)
+            for k in ref.node_targets:
+                np.testing.assert_array_equal(got.node_targets[k], ref.node_targets[k])
+            for k in ref.graph_targets:
+                np.testing.assert_array_equal(got.graph_targets[k], ref.graph_targets[k])
+        with pytest.raises(IndexError):
+            ds.fetch_samples([0, 99])
+        ds.close()
