@@ -196,6 +196,21 @@ def batch_graphs(
         arr[:n_graphs] = np.stack(rows)
         graph_targets[name] = arr
 
+    # Canonical RECEIVER-MAJOR edge order: segment reductions may then
+    # assume indices_are_sorted (better XLA lowering; enables the Pallas
+    # CSR family kernel on TPU) regardless of the featurizer's emission
+    # order (the radius pipeline is already receiver-sorted; SMILES is
+    # sender-major). Stable sort; padding receivers (= tot_nodes
+    # sentinel) stay at the tail. Aggregation is order-invariant, so
+    # results are unchanged.
+    if not np.all(receivers[:-1] <= receivers[1:]):
+        perm = np.argsort(receivers, kind="stable")
+        senders = senders[perm]
+        receivers = receivers[perm]
+        edge_mask = edge_mask[perm]
+        if has_edge_attr:
+            edge_attr = edge_attr[perm]
+
     return GraphBatch(
         nodes=jnp.asarray(nodes),
         senders=jnp.asarray(senders),

@@ -207,12 +207,15 @@ class PNAConv(nn.Module):
         msg = nn.Dense(fin)(z)  # pre_nn, pre_layers=1
 
         # mean/std share one fused sum-family pass (sum, sumsq, count read
-        # the messages once — hydragnn_tpu/ops/segment_pallas.py); min/max
-        # are separate XLA segment reductions.
+        # the messages once — hydragnn_tpu/ops/segment_pallas.py).
+        # indices_are_sorted: the data pipeline emits edges receiver-major
+        # sorted (data/radius_graph.py:_cap_and_sort; batch_graphs keeps
+        # per-graph order under increasing node offsets), which also
+        # enables the Pallas kernel's CSR path on TPU.
         from hydragnn_tpu.ops import segment_sum_family
 
         msum, msumsq, cnt = segment_sum_family(
-            msg, ctx.receivers, n, mask=ctx.edge_mask
+            msg, ctx.receivers, n, mask=ctx.edge_mask, indices_are_sorted=True
         )
         # mean/var formed in f32 (the family op accumulates f32); cast
         # back to the compute dtype only after the cancellation
@@ -221,10 +224,20 @@ class PNAConv(nn.Module):
         # PyG 'std': sqrt(relu(mean(x^2) - mean(x)^2) + eps)
         var = jax.nn.relu(msumsq / safe_cnt - mean * mean)
         std = jnp.sqrt(var + 1e-5)
+        # min and max in ONE segment pass: max over [msg, -msg] — each
+        # XLA segment reduction has a fixed per-pass scatter cost on TPU
+        # (~0.4 ms at E=120k, H=128; docs/PERF.md), so halving the pass
+        # count beats materializing the [E, 2H] concat
+        both = S.segment_max(
+            jnp.concatenate([msg, -msg], axis=1),
+            ctx.receivers,
+            n,
+            mask=ctx.edge_mask,
+        )
         aggs = [
             mean.astype(msg.dtype),
-            S.segment_min(msg, ctx.receivers, n, mask=ctx.edge_mask),
-            S.segment_max(msg, ctx.receivers, n, mask=ctx.edge_mask),
+            -both[:, msg.shape[1] :],
+            both[:, : msg.shape[1]],
             std.astype(msg.dtype),
         ]
         agg = jnp.concatenate(aggs, axis=-1)  # [N, 4*fin]

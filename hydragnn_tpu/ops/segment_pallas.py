@@ -6,17 +6,24 @@ via PyG aggregators), which decomposes into three sum-reductions over the
 edge messages. Done naively that is 3+ scatter passes, each re-reading
 the [E, H] message array from HBM. Two fused implementations:
 
-  - ``segment_sum_family_xla``: one concatenated segment_sum — XLA reads
-    the messages once and scatters [E, 2H+1] rows. The default; on
-    TPU v5e XLA's sorted scatter runs at HBM bandwidth (measured: a
-    single 64k x 128 f32 segment-sum ~ 0.02-0.08 ms), so this is already
-    near-optimal.
+  - ``segment_sum_family_xla``: one concatenated segment_sum — XLA
+    reads the messages once and scatters [E, 2H+1] rows (measured
+    1.1-2.0 ms at E=120k, H=128 on v5e — ~7x off the HBM roofline).
   - ``segment_sum_family_pallas``: a Pallas TPU kernel — grid over
-    output node blocks with scalar-prefetched CSR row pointers, manual
-    HBM->VMEM DMA of edge chunks, and one-hot MXU matmul accumulation in
-    VMEM. One read of the messages, no scatter at all. Useful headroom
-    on hardware/shapes where XLA's scatter is not bandwidth-bound; kept
-    behind ``HYDRAGNN_PALLAS`` (1=pallas, 0=xla, default xla).
+    output node blocks with scalar-prefetched CSR row pointers,
+    DOUBLE-BUFFERED HBM->VMEM DMA of edge chunks, and one-hot MXU
+    matmul accumulation in VMEM (precision=HIGHEST: the MXU's default
+    path rounds f32 inputs to bf16). One read of the messages, no
+    scatter: measured 0.36 ms at the same shape — 5.5x over XLA
+    (docs/PERF.md). The TPU DEFAULT via ``HYDRAGNN_PALLAS=auto``
+    when receivers are sorted (batch_graphs canonicalizes
+    receiver-major order) and H % 128 == 0; ``0`` forces XLA,
+    ``1`` forces the kernel (sorting on the fly).
+
+Training goes through a hand-written gather VJP (``_family``): the
+kernel has no native autodiff, and the closed-form backward
+(g_sum[ids] + 2*data*g_sumsq[ids], masked) is cheaper than XLA's
+packed-scatter VJP anyway.
 
 The Pallas kernel requires ``segment_ids`` sorted ascending (it builds
 CSR block pointers by binary search); the XLA pass accepts any order.
@@ -51,14 +58,9 @@ def segment_sum_family_xla(
     segment_ids: jnp.ndarray,
     num_segments: int,
     mask: Optional[jnp.ndarray] = None,
+    indices_are_sorted: bool = False,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """(sum, sumsq, count) in ONE segment_sum over [E, 2H+1].
-
-    No sortedness hint: SMILES-featurized graphs order edges
-    sender-major (reference parity, smiles_utils.py sort), so receivers
-    are not guaranteed sorted here — a false ``indices_are_sorted`` is
-    undefined behavior. Measured cost of the unsorted scatter on v5e is
-    within noise of the sorted one."""
+    """(sum, sumsq, count) in ONE segment_sum over [E, 2H+1]."""
     # accumulate in f32 even under bf16 mixed precision: sum/sumsq feed a
     # variance cancellation (mean(x^2) - mean(x)^2) that bf16 cannot carry
     data = data.astype(jnp.float32)
@@ -68,7 +70,9 @@ def segment_sum_family_xla(
         data = data * m
         ones = ones * m
     packed = jnp.concatenate([data, data * data, ones], axis=-1)
-    out = jax.ops.segment_sum(packed, segment_ids, num_segments)
+    out = jax.ops.segment_sum(
+        packed, segment_ids, num_segments, indices_are_sorted=indices_are_sorted
+    )
     h = data.shape[1]
     return out[:, :h], out[:, h : 2 * h], out[:, 2 * h]
 
@@ -80,7 +84,9 @@ def _family_kernel(block_ptr_ref, msg_hbm, recv_hbm,
     (rows [i*BN, (i+1)*BN)). Edges arrive receiver-sorted, so the block's
     edges live in [block_ptr[i], block_ptr[i+1]); DMA windows are CE-
     aligned (Mosaic tiling) and stray edges from neighbouring blocks are
-    excluded by the one-hot receiver match itself."""
+    excluded by the one-hot receiver match itself. Chunks are
+    DOUBLE-BUFFERED: the next chunk's HBM->VMEM copies start before the
+    current chunk's matmuls, hiding DMA latency behind the MXU."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -94,30 +100,52 @@ def _family_kernel(block_ptr_ref, msg_hbm, recv_hbm,
     k0 = lo // CE
     k1 = (hi + CE - 1) // CE
 
-    def chunk_body(k, _):
+    def dmas(slot, k):
         start = pl.multiple_of(k * CE, CE)
-        cp_msg = pltpu.make_async_copy(
-            msg_hbm.at[pl.ds(start, CE), :], msg_vmem, sems.at[0]
+        return (
+            pltpu.make_async_copy(
+                msg_hbm.at[pl.ds(start, CE), :], msg_vmem.at[slot], sems.at[slot, 0]
+            ),
+            pltpu.make_async_copy(
+                recv_hbm.at[:, pl.ds(start, CE)], recv_vmem.at[slot], sems.at[slot, 1]
+            ),
         )
-        cp_recv = pltpu.make_async_copy(
-            recv_hbm.at[:, pl.ds(start, CE)], recv_vmem, sems.at[1]
-        )
-        cp_msg.start(); cp_recv.start()
-        cp_msg.wait(); cp_recv.wait()
 
-        msg = msg_vmem[:]
+    @pl.when(k0 < k1)
+    def _warmup():
+        for cp in dmas(k0 % 2, k0):
+            cp.start()
+
+    def chunk_body(k, _):
+        slot = k % 2
+
+        @pl.when(k + 1 < k1)
+        def _prefetch():
+            for cp in dmas((k + 1) % 2, k + 1):
+                cp.start()
+
+        for cp in dmas(slot, k):
+            cp.wait()
+
+        msg = msg_vmem[slot]
         # one-hot transpose [BN, CE]: row b hits edges whose receiver is
         # node i*BN + b (receivers outside this block match no row)
         rows = jax.lax.broadcasted_iota(jnp.int32, (BN, CE), 0) + i * BN
-        onehot_t = (recv_vmem[:] == rows).astype(jnp.float32)
+        onehot_t = (recv_vmem[slot] == rows).astype(jnp.float32)
 
+        # precision=HIGHEST: the MXU's default path rounds f32 inputs
+        # to bf16 (measured ~3e-3 absolute error on unit-scale sums —
+        # outside the family's f32-accumulation contract); the kernel is
+        # DMA-latency-bound, so the extra MXU passes are free
         sum_ref[:] += jax.lax.dot_general(
             onehot_t, msg, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
         )
         sumsq_ref[:] += jax.lax.dot_general(
             onehot_t, msg * msg, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
         )
         return 0
 
@@ -191,9 +219,9 @@ def segment_sum_family_pallas(
             pl.BlockSpec((BN, h), lambda i, ptr: (i, 0)),
         ],
         scratch_shapes=[
-            pltpu.VMEM((CE, h), jnp.float32),
-            pltpu.VMEM((1, CE), jnp.int32),
-            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.VMEM((2, CE, h), jnp.float32),
+            pltpu.VMEM((2, 1, CE), jnp.int32),
+            pltpu.SemaphoreType.DMA((2, 2)),
         ],
     )
     s, sq = pl.pallas_call(
@@ -208,20 +236,76 @@ def segment_sum_family_pallas(
     return s[:num_segments], sq[:num_segments], cnt
 
 
+def _family_impl(data, segment_ids, num_segments, mask, indices_are_sorted, use_pallas):
+    if use_pallas:
+        return segment_sum_family_pallas(
+            data, segment_ids, num_segments, mask,
+            indices_are_sorted=indices_are_sorted,
+        )
+    return segment_sum_family_xla(
+        data, segment_ids, num_segments, mask,
+        indices_are_sorted=indices_are_sorted,
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 4, 5))
+def _family(data, segment_ids, num_segments, mask, indices_are_sorted, use_pallas):
+    """Family with a hand-written gather backward: makes the Pallas
+    kernel trainable (pallas_call has no native VJP) and replaces XLA's
+    packed-scatter VJP with the closed form
+    d/d(data) = mask * (g_sum[ids] + 2 * data * g_sumsq[ids])."""
+    return _family_impl(data, segment_ids, num_segments, mask,
+                        indices_are_sorted, use_pallas)
+
+
+def _family_fwd(data, segment_ids, num_segments, mask, indices_are_sorted, use_pallas):
+    out = _family_impl(data, segment_ids, num_segments, mask,
+                       indices_are_sorted, use_pallas)
+    return out, (data, segment_ids, mask)
+
+
+def _family_bwd(num_segments, indices_are_sorted, use_pallas, res, g):
+    data, segment_ids, mask = res
+    g_sum, g_sumsq, _ = g  # count is data-independent
+    grad = g_sum[segment_ids] + 2.0 * data.astype(g_sum.dtype) * g_sumsq[segment_ids]
+    if mask is not None:
+        grad = jnp.where(mask[:, None], grad, 0)
+    ids_zero = jnp.zeros(segment_ids.shape, dtype=jax.dtypes.float0)
+    mask_zero = (
+        None if mask is None else jnp.zeros(mask.shape, dtype=jax.dtypes.float0)
+    )
+    return grad.astype(data.dtype), ids_zero, mask_zero
+
+
+_family.defvjp(_family_fwd, _family_bwd)
+
+
 def segment_sum_family(
     data: jnp.ndarray,
     segment_ids: jnp.ndarray,
     num_segments: int,
     mask: Optional[jnp.ndarray] = None,
+    indices_are_sorted: bool = False,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """Dispatch: HYDRAGNN_PALLAS=1 selects the Pallas kernel (TPU only,
-    feature width must be a lane-tile multiple of 128 — Mosaic DMA
-    constraint); default is the XLA fused pass (measured ~10% faster on
-    v5e at bench shapes, 135k edges x 128 features)."""
-    if (
-        os.environ.get("HYDRAGNN_PALLAS", "0") == "1"
-        and pallas_available()
-        and data.shape[1] % 128 == 0
-    ):
-        return segment_sum_family_pallas(data, segment_ids, num_segments, mask)
-    return segment_sum_family_xla(data, segment_ids, num_segments, mask)
+    """Dispatch. Default ("auto"): the double-buffered Pallas kernel on
+    TPU when the caller guarantees sorted receivers and the feature
+    width is a 128-lane multiple (measured 5.5x faster than the XLA
+    scatter at E=120k, H=128 on v5e — docs/PERF.md); the fused XLA pass
+    otherwise. HYDRAGNN_PALLAS=1 forces the kernel (sorting on the fly
+    if needed), HYDRAGNN_PALLAS=0 forces XLA — the escape hatch for
+    paths where a pallas_call cannot partition (e.g. PNA over
+    GSPMD-edge-sharded giant graphs)."""
+    knob = os.environ.get("HYDRAGNN_PALLAS", "auto")
+    if knob == "1":
+        use_pallas = pallas_available() and data.shape[1] % 128 == 0
+    elif knob == "0":
+        use_pallas = False
+    else:  # auto
+        use_pallas = (
+            pallas_available()
+            and data.shape[1] % 128 == 0
+            and indices_are_sorted
+            and jax.default_backend() == "tpu"
+        )
+    return _family(data, segment_ids, num_segments, mask,
+                   indices_are_sorted, use_pallas)
