@@ -233,6 +233,7 @@ class PNAConv(nn.Module):
             ctx.receivers,
             n,
             mask=ctx.edge_mask,
+            indices_are_sorted=True,
         )
         aggs = [
             mean.astype(msg.dtype),
