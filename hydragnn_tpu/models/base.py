@@ -196,6 +196,23 @@ class HydraModel(nn.Module):
                 # rebuilt from positions on every forward
                 from hydragnn_tpu.ops.dynamic_radius import radius_graph_in_forward
 
+                if batch.pos.shape[0] > 20_000:
+                    # trace-time (static shape): the builder computes an
+                    # all-pairs O(N_pad^2) distance matrix — molecular
+                    # batches only; supercell-scale pads would allocate
+                    # gigabytes in HBM before XLA fails opaquely
+                    import warnings
+
+                    warnings.warn(
+                        "radius_graph_in_forward is O(N_pad^2): node pad "
+                        f"{batch.pos.shape[0]} implies a "
+                        f"{batch.pos.shape[0] ** 2 * 4 / 1e9:.1f} GB distance "
+                        "matrix; precompute edges on host for graphs this "
+                        "large (Architecture.radius_graph_in_forward=false)",
+                        RuntimeWarning,
+                        stacklevel=2,
+                    )
+
                 senders, receivers, edge_weight, edge_mask = radius_graph_in_forward(
                     batch.pos,
                     batch.node_graph,

@@ -295,3 +295,36 @@ def test_schnet_inforward_matches_precomputed():
     out_dyn = model_dyn.apply(variables, batch, train=False)
     for a, b in zip(out_static, out_dyn):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5)
+
+
+def test_inforward_radius_warns_on_large_pad():
+    """The in-forward radius graph is O(N_pad^2); a supercell-scale node
+    pad must warn at trace time instead of failing opaquely in XLA."""
+    import dataclasses
+    import warnings
+
+    from hydragnn_tpu.graph.batch import pad_batch
+
+    rng = np.random.RandomState(3)
+    n = 6
+    pos = rng.rand(n, 3).astype(np.float32)
+    g = {
+        "x": rng.rand(n, 2).astype(np.float32),
+        "senders": np.array([0, 1], np.int32),
+        "receivers": np.array([1, 0], np.int32),
+        "pos": pos,
+        "graph_targets": {"energy": np.array([0.5])},
+        "node_targets": {"charge": rng.rand(n, 1).astype(np.float32)},
+    }
+    small = batch_graphs([g], n_node_pad=16, n_edge_pad=32, n_graph_pad=2)
+    cfg = dataclasses.replace(
+        make_cfg("SchNet"), radius=0.8, max_neighbours=4, inforward_radius=True
+    )
+    model, variables = create_model(cfg, small)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # small pad: no warning expected
+        model.apply(variables, small, train=False)
+
+    big = pad_batch(small, n_node=20_500, n_edge=32, n_graph=2)
+    with pytest.warns(RuntimeWarning, match="O\\(N_pad\\^2\\)"):
+        model.apply(variables, big, train=False)
