@@ -105,14 +105,19 @@ def _segment_extremum_fwd(data, segment_ids, num_segments, indices_are_sorted, i
 
 
 def _segment_extremum_bwd(num_segments, indices_are_sorted, is_max, res, g):
+    from hydragnn_tpu.ops.segment_pallas import segment_sum_fast
+
     data, segment_ids, out = res
     sel = data == out[segment_ids]
-    cnt = jax.ops.segment_sum(
+    # tie count: a full-width segment sum — the Pallas CSR kernel when
+    # ids are sorted on TPU (this is a backward hot path: PNA pays it
+    # every layer)
+    cnt = segment_sum_fast(
         sel.astype(data.dtype),
         segment_ids,
         num_segments,
         indices_are_sorted=indices_are_sorted,
-    )
+    ).astype(data.dtype)
     share = g / jnp.maximum(cnt, 1)
     grad = jnp.where(sel, share[segment_ids], 0)
     ids_zero = jnp.zeros(segment_ids.shape, dtype=jax.dtypes.float0)
@@ -210,6 +215,38 @@ def segment_softmax(
         exp, segment_ids, num_segments, indices_are_sorted=indices_are_sorted
     )
     return exp / jnp.maximum(denom[segment_ids], 1e-16)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def gather_rows(
+    x: jnp.ndarray,
+    ids: jnp.ndarray,
+    num_rows: int,
+    indices_are_sorted: bool = False,
+) -> jnp.ndarray:
+    """``x[ids]`` with a segment-sum backward that can exploit
+    sortedness: the VJP of a plain gather is a scatter-add XLA performs
+    without an ordering hint; routing it through
+    :func:`hydragnn_tpu.ops.segment_pallas.segment_sum_fast` uses the
+    Pallas CSR kernel on TPU for sorted ids (the per-layer
+    receiver-gather backward in every conv)."""
+    return x[ids]
+
+
+def _gather_rows_fwd(x, ids, num_rows, indices_are_sorted):
+    return x[ids], ids
+
+
+def _gather_rows_bwd(num_rows, indices_are_sorted, ids, g):
+    from hydragnn_tpu.ops.segment_pallas import segment_sum_fast
+
+    grad = segment_sum_fast(
+        g, ids, num_rows, indices_are_sorted=indices_are_sorted
+    ).astype(g.dtype)
+    return grad, jnp.zeros(ids.shape, dtype=jax.dtypes.float0)
+
+
+gather_rows.defvjp(_gather_rows_fwd, _gather_rows_bwd)
 
 
 def node_degree(

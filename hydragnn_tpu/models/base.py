@@ -205,10 +205,12 @@ class HydraModel(nn.Module):
 
                     warnings.warn(
                         "radius_graph_in_forward is O(N_pad^2): node pad "
-                        f"{batch.pos.shape[0]} implies a "
-                        f"{batch.pos.shape[0] ** 2 * 4 / 1e9:.1f} GB distance "
-                        "matrix; precompute edges on host for graphs this "
-                        "large (Architecture.radius_graph_in_forward=false)",
+                        f"{batch.pos.shape[0]} implies ~"
+                        f"{batch.pos.shape[0] ** 2 * 12 / 1e9:.1f} GB of "
+                        "pairwise temporaries (the [N,N,3] displacement "
+                        "tensor dominates); precompute edges on host for "
+                        "graphs this large "
+                        "(Architecture.radius_graph_in_forward=false)",
                         RuntimeWarning,
                         stacklevel=2,
                     )

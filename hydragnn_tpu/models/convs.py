@@ -198,7 +198,10 @@ class PNAConv(nn.Module):
     @nn.compact
     def __call__(self, x: jnp.ndarray, ctx: EdgeContext) -> jnp.ndarray:
         n, fin = x.shape
-        xi = x[ctx.receivers]
+        # receiver gather via gather_rows: its backward is a SORTED
+        # segment sum (Pallas CSR kernel on TPU) instead of XLA's
+        # unhinted scatter-add; senders are unsorted, plain gather
+        xi = S.gather_rows(x, ctx.receivers, n, True)
         xj = x[ctx.senders]
         z = [xi, xj]
         if self.edge_dim is not None and self.edge_dim > 0 and ctx.edge_attr is not None:

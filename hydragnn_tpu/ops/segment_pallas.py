@@ -85,71 +85,9 @@ def _family_kernel(block_ptr_ref, msg_hbm, recv_hbm,
     edges live in [block_ptr[i], block_ptr[i+1]); DMA windows are CE-
     aligned (Mosaic tiling) and stray edges from neighbouring blocks are
     excluded by the one-hot receiver match itself. Chunks are
-    DOUBLE-BUFFERED: the next chunk's HBM->VMEM copies start before the
-    current chunk's matmuls, hiding DMA latency behind the MXU."""
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
-    i = pl.program_id(0)
-    lo = block_ptr_ref[i]
-    hi = block_ptr_ref[i + 1]
-
-    sum_ref[:] = jnp.zeros_like(sum_ref)
-    sumsq_ref[:] = jnp.zeros_like(sumsq_ref)
-
-    k0 = lo // CE
-    k1 = (hi + CE - 1) // CE
-
-    def dmas(slot, k):
-        start = pl.multiple_of(k * CE, CE)
-        return (
-            pltpu.make_async_copy(
-                msg_hbm.at[pl.ds(start, CE), :], msg_vmem.at[slot], sems.at[slot, 0]
-            ),
-            pltpu.make_async_copy(
-                recv_hbm.at[:, pl.ds(start, CE)], recv_vmem.at[slot], sems.at[slot, 1]
-            ),
-        )
-
-    @pl.when(k0 < k1)
-    def _warmup():
-        for cp in dmas(k0 % 2, k0):
-            cp.start()
-
-    def chunk_body(k, _):
-        slot = k % 2
-
-        @pl.when(k + 1 < k1)
-        def _prefetch():
-            for cp in dmas((k + 1) % 2, k + 1):
-                cp.start()
-
-        for cp in dmas(slot, k):
-            cp.wait()
-
-        msg = msg_vmem[slot]
-        # one-hot transpose [BN, CE]: row b hits edges whose receiver is
-        # node i*BN + b (receivers outside this block match no row)
-        rows = jax.lax.broadcasted_iota(jnp.int32, (BN, CE), 0) + i * BN
-        onehot_t = (recv_vmem[slot] == rows).astype(jnp.float32)
-
-        # precision=HIGHEST: the MXU's default path rounds f32 inputs
-        # to bf16 (measured ~3e-3 absolute error on unit-scale sums —
-        # outside the family's f32-accumulation contract); the kernel is
-        # DMA-latency-bound, so the extra MXU passes are free
-        sum_ref[:] += jax.lax.dot_general(
-            onehot_t, msg, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
-        )
-        sumsq_ref[:] += jax.lax.dot_general(
-            onehot_t, msg * msg, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
-        )
-        return 0
-
-    jax.lax.fori_loop(k0, k1, chunk_body, 0)
+    DOUBLE-BUFFERED (see :func:`_csr_chunk_loop`)."""
+    _csr_chunk_loop(block_ptr_ref, msg_hbm, recv_hbm,
+                    msg_vmem, recv_vmem, sems, sum_ref, sumsq_ref)
 
 
 @functools.partial(
@@ -166,45 +104,19 @@ def segment_sum_family_pallas(
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    if not indices_are_sorted:
-        # the kernel's CSR block pointers require sorted receivers;
-        # SMILES-featurized graphs order edges sender-major, so sort
-        # unless the caller guarantees otherwise
-        order = jnp.argsort(segment_ids)
-        segment_ids = segment_ids[order]
-        data = data[order]
-        if mask is not None:
-            mask = mask[order]
-
-    e, h = data.shape
-    n_pad = ((num_segments + BN - 1) // BN) * BN
-    n_blocks = n_pad // BN
-
-    data = data.astype(jnp.float32)
-    ones = jnp.ones((e, 1), jnp.float32)
-    if mask is not None:
-        m = mask[:, None].astype(jnp.float32)
-        # zero masked messages; the one-hot matmuls then ignore them
-        data = data * m
-        ones = ones * m
+    # shared host-side prep (sort if needed, f32 + mask premultiply, CE
+    # tail padding with sentinel receivers, CSR block pointers)
+    data, sorted_ids, sorted_mask, recv, block_ptr, n_pad, n_blocks, h = _csr_prep(
+        data, segment_ids, mask, num_segments, indices_are_sorted
+    )
     # the count is an [E, 1] reduction — bandwidth-trivial next to the
     # [E, H] passes, so XLA keeps it while Pallas does the heavy lifting
+    ones = jnp.ones((sorted_ids.shape[0],), jnp.float32)
+    if sorted_mask is not None:
+        ones = ones * sorted_mask.astype(jnp.float32)
     cnt = jax.ops.segment_sum(
-        ones[:, 0], segment_ids, num_segments, indices_are_sorted=True
+        ones, sorted_ids, num_segments, indices_are_sorted=True
     )
-
-    # tail padding to a CE multiple: every DMA reads a fixed, aligned CE
-    # window; sentinel receivers (n_pad) match no block row
-    e_pad = ((e + CE - 1) // CE) * CE
-    data = jnp.concatenate([data, jnp.zeros((e_pad - e, h), jnp.float32)], axis=0)
-    recv = jnp.concatenate(
-        [segment_ids.astype(jnp.int32), jnp.full((e_pad - e,), n_pad, jnp.int32)]
-    )
-    # CSR row pointers at node-block boundaries (cheap log-search)
-    boundaries = jnp.arange(n_blocks + 1, dtype=jnp.int32) * BN
-    block_ptr = jnp.searchsorted(
-        recv[:e], boundaries, side="left"
-    ).astype(jnp.int32)
     recv_row = recv[None, :]  # [1, E]: receivers along lanes
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -234,6 +146,180 @@ def segment_sum_family_pallas(
         interpret=interpret,
     )(block_ptr, data, recv_row)
     return s[:num_segments], sq[:num_segments], cnt
+
+
+def _sum_kernel(block_ptr_ref, msg_hbm, recv_hbm, sum_ref,
+                msg_vmem, recv_vmem, sems):
+    """Sum-only sibling of :func:`_family_kernel` (one matmul per chunk)
+    — serves the VJP hot paths (gather backwards, extremum tie counts)
+    where only a plain segment sum is needed. Shares the DMA/one-hot
+    structure via :func:`_csr_chunk_loop`."""
+    _csr_chunk_loop(block_ptr_ref, msg_hbm, recv_hbm,
+                    msg_vmem, recv_vmem, sems, sum_ref, None)
+
+
+def _csr_chunk_loop(block_ptr_ref, msg_hbm, recv_hbm,
+                    msg_vmem, recv_vmem, sems, sum_ref, sumsq_ref):
+    """Shared double-buffered CSR chunk loop: accumulate the one-hot
+    matmul into ``sum_ref`` (and ``sumsq_ref`` when not None)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    i = pl.program_id(0)
+    lo = block_ptr_ref[i]
+    hi = block_ptr_ref[i + 1]
+    sum_ref[:] = jnp.zeros_like(sum_ref)
+    if sumsq_ref is not None:
+        sumsq_ref[:] = jnp.zeros_like(sumsq_ref)
+    k0 = lo // CE
+    k1 = (hi + CE - 1) // CE
+
+    def dmas(slot, k):
+        start = pl.multiple_of(k * CE, CE)
+        return (
+            pltpu.make_async_copy(
+                msg_hbm.at[pl.ds(start, CE), :], msg_vmem.at[slot], sems.at[slot, 0]
+            ),
+            pltpu.make_async_copy(
+                recv_hbm.at[:, pl.ds(start, CE)], recv_vmem.at[slot], sems.at[slot, 1]
+            ),
+        )
+
+    @pl.when(k0 < k1)
+    def _warmup():
+        for cp in dmas(k0 % 2, k0):
+            cp.start()
+
+    def chunk_body(k, _):
+        slot = k % 2
+
+        @pl.when(k + 1 < k1)
+        def _prefetch():
+            for cp in dmas((k + 1) % 2, k + 1):
+                cp.start()
+
+        for cp in dmas(slot, k):
+            cp.wait()
+        msg = msg_vmem[slot]
+        rows = jax.lax.broadcasted_iota(jnp.int32, (BN, CE), 0) + i * BN
+        onehot_t = (recv_vmem[slot] == rows).astype(jnp.float32)
+        # precision=HIGHEST: the MXU default rounds f32 inputs to bf16
+        sum_ref[:] += jax.lax.dot_general(
+            onehot_t, msg, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        if sumsq_ref is not None:
+            sumsq_ref[:] += jax.lax.dot_general(
+                onehot_t, msg * msg, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST,
+            )
+        return 0
+
+    jax.lax.fori_loop(k0, k1, chunk_body, 0)
+
+
+def _csr_prep(data, segment_ids, mask, num_segments, indices_are_sorted):
+    """Shared host-side prep: optional sort, f32 + mask premultiply, CE
+    tail padding with sentinel receivers, CSR block pointers."""
+    if not indices_are_sorted:
+        order = jnp.argsort(segment_ids)
+        segment_ids = segment_ids[order]
+        data = data[order]
+        if mask is not None:
+            mask = mask[order]
+    e, h = data.shape
+    n_pad = ((num_segments + BN - 1) // BN) * BN
+    data = data.astype(jnp.float32)
+    if mask is not None:
+        data = data * mask[:, None].astype(jnp.float32)
+    e_pad = ((e + CE - 1) // CE) * CE
+    data = jnp.concatenate([data, jnp.zeros((e_pad - e, h), jnp.float32)], axis=0)
+    recv = jnp.concatenate(
+        [segment_ids.astype(jnp.int32), jnp.full((e_pad - e,), n_pad, jnp.int32)]
+    )
+    n_blocks = n_pad // BN
+    boundaries = jnp.arange(n_blocks + 1, dtype=jnp.int32) * BN
+    block_ptr = jnp.searchsorted(recv[:e], boundaries, side="left").astype(jnp.int32)
+    return data, segment_ids, mask, recv, block_ptr, n_pad, n_blocks, h
+
+
+@functools.partial(
+    jax.jit, static_argnames=("num_segments", "interpret", "indices_are_sorted")
+)
+def segment_sum_pallas(
+    data: jnp.ndarray,
+    segment_ids: jnp.ndarray,
+    num_segments: int,
+    mask: Optional[jnp.ndarray] = None,
+    interpret: bool = False,
+    indices_are_sorted: bool = False,
+) -> jnp.ndarray:
+    """Plain segment sum through the double-buffered CSR kernel."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    data, _, _, recv, block_ptr, n_pad, n_blocks, h = _csr_prep(
+        data, segment_ids, mask, num_segments, indices_are_sorted
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_blocks,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=[pl.BlockSpec((BN, h), lambda i, ptr: (i, 0))],
+        scratch_shapes=[
+            pltpu.VMEM((2, CE, h), jnp.float32),
+            pltpu.VMEM((2, 1, CE), jnp.int32),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+    )
+    (s,) = pl.pallas_call(
+        _sum_kernel,
+        out_shape=[jax.ShapeDtypeStruct((n_pad, h), jnp.float32)],
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(block_ptr, data, recv[None, :])
+    return s[:num_segments]
+
+
+def segment_sum_fast(
+    data: jnp.ndarray,
+    segment_ids: jnp.ndarray,
+    num_segments: int,
+    mask: Optional[jnp.ndarray] = None,
+    indices_are_sorted: bool = False,
+) -> jnp.ndarray:
+    """Segment sum for VJP hot paths: the Pallas CSR kernel on TPU when
+    receivers are sorted and the width tiles (same knob contract as
+    :func:`segment_sum_family`: "1" forces the kernel, sorting on the
+    fly; "0" forces XLA; default auto), XLA otherwise. Not
+    differentiated itself — callers are custom backward functions."""
+    knob = os.environ.get("HYDRAGNN_PALLAS", "auto")
+    if knob == "1":
+        use_pallas = pallas_available() and data.shape[1] % 128 == 0
+    elif knob == "0":
+        use_pallas = False
+    else:
+        use_pallas = (
+            pallas_available()
+            and data.shape[1] % 128 == 0
+            and indices_are_sorted
+            and jax.default_backend() == "tpu"
+        )
+    if use_pallas:
+        return segment_sum_pallas(
+            data, segment_ids, num_segments, mask,
+            indices_are_sorted=indices_are_sorted,
+        )
+    if mask is not None:
+        data = data * mask[:, None].astype(data.dtype)
+    return jax.ops.segment_sum(
+        data, segment_ids, num_segments, indices_are_sorted=indices_are_sorted
+    )
 
 
 def _family_impl(data, segment_ids, num_segments, mask, indices_are_sorted, use_pallas):

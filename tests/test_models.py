@@ -325,6 +325,8 @@ def test_inforward_radius_warns_on_large_pad():
         warnings.simplefilter("error")  # small pad: no warning expected
         model.apply(variables, small, train=False)
 
+    # eval_shape: the warning fires at TRACE time, so the O(N^2) build
+    # itself (gigabytes of pairwise temporaries) never executes
     big = pad_batch(small, n_node=20_500, n_edge=32, n_graph=2)
     with pytest.warns(RuntimeWarning, match="O\\(N_pad\\^2\\)"):
-        model.apply(variables, big, train=False)
+        jax.eval_shape(lambda v, b: model.apply(v, b, train=False), variables, big)
