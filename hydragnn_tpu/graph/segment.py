@@ -249,6 +249,40 @@ def _gather_rows_bwd(num_rows, indices_are_sorted, ids, g):
 gather_rows.defvjp(_gather_rows_fwd, _gather_rows_bwd)
 
 
+@partial(jax.custom_vjp, nondiff_argnums=(3,))
+def gather_rows_permuted(
+    x: jnp.ndarray,
+    ids: jnp.ndarray,
+    perm: jnp.ndarray,
+    num_rows: int,
+) -> jnp.ndarray:
+    """``x[ids]`` for UNSORTED ids with a sorted-segment-sum backward:
+    ``perm`` must sort ``ids`` ascending (``perm = argsort(ids)``,
+    computed once per step by the chassis and reused by every layer).
+    The VJP permutes the cotangent into sorted order and reduces with
+    the sorted/Pallas segment sum — XLA's unsorted scatter-add costs
+    ~1.1 ms at [E=120k, H=128] on v5e vs ~0.5 ms this way."""
+    return x[ids]
+
+
+def _gather_rows_permuted_fwd(x, ids, perm, num_rows):
+    return x[ids], (ids, perm)
+
+
+def _gather_rows_permuted_bwd(num_rows, res, g):
+    from hydragnn_tpu.ops.segment_pallas import segment_sum_fast
+
+    ids, perm = res
+    grad = segment_sum_fast(
+        g[perm], ids[perm], num_rows, indices_are_sorted=True
+    ).astype(g.dtype)
+    f0 = jax.dtypes.float0
+    return grad, jnp.zeros(ids.shape, dtype=f0), jnp.zeros(perm.shape, dtype=f0)
+
+
+gather_rows_permuted.defvjp(_gather_rows_permuted_fwd, _gather_rows_permuted_bwd)
+
+
 def node_degree(
     receivers: jnp.ndarray,
     num_nodes: int,

@@ -254,6 +254,10 @@ class HydraModel(nn.Module):
             node_mask=batch.node_mask,
             edge_attr=edge_attr,
             edge_weight=edge_weight,
+            # one argsort per step, reused by every layer's sender-gather
+            # backward (convs._gather_senders) — the sorted segment sum
+            # beats XLA's unsorted scatter-add ~2x at flagship shapes
+            sender_perm=jnp.argsort(batch.senders),
         )
 
     def _apply_conv(self, conv, x, ctx, train: bool):

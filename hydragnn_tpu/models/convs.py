@@ -30,11 +30,28 @@ class EdgeContext:
     """Edge structure handed to every conv layer by the chassis."""
 
     senders: jnp.ndarray  # [E] int32
-    receivers: jnp.ndarray  # [E] int32
+    # CONTRACT: receivers must be sorted ascending (batch_graphs
+    # canonicalizes receiver-major edge order; radius_graph_in_forward
+    # emits it) — every conv passes indices_are_sorted=True to its
+    # segment reductions, and a violated hint silently corrupts sums
+    # on TPU rather than erroring.
+    receivers: jnp.ndarray  # [E] int32, sorted ascending
     edge_mask: jnp.ndarray  # [E] bool
     node_mask: jnp.ndarray  # [N] bool
     edge_attr: Optional[jnp.ndarray] = None  # [E, De]
     edge_weight: Optional[jnp.ndarray] = None  # [E] distances (SchNet)
+    # argsort(senders), computed ONCE per step by the chassis: lets every
+    # layer's sender-gather backward run as a SORTED segment sum (the
+    # Pallas CSR kernel on TPU) instead of XLA's unsorted scatter-add
+    sender_perm: Optional[jnp.ndarray] = None  # [E] int32
+
+
+def _gather_senders(x: jnp.ndarray, ctx: EdgeContext) -> jnp.ndarray:
+    """x[ctx.senders] with the fast permuted-gather backward when the
+    chassis provided ``sender_perm``."""
+    if ctx.sender_perm is not None:
+        return S.gather_rows_permuted(x, ctx.senders, ctx.sender_perm, x.shape[0])
+    return x[ctx.senders]
 
 
 class GINConv(nn.Module):
@@ -46,7 +63,10 @@ class GINConv(nn.Module):
     @nn.compact
     def __call__(self, x: jnp.ndarray, ctx: EdgeContext) -> jnp.ndarray:
         eps = self.param("eps", lambda _: jnp.asarray(100.0, jnp.float32))
-        agg = S.segment_sum(x[ctx.senders], ctx.receivers, x.shape[0], mask=ctx.edge_mask)
+        agg = S.segment_sum(
+            _gather_senders(x, ctx), ctx.receivers, x.shape[0],
+            mask=ctx.edge_mask, indices_are_sorted=True,
+        )
         h = (1.0 + eps) * x + agg
         h = nn.Dense(self.out_dim)(h)
         h = nn.relu(h)
@@ -62,7 +82,10 @@ class SAGEConv(nn.Module):
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, ctx: EdgeContext) -> jnp.ndarray:
-        agg = S.segment_mean(x[ctx.senders], ctx.receivers, x.shape[0], mask=ctx.edge_mask)
+        agg = S.segment_mean(
+            _gather_senders(x, ctx), ctx.receivers, x.shape[0],
+            mask=ctx.edge_mask, indices_are_sorted=True,
+        )
         return nn.Dense(self.out_dim)(agg) + nn.Dense(self.out_dim, use_bias=False)(x)
 
 
@@ -83,7 +106,10 @@ class MFConv(nn.Module):
     def __call__(self, x: jnp.ndarray, ctx: EdgeContext) -> jnp.ndarray:
         n, fin = x.shape
         ndeg = self.max_degree + 1
-        agg = S.segment_sum(x[ctx.senders], ctx.receivers, n, mask=ctx.edge_mask)
+        agg = S.segment_sum(
+            _gather_senders(x, ctx), ctx.receivers, n,
+            mask=ctx.edge_mask, indices_are_sorted=True,
+        )
         deg = S.node_degree(ctx.receivers, n, mask=ctx.edge_mask).astype(jnp.int32)
         deg = jnp.clip(deg, 0, self.max_degree)
 
@@ -122,15 +148,18 @@ class CGConv(nn.Module):
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, ctx: EdgeContext) -> jnp.ndarray:
-        xi = x[ctx.receivers]
-        xj = x[ctx.senders]
+        xi = S.gather_rows(x, ctx.receivers, x.shape[0], True)
+        xj = _gather_senders(x, ctx)
         z = [xi, xj]
         if ctx.edge_attr is not None:
             z.append(ctx.edge_attr)
         z = jnp.concatenate(z, axis=-1)
         gate = jax.nn.sigmoid(nn.Dense(self.out_dim)(z))
         core = jax.nn.softplus(nn.Dense(self.out_dim)(z))
-        agg = S.segment_sum(gate * core, ctx.receivers, x.shape[0], mask=ctx.edge_mask)
+        agg = S.segment_sum(
+            gate * core, ctx.receivers, x.shape[0],
+            mask=ctx.edge_mask, indices_are_sorted=True,
+        )
         return x + agg
 
 
@@ -202,7 +231,7 @@ class PNAConv(nn.Module):
         # segment sum (Pallas CSR kernel on TPU) instead of XLA's
         # unhinted scatter-add; senders are unsorted, plain gather
         xi = S.gather_rows(x, ctx.receivers, n, True)
-        xj = x[ctx.senders]
+        xj = _gather_senders(x, ctx)
         z = [xi, xj]
         if self.edge_dim is not None and self.edge_dim > 0 and ctx.edge_attr is not None:
             z.append(nn.Dense(fin)(ctx.edge_attr))
@@ -310,8 +339,11 @@ class CFConv(nn.Module):
         w = w * c[:, None]
 
         h = nn.Dense(self.num_filters, use_bias=False, kernel_init=xavier)(x)
-        msg = h[ctx.senders] * w
-        agg = S.segment_sum(msg, ctx.receivers, x.shape[0], mask=ctx.edge_mask)
+        msg = _gather_senders(h, ctx) * w
+        agg = S.segment_sum(
+            msg, ctx.receivers, x.shape[0],
+            mask=ctx.edge_mask, indices_are_sorted=True,
+        )
         return nn.Dense(self.out_dim, kernel_init=xavier)(agg)
 
 

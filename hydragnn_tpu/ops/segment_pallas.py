@@ -286,6 +286,24 @@ def segment_sum_pallas(
     return s[:num_segments]
 
 
+def _use_pallas(data: jnp.ndarray, indices_are_sorted: bool) -> bool:
+    """Shared HYDRAGNN_PALLAS knob contract: "1" forces the kernel
+    (sorting on the fly), "0" forces XLA, default auto = Pallas on TPU
+    for sorted, 2-D, 128-lane-multiple data."""
+    tiles = data.ndim == 2 and data.shape[1] % 128 == 0
+    knob = os.environ.get("HYDRAGNN_PALLAS", "auto")
+    if knob == "1":
+        return pallas_available() and tiles
+    if knob == "0":
+        return False
+    return (
+        pallas_available()
+        and tiles
+        and indices_are_sorted
+        and jax.default_backend() == "tpu"
+    )
+
+
 def segment_sum_fast(
     data: jnp.ndarray,
     segment_ids: jnp.ndarray,
@@ -298,19 +316,7 @@ def segment_sum_fast(
     :func:`segment_sum_family`: "1" forces the kernel, sorting on the
     fly; "0" forces XLA; default auto), XLA otherwise. Not
     differentiated itself — callers are custom backward functions."""
-    knob = os.environ.get("HYDRAGNN_PALLAS", "auto")
-    if knob == "1":
-        use_pallas = pallas_available() and data.shape[1] % 128 == 0
-    elif knob == "0":
-        use_pallas = False
-    else:
-        use_pallas = (
-            pallas_available()
-            and data.shape[1] % 128 == 0
-            and indices_are_sorted
-            and jax.default_backend() == "tpu"
-        )
-    if use_pallas:
+    if _use_pallas(data, indices_are_sorted):
         return segment_sum_pallas(
             data, segment_ids, num_segments, mask,
             indices_are_sorted=indices_are_sorted,
@@ -381,17 +387,6 @@ def segment_sum_family(
     if needed), HYDRAGNN_PALLAS=0 forces XLA — the escape hatch for
     paths where a pallas_call cannot partition (e.g. PNA over
     GSPMD-edge-sharded giant graphs)."""
-    knob = os.environ.get("HYDRAGNN_PALLAS", "auto")
-    if knob == "1":
-        use_pallas = pallas_available() and data.shape[1] % 128 == 0
-    elif knob == "0":
-        use_pallas = False
-    else:  # auto
-        use_pallas = (
-            pallas_available()
-            and data.shape[1] % 128 == 0
-            and indices_are_sorted
-            and jax.default_backend() == "tpu"
-        )
+    use_pallas = _use_pallas(data, indices_are_sorted)
     return _family(data, segment_ids, num_segments, mask,
                    indices_are_sorted, use_pallas)
