@@ -131,7 +131,7 @@ def segment_sum_family_pallas(
             pl.BlockSpec((BN, h), lambda i, ptr: (i, 0)),
         ],
         scratch_shapes=[
-            pltpu.VMEM((2, CE, h), jnp.float32),
+            pltpu.VMEM((2, CE, h), data.dtype),
             pltpu.VMEM((2, 1, CE), jnp.int32),
             pltpu.SemaphoreType.DMA((2, 2)),
         ],
@@ -200,7 +200,8 @@ def _csr_chunk_loop(block_ptr_ref, msg_hbm, recv_hbm,
 
         for cp in dmas(slot, k):
             cp.wait()
-        msg = msg_vmem[slot]
+        # upcast bf16 DMA payloads in registers; matmuls accumulate f32
+        msg = msg_vmem[slot].astype(jnp.float32)
         rows = jax.lax.broadcasted_iota(jnp.int32, (BN, CE), 0) + i * BN
         onehot_t = (recv_vmem[slot] == rows).astype(jnp.float32)
         # precision=HIGHEST: the MXU default rounds f32 inputs to bf16
@@ -231,11 +232,16 @@ def _csr_prep(data, segment_ids, mask, num_segments, indices_are_sorted):
             mask = mask[order]
     e, h = data.shape
     n_pad = ((num_segments + BN - 1) // BN) * BN
-    data = data.astype(jnp.float32)
+    # bf16 stays bf16: the kernel DMAs half the bytes and upcasts in
+    # registers before the f32-accumulating matmuls (under mixed
+    # precision the model already rounded the messages to bf16, so no
+    # information is lost); every other dtype goes f32
+    if data.dtype != jnp.bfloat16:
+        data = data.astype(jnp.float32)
     if mask is not None:
-        data = data * mask[:, None].astype(jnp.float32)
+        data = data * mask[:, None].astype(data.dtype)
     e_pad = ((e + CE - 1) // CE) * CE
-    data = jnp.concatenate([data, jnp.zeros((e_pad - e, h), jnp.float32)], axis=0)
+    data = jnp.concatenate([data, jnp.zeros((e_pad - e, h), data.dtype)], axis=0)
     recv = jnp.concatenate(
         [segment_ids.astype(jnp.int32), jnp.full((e_pad - e,), n_pad, jnp.int32)]
     )
@@ -272,7 +278,7 @@ def segment_sum_pallas(
         ],
         out_specs=[pl.BlockSpec((BN, h), lambda i, ptr: (i, 0))],
         scratch_shapes=[
-            pltpu.VMEM((2, CE, h), jnp.float32),
+            pltpu.VMEM((2, CE, h), data.dtype),
             pltpu.VMEM((2, 1, CE), jnp.int32),
             pltpu.SemaphoreType.DMA((2, 2)),
         ],
