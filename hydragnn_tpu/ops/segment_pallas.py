@@ -104,8 +104,8 @@ def segment_sum_family_pallas(
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    # shared host-side prep (sort if needed, f32 + mask premultiply, CE
-    # tail padding with sentinel receivers, CSR block pointers)
+    # shared host-side prep (sort if needed, dtype/mask normalization,
+    # CE tail padding with sentinel receivers, CSR block pointers)
     data, sorted_ids, sorted_mask, recv, block_ptr, n_pad, n_blocks, h = _csr_prep(
         data, segment_ids, mask, num_segments, indices_are_sorted
     )
@@ -222,8 +222,11 @@ def _csr_chunk_loop(block_ptr_ref, msg_hbm, recv_hbm,
 
 
 def _csr_prep(data, segment_ids, mask, num_segments, indices_are_sorted):
-    """Shared host-side prep: optional sort, f32 + mask premultiply, CE
-    tail padding with sentinel receivers, CSR block pointers."""
+    """Shared host-side prep: optional sort, dtype normalization (bf16
+    stays bf16 for half-width DMA, everything else goes f32), mask
+    premultiply (always in f32 so non-boolean weight masks keep full
+    precision), CE tail padding with sentinel receivers, CSR block
+    pointers."""
     if not indices_are_sorted:
         order = jnp.argsort(segment_ids)
         segment_ids = segment_ids[order]
@@ -239,7 +242,11 @@ def _csr_prep(data, segment_ids, mask, num_segments, indices_are_sorted):
     if data.dtype != jnp.bfloat16:
         data = data.astype(jnp.float32)
     if mask is not None:
-        data = data * mask[:, None].astype(data.dtype)
+        # multiply in f32 then round once: a non-boolean weight mask must
+        # not be pre-rounded to bf16 (double-rounding precision cliff)
+        data = (
+            data.astype(jnp.float32) * mask[:, None].astype(jnp.float32)
+        ).astype(data.dtype)
     e_pad = ((e + CE - 1) // CE) * CE
     data = jnp.concatenate([data, jnp.zeros((e_pad - e, h), data.dtype)], axis=0)
     recv = jnp.concatenate(

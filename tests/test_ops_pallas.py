@@ -219,3 +219,41 @@ def pytest_gather_rows_permuted_grad_matches_plain():
     np.testing.assert_allclose(
         np.asarray(g_custom), np.asarray(g_plain), rtol=1e-5, atol=1e-6
     )
+
+
+def pytest_family_pallas_bf16_path():
+    """The kernel's bf16 DMA path: bf16 inputs, f32 accumulation — must
+    match the XLA family on the same bf16 data (interpret mode), and a
+    non-boolean weight mask must not be double-rounded."""
+    from hydragnn_tpu.ops.segment_pallas import (
+        segment_sum_family_pallas,
+        segment_sum_family_xla,
+        segment_sum_pallas,
+    )
+
+    rng = np.random.default_rng(11)
+    e, h, n = 700, 128, 150
+    data = jnp.asarray(rng.normal(size=(e, h)).astype(np.float32)).astype(jnp.bfloat16)
+    seg = jnp.asarray(np.sort(rng.integers(0, n, e)).astype(np.int32))
+    mask = jnp.asarray(rng.random(e) > 0.3)
+
+    s_ref, sq_ref, c_ref = segment_sum_family_xla(data, seg, n, mask=mask)
+    s_out, sq_out, c_out = segment_sum_family_pallas(
+        data, seg, n, mask=mask, interpret=True, indices_are_sorted=True
+    )
+    np.testing.assert_allclose(np.asarray(s_out), np.asarray(s_ref), rtol=1e-4, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(sq_out), np.asarray(sq_ref), rtol=1e-4, atol=1e-3)
+    np.testing.assert_array_equal(np.asarray(c_out), np.asarray(c_ref))
+    # outputs accumulate f32 even from bf16 inputs
+    assert s_out.dtype == jnp.float32 and sq_out.dtype == jnp.float32
+
+    # float weight mask with bf16 data: premultiply happens in f32
+    wmask = jnp.asarray(rng.random(e).astype(np.float32))
+    ref = jax.ops.segment_sum(
+        (data.astype(jnp.float32) * wmask[:, None]).astype(jnp.bfloat16).astype(jnp.float32),
+        seg, n,
+    )
+    out = segment_sum_pallas(
+        data, seg, n, mask=wmask, interpret=True, indices_are_sorted=True
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-3)
