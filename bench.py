@@ -187,6 +187,44 @@ def _bench_one(
         dt = time.perf_counter() - t0
 
     step_s = dt / done
+
+    # scan-slope step time (VERDICT r02 item 4): chain the step K times
+    # inside one lax.scan dispatch and take the slope between two K
+    # values — cancels the tunnel's per-dispatch RTT + server overhead
+    # (10-120 ms depending on burst history), which otherwise pollutes
+    # small configs whose step is cheaper than the dispatch floor. Costs
+    # 2 compiles + 2 dispatches per config. (The full-epoch scan_epoch
+    # path is a different executable with its own tunnel pathology —
+    # docs/PERF.md; this is the same per-step body, chained.)
+    scan_step_ms = None
+    smoke_default = "0" if os.environ.get("BENCH_SMOKE", "0") == "1" else "1"
+    if os.environ.get("BENCH_SCAN_SLOPE", smoke_default) == "1":
+        from hydragnn_tpu.train.state import _train_step_body
+        from hydragnn_tpu.utils.profile import scan_slope_ms
+
+        body = _train_step_body(model, tx, compute_dtype=compute_dtype)
+        batch0 = batches[0]
+
+        def make_chain(k: int):
+            def f(st, _):
+                st, loss, _ = body(st, batch0)
+                return st, loss
+
+            fn = jax.jit(lambda st: jax.lax.scan(f, st, None, length=k))
+
+            def run():
+                _, losses = fn(state)
+                np.asarray(losses[-1])  # real D2H sync
+
+            return run
+
+        k1, k2 = (2, 4) if measure_steps <= 4 else (4, 12)
+        scan_step_ms = scan_slope_ms(make_chain, k1, k2)
+        if scan_step_ms <= 0:
+            # two timed dispatches under burst-varying RTT can invert;
+            # a non-positive slope is noise — don't record garbage
+            scan_step_ms = None
+
     real_nodes = float(
         sum(s.num_nodes for s in loader.samples) / max(len(loader.samples), 1)
     )
@@ -202,14 +240,22 @@ def _bench_one(
         "hidden_dim": hidden,
         "num_conv_layers": layers,
     }
+    if scan_step_ms is not None:
+        out["scan_step_ms"] = round(scan_step_ms, 3)
+        out["graphs_per_sec_scan"] = round(batch_size / max(scan_step_ms, 1e-9) * 1e3, 2)
+    scan_s = (scan_step_ms or 0.0) / 1e3
     if flops:
         out["flops_per_step"] = flops
         out["achieved_tflops"] = round(flops / step_s / 1e12, 3)
         if peak:
             out["mfu"] = round(flops / step_s / peak, 4)
+            if scan_s > 0:
+                out["mfu_scan"] = round(flops / scan_s / peak, 4)
     if nbytes:
         out["bytes_per_step"] = nbytes
         out["hbm_gbps"] = round(nbytes / step_s / 1e9, 1)
+        if scan_s > 0:
+            out["hbm_gbps_scan"] = round(nbytes / scan_s / 1e9, 1)
         if flops:
             out["arithmetic_intensity"] = round(flops / nbytes, 2)
     return out
@@ -251,10 +297,15 @@ def _load_baseline(here: str) -> float | None:
 
 
 def main() -> None:
+    # honor an explicit JAX_PLATFORMS (e.g. cpu for CI smoke) — the axon
+    # plugin image overrides the env unless pinned through jax.config
+    # BEFORE backend init (hydragnn_tpu/utils/platform.py); without a
+    # pin the bench stays on the real device the driver provides
+    from hydragnn_tpu.utils.platform import pin_platform_from_env
+
+    pin_platform_from_env()
     import jax
 
-    # keep bench on the real device the driver provides (TPU under axon,
-    # else whatever the default backend is)
     device = jax.devices()[0]
     peak = _peak_flops(device)
     bf16 = os.environ.get("BENCH_BF16", "1") == "1"

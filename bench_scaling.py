@@ -134,6 +134,7 @@ def run(sizes=None) -> dict:
             "first_step_loss": first_loss,
             "loss_matches_serial": bool(loss_ok),
         }
+    on_cpu = jax.default_backend() == "cpu"
     return {
         "metric": "scaling_efficiency",
         "unit": "graphs/sec/chip",
@@ -141,7 +142,13 @@ def run(sizes=None) -> dict:
         "steps": steps,
         "device": getattr(jax.devices()[0], "device_kind", "unknown"),
         "n_devices_visible": n_dev,
-        "virtual_cpu_mesh": jax.default_backend() == "cpu",
+        "virtual_cpu_mesh": on_cpu,
+        # On a virtual CPU mesh the "devices" contend for the same host
+        # cores, so the efficiency column carries NO information about
+        # TPU scaling — the artifact's real content is loss_matches_serial
+        # (VERDICT r02 item 8). Timing columns are meaningful only on
+        # real multi-chip hardware.
+        "efficiency_meaningful": not on_cpu,
         "sizes": results,
     }
 

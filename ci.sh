@@ -1,0 +1,62 @@
+#!/usr/bin/env bash
+# CI protocol runner — the committed encoding of the test discipline
+# (VERDICT r02 item 7), mirroring the reference's CI pipeline
+# (/root/reference/.github/workflows/CI.yml: black format gate, serial
+# pytest, the same suite again under mpirun -n 2).
+#
+# Stages:
+#   1. format gate      — `black --check .` when black is installed; the
+#                         baked TPU image ships no formatter, so the gate
+#                         degrades to a full-tree syntax check (compileall)
+#                         and prints which gate ran.
+#   2. serial suite     — python -m pytest tests/ -q on the virtual
+#                         8-device CPU mesh (conftest pins it). This
+#                         INCLUDES the 2-OS-process distributed pass: the
+#                         reference re-runs its whole suite under
+#                         `mpirun -n 2`; here the multi-process rendezvous
+#                         is exercised by tests/test_multiprocess.py, which
+#                         spawns 2 python processes with a shared
+#                         coordinator itself (TPU-native launch shape —
+#                         jax.distributed, not MPI).
+#   3. full matrix      — opt-in (CI_FULL=1): all 7 models x head configs
+#                         trained to the reference accuracy thresholds
+#                         (HYDRAGNN_FULL_MATRIX=1, ~15 min).
+#   4. TPU kernel suite — opt-in (CI_TPU=1, needs a real TPU):
+#                         HYDRAGNN_TPU_TESTS=1 on-chip kernel-vs-XLA
+#                         checks, budgeted under the tunnel's dispatch
+#                         throttle (tests/test_tpu_chip.py).
+#
+# Usage: ./ci.sh            # stages 1-2 (the default CI gate)
+#        CI_FULL=1 ./ci.sh  # + acceptance matrix
+#        CI_TPU=1  ./ci.sh  # + real-chip kernel suite
+set -euo pipefail
+cd "$(dirname "$0")"
+
+echo "== [1/4] format gate =="
+if python -m black --version >/dev/null 2>&1; then
+    python -m black --check .
+elif command -v black >/dev/null 2>&1; then
+    black --check .
+else
+    echo "black not installed in this image; running syntax gate (compileall)"
+    python -m compileall -q hydragnn_tpu tests examples bench.py bench_scaling.py __graft_entry__.py
+fi
+
+echo "== [2/4] serial suite (virtual 8-device CPU mesh, incl. 2-process pass) =="
+python -m pytest tests/ -q
+
+if [ "${CI_FULL:-0}" = "1" ]; then
+    echo "== [3/4] full acceptance matrix (reference thresholds) =="
+    HYDRAGNN_FULL_MATRIX=1 python -m pytest tests/test_train_matrix.py -q
+else
+    echo "== [3/4] full acceptance matrix: skipped (set CI_FULL=1) =="
+fi
+
+if [ "${CI_TPU:-0}" = "1" ]; then
+    echo "== [4/4] real-chip TPU kernel suite =="
+    HYDRAGNN_TPU_TESTS=1 python -m pytest tests/test_tpu_chip.py -q
+else
+    echo "== [4/4] real-chip TPU kernel suite: skipped (set CI_TPU=1, needs a TPU) =="
+fi
+
+echo "CI protocol complete."

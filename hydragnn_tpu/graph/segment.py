@@ -111,15 +111,21 @@ def _segment_extremum_bwd(num_segments, indices_are_sorted, is_max, res, g):
     sel = data == out[segment_ids]
     # tie count: a full-width segment sum — the Pallas CSR kernel when
     # ids are sorted on TPU (this is a backward hot path: PNA pays it
-    # every layer)
+    # every layer). The 0/1 tie mask travels in the DATA dtype (half
+    # HBM bytes under bf16 — 0/1 are exact in bf16), while the
+    # ACCUMULATION is >= f32 by segment_sum_fast's contract, so counts
+    # above 256 stay exact; count and share math stays f32 (bf16 cannot
+    # represent large counts, mis-splitting heavily-tied segments).
     cnt = segment_sum_fast(
         sel.astype(data.dtype),
         segment_ids,
         num_segments,
         indices_are_sorted=indices_are_sorted,
-    ).astype(data.dtype)
-    share = g / jnp.maximum(cnt, 1)
-    grad = jnp.where(sel, share[segment_ids], 0)
+    ).astype(jnp.float32)
+    share = g.astype(jnp.float32) / jnp.maximum(cnt, 1.0)
+    # cast BEFORE the [E, H]-widening gather: halves the gather's HBM
+    # write under bf16; the final cotangent is data.dtype anyway
+    grad = jnp.where(sel, share.astype(data.dtype)[segment_ids], 0)
     ids_zero = jnp.zeros(segment_ids.shape, dtype=jax.dtypes.float0)
     return grad, ids_zero
 

@@ -214,6 +214,26 @@ class PNAConv(nn.Module):
     aggregators [mean,min,max,std], scalers [identity,amplification,
     attenuation,linear], towers=1, pre/post_layers=1, divide_input=False).
 
+    TPU-first message elimination: the pre-aggregation network is ONE
+    linear layer (pre_layers=1), so the per-edge message decomposes
+    exactly as
+
+        msg_e = W @ [x_i, x_j, e_ij] + b
+              = (x_i @ W_i + b) + x_j @ W_j + e_ij @ W_e
+              =       a[recv_e] +  bsend[send_e] + c_e
+
+    with ``a``/``bsend`` computed as NODE-level matmuls. Every PNA
+    aggregator then needs only segment reductions of v_e = bsend[send_e]
+    (+ c_e) over receivers: mean(msg) = a + mean(v), max(msg) = a +
+    max(v), min likewise, and std(msg) = std(v) because variance is
+    shift-invariant. The [E, 3H] concat, the [E, *] pre-Dense matmul,
+    and the [E, H] message array — plus all their backward mirrors —
+    never exist; the only edge-width intermediate is the single gather
+    ``v``. This is the r03 answer to the measured HBM-bound profile
+    (161 GB/step at 995 GFLOPs — docs/PERF.md): attack bytes, not
+    roofline fraction. The torch path cannot do this: PyG materializes
+    messages per edge by design (torch_geometric MessagePassing).
+
     ``avg_deg_lin``/``avg_deg_log`` are precomputed on host from the
     train-set degree histogram (reference: hydragnn/utils/model.py:92-109,
     config_utils.py:54-58) so the layer itself is purely static.
@@ -227,55 +247,67 @@ class PNAConv(nn.Module):
     @nn.compact
     def __call__(self, x: jnp.ndarray, ctx: EdgeContext) -> jnp.ndarray:
         n, fin = x.shape
-        # receiver gather via gather_rows: its backward is a SORTED
-        # segment sum (Pallas CSR kernel on TPU) instead of XLA's
-        # unhinted scatter-add; senders are unsorted, plain gather
-        xi = S.gather_rows(x, ctx.receivers, n, True)
-        xj = _gather_senders(x, ctx)
-        z = [xi, xj]
-        if self.edge_dim is not None and self.edge_dim > 0 and ctx.edge_attr is not None:
-            z.append(nn.Dense(fin)(ctx.edge_attr))
-        z = jnp.concatenate(z, axis=-1)
-        msg = nn.Dense(fin)(z)  # pre_nn, pre_layers=1
+        use_edge = (
+            self.edge_dim is not None and self.edge_dim > 0 and ctx.edge_attr is not None
+        )
+        # pre_nn (pre_layers=1) as explicit slices of one kernel so the
+        # receiver/sender parts apply at node level. Init matches
+        # nn.Dense(fin) on the concat: lecun_normal with fan_in = zdim.
+        zdim = (3 if use_edge else 2) * fin
+        w = self.param("pre_kernel", nn.initializers.lecun_normal(), (zdim, fin))
+        b_pre = self.param("pre_bias", nn.initializers.zeros, (fin,))
+        w = w.astype(x.dtype)
+        a = x @ w[:fin] + b_pre.astype(x.dtype)  # receiver part [N, fin]
+        bsend = x @ w[fin : 2 * fin]  # sender part [N, fin]
 
-        # mean/std share one fused sum-family pass (sum, sumsq, count read
-        # the messages once — hydragnn_tpu/ops/segment_pallas.py).
+        # the ONLY edge-width intermediate: v_e = bsend[send_e] (+ edge
+        # term). The sender gather's backward is a sorted segment sum via
+        # the chassis-provided argsort (convs._gather_senders).
+        v = _gather_senders(bsend, ctx)
+        if use_edge:
+            v = v + nn.Dense(fin)(ctx.edge_attr) @ w[2 * fin :]
+
+        # mean/std share one fused sum-family pass over v (sum, sumsq,
+        # count read v once — hydragnn_tpu/ops/segment_pallas.py).
         # indices_are_sorted: the data pipeline emits edges receiver-major
         # sorted (data/radius_graph.py:_cap_and_sort; batch_graphs keeps
         # per-graph order under increasing node offsets), which also
         # enables the Pallas kernel's CSR path on TPU.
         from hydragnn_tpu.ops import segment_sum_family
 
-        msum, msumsq, cnt = segment_sum_family(
-            msg, ctx.receivers, n, mask=ctx.edge_mask, indices_are_sorted=True
+        vsum, vsumsq, cnt = segment_sum_family(
+            v, ctx.receivers, n, mask=ctx.edge_mask, indices_are_sorted=True
         )
         # mean/var formed in f32 (the family op accumulates f32); cast
         # back to the compute dtype only after the cancellation
         safe_cnt = jnp.maximum(cnt, 1.0)[:, None]
-        mean = msum / safe_cnt
-        # PyG 'std': sqrt(relu(mean(x^2) - mean(x)^2) + eps)
-        var = jax.nn.relu(msumsq / safe_cnt - mean * mean)
+        has = (cnt > 0.0)[:, None]
+        mean_v = vsum / safe_cnt
+        mean = jnp.where(has, a.astype(jnp.float32) + mean_v, 0.0)
+        # PyG 'std': sqrt(relu(mean(x^2) - mean(x)^2) + eps); the a-shift
+        # cancels exactly, so this is the variance of v alone — and for
+        # empty receivers sqrt(eps), digit-identical to the message form
+        var = jax.nn.relu(vsumsq / safe_cnt - mean_v * mean_v)
         std = jnp.sqrt(var + 1e-5)
-        # min and max in ONE segment pass: max over [msg, -msg] — each
-        # XLA segment reduction has a fixed per-pass scatter cost on TPU
-        # (~0.4 ms at E=120k, H=128; docs/PERF.md), so halving the pass
-        # count beats materializing the [E, 2H] concat
-        both = S.segment_max(
-            jnp.concatenate([msg, -msg], axis=1),
-            ctx.receivers,
-            n,
-            mask=ctx.edge_mask,
-            indices_are_sorted=True,
+        # min/max read the materialized v directly — two passes of [E,H]
+        # reads beat the old fused-[v,-v] trick's [E,2H] concat
+        # write+read now that no message array exists to share
+        has_c = has.astype(v.dtype)
+        max_v = S.segment_max(
+            v, ctx.receivers, n, mask=ctx.edge_mask, indices_are_sorted=True
+        )
+        min_v = S.segment_min(
+            v, ctx.receivers, n, mask=ctx.edge_mask, indices_are_sorted=True
         )
         aggs = [
-            mean.astype(msg.dtype),
-            -both[:, msg.shape[1] :],
-            both[:, : msg.shape[1]],
-            std.astype(msg.dtype),
+            mean.astype(v.dtype),
+            (a + min_v) * has_c,
+            (a + max_v) * has_c,
+            std.astype(v.dtype),
         ]
         agg = jnp.concatenate(aggs, axis=-1)  # [N, 4*fin]
 
-        deg = jnp.maximum(cnt, 1.0).astype(msg.dtype)
+        deg = jnp.maximum(cnt, 1.0).astype(v.dtype)
         log_deg = jnp.log(deg + 1.0)[:, None]
         amplification = log_deg / self.avg_deg_log
         attenuation = self.avg_deg_log / log_deg

@@ -17,21 +17,47 @@ the [E, H] message array from HBM. Two fused implementations:
     scatter: measured 0.36 ms at the same shape — 5.5x over XLA
     (docs/PERF.md). The TPU DEFAULT via ``HYDRAGNN_PALLAS=auto``
     when receivers are sorted (batch_graphs canonicalizes
-    receiver-major order) and H % 128 == 0; ``0`` forces XLA,
-    ``1`` forces the kernel (sorting on the fly).
+    receiver-major order) and H % 128 == 0.
+
+SPMD composition: the kernel calls are wrapped in
+``jax.experimental.custom_partitioning`` with an edge-axis rule — when
+GSPMD shards the operands on their leading (edge) axis (the giant-graph
+path, ``parallel/edge_sharded.py:place_giant_batch``), each device runs
+the CSR kernel on its LOCAL edge slice (a contiguous receiver-sorted
+range, so the CSR contract holds per shard) and one ``psum`` over the
+sharded axis combines the per-node partials. No escape hatch needed:
+the fast kernel and the giant-graph sharding path compose. Inside
+``shard_map`` (the DP train step) the operands are already local and
+the wrapper lowers to the plain kernel. The one context that cannot
+partition the op is ``vmap`` (custom_partitioning has no batching
+rule) — ``make_dp_edge_train_step`` traces its model vmap under
+:func:`xla_segment_ops`, which forces the XLA path programmatically.
 
 Training goes through a hand-written gather VJP (``_family``): the
 kernel has no native autodiff, and the closed-form backward
-(g_sum[ids] + 2*data*g_sumsq[ids], masked) is cheaper than XLA's
-packed-scatter VJP anyway.
+(m*g_sum[ids] + 2*m^2*data*g_sumsq[ids]) is cheaper than XLA's
+packed-scatter VJP anyway. The mask is non-differentiable by contract
+(stop_gradient applied on entry): it is an edge-validity weighting,
+not a learnable quantity.
 
 The Pallas kernel requires ``segment_ids`` sorted ascending (it builds
 CSR block pointers by binary search); the XLA pass accepts any order.
 Both need a static ``num_segments``.
+
+``HYDRAGNN_PALLAS`` knob contract:
+  - ``auto`` (default): Pallas on TPU for sorted, 2-D, 128-lane data;
+  - ``1``: force the kernel when the backend is TPU (sorting on the
+    fly if needed); falls back to XLA elsewhere rather than crashing
+    at Mosaic lowering on CPU/GPU;
+  - ``interpret``: force the kernel in interpret mode on ANY backend
+    (CPU-mesh tests of the sharded kernel path);
+  - ``0``: force XLA.
 """
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import functools
 import os
 from typing import Optional, Tuple
@@ -39,9 +65,28 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.experimental.custom_partitioning import custom_partitioning
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 BN = 128  # output rows (nodes) per grid step
 CE = 512  # edges DMA'd per inner chunk
+
+_FORCE_XLA = contextvars.ContextVar("hydragnn_force_xla_segment_ops", default=False)
+
+
+@contextlib.contextmanager
+def xla_segment_ops():
+    """Force the XLA segment path for every op traced inside this
+    context. Needed where the partitioned kernel op cannot appear:
+    under ``vmap`` (custom_partitioning has no batching rule —
+    ``parallel/edge_sharded.py:make_dp_edge_train_step`` vmaps the
+    model over the data axis). Trace-time scoped: wrap the code that
+    BUILDS/TRACES the jitted function, not the execution."""
+    tok = _FORCE_XLA.set(True)
+    try:
+        yield
+    finally:
+        _FORCE_XLA.reset(tok)
 
 
 def pallas_available() -> bool:
@@ -88,64 +133,6 @@ def _family_kernel(block_ptr_ref, msg_hbm, recv_hbm,
     DOUBLE-BUFFERED (see :func:`_csr_chunk_loop`)."""
     _csr_chunk_loop(block_ptr_ref, msg_hbm, recv_hbm,
                     msg_vmem, recv_vmem, sems, sum_ref, sumsq_ref)
-
-
-@functools.partial(
-    jax.jit, static_argnames=("num_segments", "interpret", "indices_are_sorted")
-)
-def segment_sum_family_pallas(
-    data: jnp.ndarray,
-    segment_ids: jnp.ndarray,
-    num_segments: int,
-    mask: Optional[jnp.ndarray] = None,
-    interpret: bool = False,
-    indices_are_sorted: bool = False,
-) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
-    # shared host-side prep (sort if needed, dtype/mask normalization,
-    # CE tail padding with sentinel receivers, CSR block pointers)
-    data, sorted_ids, sorted_mask, recv, block_ptr, n_pad, n_blocks, h = _csr_prep(
-        data, segment_ids, mask, num_segments, indices_are_sorted
-    )
-    # the count is an [E, 1] reduction — bandwidth-trivial next to the
-    # [E, H] passes, so XLA keeps it while Pallas does the heavy lifting
-    ones = jnp.ones((sorted_ids.shape[0],), jnp.float32)
-    if sorted_mask is not None:
-        ones = ones * sorted_mask.astype(jnp.float32)
-    cnt = jax.ops.segment_sum(
-        ones, sorted_ids, num_segments, indices_are_sorted=True
-    )
-    recv_row = recv[None, :]  # [1, E]: receivers along lanes
-
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
-        grid=(n_blocks,),
-        in_specs=[
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ],
-        out_specs=[
-            pl.BlockSpec((BN, h), lambda i, ptr: (i, 0)),
-            pl.BlockSpec((BN, h), lambda i, ptr: (i, 0)),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((2, CE, h), data.dtype),
-            pltpu.VMEM((2, 1, CE), jnp.int32),
-            pltpu.SemaphoreType.DMA((2, 2)),
-        ],
-    )
-    s, sq = pl.pallas_call(
-        _family_kernel,
-        out_shape=[
-            jax.ShapeDtypeStruct((n_pad, h), jnp.float32),
-            jax.ShapeDtypeStruct((n_pad, h), jnp.float32),
-        ],
-        grid_spec=grid_spec,
-        interpret=interpret,
-    )(block_ptr, data, recv_row)
-    return s[:num_segments], sq[:num_segments], cnt
 
 
 def _sum_kernel(block_ptr_ref, msg_hbm, recv_hbm, sum_ref,
@@ -221,32 +208,29 @@ def _csr_chunk_loop(block_ptr_ref, msg_hbm, recv_hbm,
     jax.lax.fori_loop(k0, k1, chunk_body, 0)
 
 
-def _csr_prep(data, segment_ids, mask, num_segments, indices_are_sorted):
-    """Shared host-side prep: optional sort, dtype normalization (bf16
-    stays bf16 for half-width DMA, everything else goes f32), mask
-    premultiply (always in f32 so non-boolean weight masks keep full
-    precision), CE tail padding with sentinel receivers, CSR block
-    pointers."""
-    if not indices_are_sorted:
-        order = jnp.argsort(segment_ids)
-        segment_ids = segment_ids[order]
-        data = data[order]
-        if mask is not None:
-            mask = mask[order]
+def _csr_prep(data, segment_ids, mask, num_segments):
+    """Shard-local prep for the CSR kernels (``segment_ids`` must be
+    sorted ascending — sorting, if any, happens before the partitioned
+    op so each shard's slice stays contiguous): dtype normalization
+    (bf16 stays bf16 for half-width DMA unless a float weight mask
+    forces f32; everything else goes f32), mask premultiply, CE tail
+    padding with sentinel receivers, CSR block pointers."""
     e, h = data.shape
     n_pad = ((num_segments + BN - 1) // BN) * BN
     # bf16 stays bf16: the kernel DMAs half the bytes and upcasts in
     # registers before the f32-accumulating matmuls (under mixed
     # precision the model already rounded the messages to bf16, so no
     # information is lost); every other dtype goes f32
-    if data.dtype != jnp.bfloat16:
+    float_mask = mask is not None and jnp.issubdtype(mask.dtype, jnp.floating)
+    if data.dtype != jnp.bfloat16 or float_mask:
+        # bf16 stays bf16 EXCEPT under a float weight mask: the weighted
+        # products are not bf16-representable, and rounding them before
+        # accumulation measurably diverges from the f32 XLA path at
+        # realistic degrees (caught by the on-chip selfcheck at E=120k,
+        # ~23 edges/node — boolean masks are exact in any dtype)
         data = data.astype(jnp.float32)
     if mask is not None:
-        # multiply in f32 then round once: a non-boolean weight mask must
-        # not be pre-rounded to bf16 (double-rounding precision cliff)
-        data = (
-            data.astype(jnp.float32) * mask[:, None].astype(jnp.float32)
-        ).astype(data.dtype)
+        data = data * mask[:, None].astype(data.dtype)
     e_pad = ((e + CE - 1) // CE) * CE
     data = jnp.concatenate([data, jnp.zeros((e_pad - e, h), data.dtype)], axis=0)
     recv = jnp.concatenate(
@@ -255,7 +239,153 @@ def _csr_prep(data, segment_ids, mask, num_segments, indices_are_sorted):
     n_blocks = n_pad // BN
     boundaries = jnp.arange(n_blocks + 1, dtype=jnp.int32) * BN
     block_ptr = jnp.searchsorted(recv[:e], boundaries, side="left").astype(jnp.int32)
-    return data, segment_ids, mask, recv, block_ptr, n_pad, n_blocks, h
+    return data, recv, block_ptr, n_pad, n_blocks, h
+
+
+def _csr_kernel_call(data, segment_ids, mask, num_segments, interpret, family):
+    """Shard-local CSR kernel invocation (sorted contract). Returns
+    (sum, sumsq, cnt) when ``family`` else the plain sum."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    data, recv, block_ptr, n_pad, n_blocks, h = _csr_prep(
+        data, segment_ids, mask, num_segments
+    )
+    n_out = 2 if family else 1
+    # under shard_map with check_vma=True the out_shape must declare which
+    # manual mesh axes the result varies over — same set as the inputs
+    vma = frozenset(getattr(jax.typeof(data), "vma", frozenset())) | frozenset(
+        getattr(jax.typeof(recv), "vma", frozenset())
+    )
+    out_sds = jax.ShapeDtypeStruct((n_pad, h), jnp.float32, vma=vma)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_blocks,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=[pl.BlockSpec((BN, h), lambda i, ptr: (i, 0))] * n_out,
+        scratch_shapes=[
+            pltpu.VMEM((2, CE, h), data.dtype),
+            pltpu.VMEM((2, 1, CE), jnp.int32),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+    )
+    outs = pl.pallas_call(
+        _family_kernel if family else _sum_kernel,
+        out_shape=[out_sds] * n_out,
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(block_ptr, data, recv[None, :])
+    if not family:
+        return outs[0][:num_segments]
+    # the count is an [E, 1] reduction — bandwidth-trivial next to the
+    # [E, H] passes, so XLA keeps it while Pallas does the heavy lifting
+    ones = jnp.ones((segment_ids.shape[0],), jnp.float32)
+    if mask is not None:
+        ones = ones * mask.astype(jnp.float32)
+    cnt = jax.ops.segment_sum(
+        ones, segment_ids, num_segments, indices_are_sorted=True
+    )
+    return outs[0][:num_segments], outs[1][:num_segments], cnt
+
+
+def _make_partitioned_op(family: bool, has_mask: bool):
+    """Build a custom_partitioning wrapper around the CSR kernel.
+
+    Partitioning rule: when GSPMD shards the operands on the edge axis
+    (leading dim of ``data``/``ids``/``mask`` — the giant-graph path),
+    each device runs the kernel on its local, contiguous,
+    receiver-sorted edge slice against the full segment range, and one
+    ``psum`` over the sharded mesh axis combines the per-node partials.
+    Any other operand sharding is canonicalized to replicated. Outputs
+    are replicated (they are [num_segments, ...] node-space arrays)."""
+    n_args = 3 if has_mask else 2
+
+    def base(*args):
+        data, ids = args[0], args[1]
+        mask = args[2] if has_mask else None
+        num_segments, interpret = args[n_args], args[n_args + 1]
+        return _csr_kernel_call(data, ids, mask, num_segments, interpret, family)
+
+    op = custom_partitioning(base, static_argnums=(n_args, n_args + 1))
+
+    def _out_shardings(mesh):
+        rep = NamedSharding(mesh, P())
+        return (rep, rep, rep) if family else rep
+
+    def infer(num_segments, interpret, mesh, arg_shapes, result_shape):
+        return _out_shardings(mesh)
+
+    def partition(num_segments, interpret, mesh, arg_shapes, result_shape):
+        spec = arg_shapes[0].sharding.spec
+        edge_axis = spec[0] if len(spec) >= 1 else None
+
+        def lower_fn(*arrs):
+            data, ids = arrs[0], arrs[1]
+            mask = arrs[2] if has_mask else None
+            out = _csr_kernel_call(
+                data, ids, mask, num_segments, interpret, family
+            )
+            if edge_axis is not None:
+                out = jax.lax.psum(out, edge_axis)
+            return out
+
+        arg_sh = [
+            NamedSharding(mesh, P(edge_axis, None)),
+            NamedSharding(mesh, P(edge_axis)),
+        ]
+        if has_mask:
+            arg_sh.append(NamedSharding(mesh, P(edge_axis)))
+        return mesh, lower_fn, _out_shardings(mesh), tuple(arg_sh)
+
+    ins = "e h, e" + (", e" if has_mask else "")
+    outs = "n h, n h, n" if family else "n h"
+    op.def_partition(
+        partition=partition,
+        infer_sharding_from_operands=infer,
+        sharding_rule=f"{ins} -> {outs}",
+    )
+    return op
+
+
+_FAMILY_OP = _make_partitioned_op(family=True, has_mask=False)
+_FAMILY_OP_MASKED = _make_partitioned_op(family=True, has_mask=True)
+_SUM_OP = _make_partitioned_op(family=False, has_mask=False)
+_SUM_OP_MASKED = _make_partitioned_op(family=False, has_mask=True)
+
+
+def _sort_for_csr(data, segment_ids, mask, indices_are_sorted):
+    """Global pre-sort for the forced-kernel path. Happens OUTSIDE the
+    partitioned op so the sorted contract holds per shard."""
+    if indices_are_sorted:
+        return data, segment_ids, mask
+    order = jnp.argsort(segment_ids)
+    return (
+        data[order],
+        segment_ids[order],
+        None if mask is None else mask[order],
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("num_segments", "interpret", "indices_are_sorted")
+)
+def segment_sum_family_pallas(
+    data: jnp.ndarray,
+    segment_ids: jnp.ndarray,
+    num_segments: int,
+    mask: Optional[jnp.ndarray] = None,
+    interpret: bool = False,
+    indices_are_sorted: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    data, segment_ids, mask = _sort_for_csr(
+        data, segment_ids, mask, indices_are_sorted
+    )
+    if mask is not None:
+        return _FAMILY_OP_MASKED(data, segment_ids, mask, num_segments, interpret)
+    return _FAMILY_OP(data, segment_ids, num_segments, interpret)
 
 
 @functools.partial(
@@ -270,51 +400,37 @@ def segment_sum_pallas(
     indices_are_sorted: bool = False,
 ) -> jnp.ndarray:
     """Plain segment sum through the double-buffered CSR kernel."""
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
-    data, _, _, recv, block_ptr, n_pad, n_blocks, h = _csr_prep(
-        data, segment_ids, mask, num_segments, indices_are_sorted
+    data, segment_ids, mask = _sort_for_csr(
+        data, segment_ids, mask, indices_are_sorted
     )
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
-        grid=(n_blocks,),
-        in_specs=[
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ],
-        out_specs=[pl.BlockSpec((BN, h), lambda i, ptr: (i, 0))],
-        scratch_shapes=[
-            pltpu.VMEM((2, CE, h), data.dtype),
-            pltpu.VMEM((2, 1, CE), jnp.int32),
-            pltpu.SemaphoreType.DMA((2, 2)),
-        ],
-    )
-    (s,) = pl.pallas_call(
-        _sum_kernel,
-        out_shape=[jax.ShapeDtypeStruct((n_pad, h), jnp.float32)],
-        grid_spec=grid_spec,
-        interpret=interpret,
-    )(block_ptr, data, recv[None, :])
-    return s[:num_segments]
+    if mask is not None:
+        return _SUM_OP_MASKED(data, segment_ids, mask, num_segments, interpret)
+    return _SUM_OP(data, segment_ids, num_segments, interpret)
 
 
 def _use_pallas(data: jnp.ndarray, indices_are_sorted: bool) -> bool:
-    """Shared HYDRAGNN_PALLAS knob contract: "1" forces the kernel
-    (sorting on the fly), "0" forces XLA, default auto = Pallas on TPU
-    for sorted, 2-D, 128-lane-multiple data."""
-    tiles = data.ndim == 2 and data.shape[1] % 128 == 0
+    """Shared HYDRAGNN_PALLAS knob contract (module docstring): "1"
+    forces the kernel on TPU, "interpret" forces it in interpret mode
+    on any backend, "0" forces XLA, default auto = Pallas on TPU for
+    sorted, 2-D, 128-lane-multiple data. :func:`xla_segment_ops`
+    overrides everything (vmap has no custom_partitioning rule)."""
+    if _FORCE_XLA.get():
+        return False
     knob = os.environ.get("HYDRAGNN_PALLAS", "auto")
-    if knob == "1":
-        return pallas_available() and tiles
     if knob == "0":
         return False
-    return (
-        pallas_available()
-        and tiles
-        and indices_are_sorted
-        and jax.default_backend() == "tpu"
-    )
+    tiles = data.ndim == 2 and data.shape[1] % 128 == 0
+    if not (pallas_available() and tiles):
+        return False
+    if knob == "interpret":
+        return True
+    if knob == "1":
+        return jax.default_backend() == "tpu"
+    return indices_are_sorted and jax.default_backend() == "tpu"
+
+
+def _interpret_mode() -> bool:
+    return os.environ.get("HYDRAGNN_PALLAS", "auto") == "interpret"
 
 
 def segment_sum_fast(
@@ -326,14 +442,22 @@ def segment_sum_fast(
 ) -> jnp.ndarray:
     """Segment sum for VJP hot paths: the Pallas CSR kernel on TPU when
     receivers are sorted and the width tiles (same knob contract as
-    :func:`segment_sum_family`: "1" forces the kernel, sorting on the
-    fly; "0" forces XLA; default auto), XLA otherwise. Not
-    differentiated itself — callers are custom backward functions."""
+    :func:`segment_sum_family`), XLA otherwise. Not differentiated
+    itself — callers are custom backward functions.
+
+    ACCUMULATION CONTRACT: sums always accumulate in >= f32 regardless
+    of input dtype — the kernel accumulates f32 natively (bf16 inputs
+    DMA half the bytes, exact for 0/1-valued data like tie masks), and
+    the XLA fallback upcasts sub-f32 inputs first. Callers may
+    therefore pass bf16 cotangents/masks purely for bandwidth."""
     if _use_pallas(data, indices_are_sorted):
         return segment_sum_pallas(
             data, segment_ids, num_segments, mask,
+            interpret=_interpret_mode(),
             indices_are_sorted=indices_are_sorted,
         )
+    if data.dtype in (jnp.bfloat16, jnp.float16):
+        data = data.astype(jnp.float32)
     if mask is not None:
         data = data * mask[:, None].astype(data.dtype)
     return jax.ops.segment_sum(
@@ -345,6 +469,7 @@ def _family_impl(data, segment_ids, num_segments, mask, indices_are_sorted, use_
     if use_pallas:
         return segment_sum_family_pallas(
             data, segment_ids, num_segments, mask,
+            interpret=_interpret_mode(),
             indices_are_sorted=indices_are_sorted,
         )
     return segment_sum_family_xla(
@@ -358,7 +483,9 @@ def _family(data, segment_ids, num_segments, mask, indices_are_sorted, use_palla
     """Family with a hand-written gather backward: makes the Pallas
     kernel trainable (pallas_call has no native VJP) and replaces XLA's
     packed-scatter VJP with the closed form
-    d/d(data) = mask * (g_sum[ids] + 2 * data * g_sumsq[ids])."""
+    d/d(data) = m * g_sum[ids] + 2 * m^2 * data * g_sumsq[ids]
+    (m = mask weights; for a boolean mask m^2 = m and this reduces to
+    the gated form)."""
     return _family_impl(data, segment_ids, num_segments, mask,
                         indices_are_sorted, use_pallas)
 
@@ -372,13 +499,29 @@ def _family_fwd(data, segment_ids, num_segments, mask, indices_are_sorted, use_p
 def _family_bwd(num_segments, indices_are_sorted, use_pallas, res, g):
     data, segment_ids, mask = res
     g_sum, g_sumsq, _ = g  # count is data-independent
-    grad = g_sum[segment_ids] + 2.0 * data.astype(g_sum.dtype) * g_sumsq[segment_ids]
-    if mask is not None:
-        grad = jnp.where(mask[:, None], grad, 0)
+    # cast the [N, H] cotangents to the data dtype BEFORE the
+    # [E, H]-widening gathers: under bf16 mixed precision this halves
+    # the two gather writes (the backward's dominant HBM traffic), and
+    # the final cotangent is data.dtype regardless
+    g_sum = g_sum.astype(data.dtype)
+    g_sumsq = g_sumsq.astype(data.dtype)
+    sumsq_term = 2.0 * data * g_sumsq[segment_ids]
+    if mask is None:
+        grad = g_sum[segment_ids] + sumsq_term
+        mask_zero = None
+    else:
+        # weighted closed form: out_sum = sum(m*d), out_sumsq = sum(m^2*d^2)
+        # => d/dd = m*g_sum[ids] + 2*m^2*d*g_sumsq[ids]
+        m = mask.astype(g_sum.dtype)[:, None]
+        grad = m * (g_sum[segment_ids] + m * sumsq_term)
+        # the mask is non-differentiable by contract (stop_gradient on
+        # entry in segment_sum_family): bool/int masks take a float0
+        # cotangent, float weight masks a true-zero one
+        if jnp.issubdtype(mask.dtype, jnp.floating):
+            mask_zero = jnp.zeros(mask.shape, dtype=mask.dtype)
+        else:
+            mask_zero = jnp.zeros(mask.shape, dtype=jax.dtypes.float0)
     ids_zero = jnp.zeros(segment_ids.shape, dtype=jax.dtypes.float0)
-    mask_zero = (
-        None if mask is None else jnp.zeros(mask.shape, dtype=jax.dtypes.float0)
-    )
     return grad.astype(data.dtype), ids_zero, mask_zero
 
 
@@ -396,10 +539,12 @@ def segment_sum_family(
     TPU when the caller guarantees sorted receivers and the feature
     width is a 128-lane multiple (measured 5.5x faster than the XLA
     scatter at E=120k, H=128 on v5e — docs/PERF.md); the fused XLA pass
-    otherwise. HYDRAGNN_PALLAS=1 forces the kernel (sorting on the fly
-    if needed), HYDRAGNN_PALLAS=0 forces XLA — the escape hatch for
-    paths where a pallas_call cannot partition (e.g. PNA over
-    GSPMD-edge-sharded giant graphs)."""
+    otherwise. The kernel op carries a custom_partitioning rule, so it
+    composes with GSPMD edge sharding (module docstring); only vmap
+    contexts need :func:`xla_segment_ops`. The mask (edge validity or
+    float weights) is non-differentiable by contract."""
+    if mask is not None:
+        mask = jax.lax.stop_gradient(mask)
     use_pallas = _use_pallas(data, indices_are_sorted)
     return _family(data, segment_ids, num_segments, mask,
                    indices_are_sorted, use_pallas)

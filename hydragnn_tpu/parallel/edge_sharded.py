@@ -196,10 +196,20 @@ def make_dp_edge_train_step(
     import optax
 
     from hydragnn_tpu.models.base import model_loss
+    from hydragnn_tpu.ops.segment_pallas import xla_segment_ops
 
     from hydragnn_tpu.parallel.sharded import _state_sharding
 
     def step(state, batch):
+        # this step vmaps the model over the data axis; the Pallas
+        # segment ops' custom_partitioning wrapper has no vmap batching
+        # rule, so trace the whole body on the XLA segment path (the
+        # GSPMD giant-graph path — plain jit, no vmap — keeps the
+        # kernel via its partitioning rule; see ops/segment_pallas.py)
+        with xla_segment_ops():
+            return _body(state, batch)
+
+    def _body(state, batch):
         rng, dropout_rng = jax.random.split(state.rng)
         d_data = batch.graph_mask.shape[0]
 

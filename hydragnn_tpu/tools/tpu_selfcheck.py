@@ -1,0 +1,235 @@
+"""On-chip TPU kernel selfcheck (VERDICT r02 item 3).
+
+Every Pallas test in the default suite runs interpret-mode on the CPU
+mesh; since r02 flipped ``HYDRAGNN_PALLAS=auto`` to kernel-on-TPU, the
+path real training takes was validated only by bench-time spot checks.
+This module exercises the DEFAULT TPU kernel path on the actual chip:
+
+  1. family kernel vs the fused XLA pass — f32 and bf16 data, boolean
+     and float-weight masks, two CSR shapes (multi-chunk included);
+  2. sum-only kernel (the VJP hot path) vs ``jax.ops.segment_sum``;
+  3. one flagship-shaped PNA train step, Pallas vs XLA dispatch — loss
+     must agree to mixed-precision tolerance;
+  4. (``--bench``) the bf16-vs-f32 kernel bandwidth A/B that r02 left
+     roofline-derived: scan-slope timing (the op chained K times inside
+     one ``lax.scan`` dispatch, slope between two K values — cancels
+     the tunnel's per-dispatch RTT; docs/PERF.md protocol).
+
+Dispatch budget: the tunneled dev chip throttles after ~100 fast
+dispatches (memory: post-burst ~100x slowdown), so the default check
+set stays under ~40 dispatches including compiles.
+
+Run via ``ci.sh`` (CI_TPU=1 -> tests/test_tpu_chip.py subprocess; the
+in-process pytest session pins a CPU mesh, so the chip work happens
+here) or directly: ``python -m hydragnn_tpu.tools.tpu_selfcheck``.
+Exit code 0 = all checks passed. Prints one JSON line per check.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def _fail(name: str, **kw) -> None:
+    print(json.dumps({"check": name, "ok": False, **kw}))
+
+
+def _ok(name: str, **kw) -> None:
+    print(json.dumps({"check": name, "ok": True, **kw}))
+
+
+def _allclose(a, b, rtol, atol) -> bool:
+    import numpy as np
+
+    return bool(
+        np.allclose(np.asarray(a, np.float32), np.asarray(b, np.float32), rtol=rtol, atol=atol)
+    )
+
+
+def check_kernels() -> bool:
+    """Family + sum kernels vs XLA on-chip, multiple dtypes/masks."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from hydragnn_tpu.ops.segment_pallas import (
+        segment_sum_family_pallas,
+        segment_sum_family_xla,
+        segment_sum_pallas,
+    )
+
+    ok = True
+    rng = np.random.default_rng(0)
+    shapes = [(4096, 128, 1024), (120_000, 128, 5136)]  # (E, H, N); 2nd = bench shape
+    for e, h, n in shapes:
+        recv = np.sort(rng.integers(0, n, e)).astype(np.int32)
+        data32 = rng.normal(size=(e, h)).astype(np.float32)
+        bmask = rng.random(e) > 0.2
+        wmask = rng.random(e).astype(np.float32)
+        for dtype, rtol, atol in ((jnp.float32, 1e-5, 1e-4), (jnp.bfloat16, 1e-2, 1e-2)):
+            data = jnp.asarray(data32).astype(dtype)
+            for mask, mname in ((None, "none"), (jnp.asarray(bmask), "bool"), (jnp.asarray(wmask), "float")):
+                s, sq, c = segment_sum_family_pallas(
+                    data, jnp.asarray(recv), n, mask, indices_are_sorted=True
+                )
+                rs, rsq, rc = segment_sum_family_xla(
+                    # XLA reference on the SAME (possibly bf16-rounded) data
+                    data, jnp.asarray(recv), n, mask, indices_are_sorted=True
+                )
+                good = (
+                    _allclose(s, rs, rtol, atol)
+                    and _allclose(sq, rsq, rtol, max(atol, 1e-2))
+                    and _allclose(c, rc, 1e-6, 1e-6)
+                )
+                name = f"family_E{e}_{dtype.__name__}_mask-{mname}"
+                (_ok if good else _fail)(name)
+                ok &= good
+        # sum-only kernel: one representative config per shape
+        out = segment_sum_pallas(
+            jnp.asarray(data32), jnp.asarray(recv), n,
+            jnp.asarray(bmask), indices_are_sorted=True,
+        )
+        ref = jax.ops.segment_sum(
+            jnp.asarray(data32 * bmask[:, None]), jnp.asarray(recv), n,
+            indices_are_sorted=True,
+        )
+        good = _allclose(out, ref, 1e-5, 1e-4)
+        (_ok if good else _fail)(f"sum_E{e}_f32_mask-bool")
+        ok &= good
+    return ok
+
+
+def check_train_step() -> bool:
+    """Flagship-shaped PNA train step: Pallas dispatch vs forced-XLA
+    must produce the same loss (the end-to-end gate: VJPs, gathers,
+    extremum backwards all route differently)."""
+    import os
+
+    import numpy as np
+    import jax.numpy as jnp
+
+    from hydragnn_tpu.flagship import build_flagship
+    from hydragnn_tpu.train import create_train_state, make_train_step, select_optimizer
+
+    config, model, variables, loader = build_flagship(
+        n_samples=160, hidden_dim=128, num_conv_layers=2, batch_size=128,
+        unit_cells=(2, 4),
+    )
+    tx = select_optimizer(config["NeuralNetwork"]["Training"])
+    batch = next(iter(loader))
+
+    losses = {}
+    kernel_in_hlo = {}
+    for knob in ("auto", "0"):
+        os.environ["HYDRAGNN_PALLAS"] = knob
+        try:
+            step = make_train_step(model, tx, compute_dtype=jnp.bfloat16)
+            state = create_train_state(variables, tx, seed=0)
+            compiled = step.lower(state, batch).compile()
+            # positive control: the kernel must actually BE in the auto
+            # step (pallas lowers to tpu_custom_call) and absent from
+            # the forced-XLA step — equal losses alone can't tell a
+            # working A/B from two identical dispatches
+            try:
+                text = compiled.as_text()
+            except Exception:
+                text = ""
+            # pallas lowers to the Mosaic "tpu_custom_call" target
+            # specifically — plain "custom_call" also matches unrelated
+            # XLA custom calls and cannot discriminate the paths
+            kernel_in_hlo[knob] = "tpu_custom_call" in text
+            _, loss, _ = compiled(state, batch)
+            losses[knob] = float(np.asarray(loss))
+        finally:
+            os.environ.pop("HYDRAGNN_PALLAS", None)
+    diff = abs(losses["auto"] - losses["0"]) / max(abs(losses["0"]), 1e-9)
+    good = diff < 5e-3  # bf16 mixed precision; r02 measured 7e-6 on f32
+    if kernel_in_hlo.get("auto") is False:
+        good = False  # auto on TPU must dispatch the kernel
+    if kernel_in_hlo.get("0") is True:
+        good = False  # forced-XLA arm must NOT contain it, or the A/B is vacuous
+    (_ok if good else _fail)(
+        "train_step_pallas_vs_xla",
+        losses=losses,
+        rel_diff=diff,
+        kernel_in_hlo=kernel_in_hlo,
+    )
+    return good
+
+
+def bench_bf16_ab() -> None:
+    """Measured bf16-vs-f32 family-kernel A/B at the bench shape
+    (PERF.md left the bf16-DMA gain roofline-derived in r02). Scan-slope
+    protocol; prints ms/op and effective HBM GB/s for both dtypes."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from hydragnn_tpu.ops.segment_pallas import segment_sum_family_pallas
+
+    e, h, n = 120_000, 128, 5136
+    rng = np.random.default_rng(1)
+    recv = jnp.asarray(np.sort(rng.integers(0, n, e)).astype(np.int32))
+    base = rng.normal(size=(e, h)).astype(np.float32)
+
+    from hydragnn_tpu.utils.profile import scan_slope_ms
+
+    def slope_ms(data):
+        def body(carry, _):
+            s, sq, c = segment_sum_family_pallas(
+                carry, recv, n, None, indices_are_sorted=True
+            )
+            # chain: feed the gathered sum back so iterations depend
+            return (carry + s[recv] * 1e-9).astype(data.dtype), c[0]
+
+        def make_chain(k):
+            fn = jax.jit(lambda d: jax.lax.scan(body, d, None, length=k))
+
+            def run():
+                _, cs = fn(data)
+                np.asarray(cs[-1])  # D2H sync (block_until_ready lies here)
+
+            return run
+
+        return scan_slope_ms(make_chain, 16, 64)
+
+    for dtype in (jnp.float32, jnp.bfloat16):
+        data = jnp.asarray(base).astype(dtype)
+        ms = slope_ms(data)
+        if ms <= 0:
+            # scan_slope_ms contract: non-positive slope is RTT noise,
+            # not data — record the discard, never a negative bandwidth
+            print(json.dumps({
+                "check": f"bench_family_{dtype.__name__}", "ok": True,
+                "ms_per_op": None, "note": "non-positive slope (tunnel noise), discarded",
+            }))
+            continue
+        nbytes = e * h * (2 if dtype == jnp.bfloat16 else 4)  # one read of data
+        print(json.dumps({
+            "check": f"bench_family_{dtype.__name__}",
+            "ok": True,
+            "ms_per_op": round(ms, 4),
+            "data_read_gb_s": round(nbytes / (ms / 1e3) / 1e9, 1),
+        }))
+
+
+def main() -> int:
+    import jax
+
+    backend = jax.default_backend()
+    if backend != "tpu":
+        print(json.dumps({"check": "backend", "ok": False, "backend": backend,
+                          "note": "selfcheck requires a real TPU"}))
+        return 2
+    _ok("backend", device=getattr(jax.devices()[0], "device_kind", "?"))
+    ok = check_kernels()
+    ok &= check_train_step()
+    if "--bench" in sys.argv:
+        bench_bf16_ab()
+    print(json.dumps({"check": "ALL", "ok": bool(ok)}))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -416,10 +416,15 @@ def train_validate_test(
                     "stopper": {"count": 0, "min_loss": float("inf")},
                     "history": hist,
                 }
-                # rewrite once so future resumes see a consistent pair
+                # rewrite once so future resumes see a consistent pair —
+                # under the name resume READS from (training["startfrom"]),
+                # which may differ from this run's log_name; also under
+                # log_name so this run's own sidecar starts consistent
                 from hydragnn_tpu.utils.checkpoint import save_train_meta
 
-                save_train_meta(meta, log_name, log_dir)
+                save_train_meta(meta, training["startfrom"], log_dir)
+                if log_name != training["startfrom"]:
+                    save_train_meta(meta, log_name, log_dir)
             # an early-stopped run resumes to a no-op (the stop decision
             # is honored, not replayed into extra epochs); a completed or
             # interrupted run continues from its recorded epoch — which

@@ -102,3 +102,25 @@ def trace_annotation(name: str):
     (reference: train_validate_test.py:349-358) and the gptl4py/nvtx shim
     (reference: hydragnn/utils/gptl4py_dummy.py)."""
     return jax.profiler.TraceAnnotation(name)
+
+
+def scan_slope_ms(make_chain, k1: int, k2: int) -> float:
+    """Per-iteration time (ms) of a K-chained computation by the
+    scan-slope protocol: time the chain at two lengths and take the
+    slope — cancels per-dispatch RTT and server-side overhead, which on
+    tunneled dev chips varies 10-120 ms with burst history and would
+    otherwise swamp sub-ms ops (docs/PERF.md). ``make_chain(k)`` returns
+    a zero-arg callable that runs the k-chained computation and blocks
+    on a REAL D2H readback (``np.asarray`` of a chain-dependent value —
+    ``block_until_ready`` returns at dispatch-ack on such tunnels).
+    The caller must treat a non-positive slope as noise, not data."""
+    import time
+
+    times = {}
+    for k in (k1, k2):
+        run = make_chain(k)
+        run()  # compile + warmup
+        t0 = time.perf_counter()
+        run()
+        times[k] = time.perf_counter() - t0
+    return (times[k2] - times[k1]) / (k2 - k1) * 1e3

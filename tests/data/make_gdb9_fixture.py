@@ -1,0 +1,263 @@
+"""Generate the GDB9-format fixture under tests/data/gdb9_fixture/.
+
+WHAT THIS IS (honesty note — read before citing): the build environment
+has ZERO network egress, so the genuine GDB9/QM9 download
+(quantum-machine.org, FigShare) is unreachable. This generator instead
+produces ~100 molecules that are
+
+  - REAL molecular species: valence-correct acyclic CHNOF molecules
+    drawn from the GDB9 universe (<= 9 heavy atoms, H-saturated —
+    alkanes, amines, alcohols, ethers, fluorides and their combinations),
+  - with IDEALIZED geometries (standard bond lengths, tetrahedral
+    embedding, steric-clash rejection) rather than DFT-relaxed ones,
+  - in the EXACT GDB9 raw file format (dsgdb9nsd_*.xyz): atom count;
+    "gdb <i>" + 15 scalar properties; per-atom symbol/x/y/z/Mulliken
+    lines; harmonic frequencies; SMILES; InChI — including the Fortran
+    ``*^`` float notation GDB9 uses, sprinkled over coordinates and
+    charges to exercise the parser,
+  - with SURROGATE property values: the free-energy target (column
+    G, props index 13 — examples/qm9/qm9.py:G_INDEX) is a smooth
+    function of the true geometry/composition (element contributions +
+    pair term), so parse -> ingest -> train -> predict is a real
+    learning problem; the other 14 columns are plausible-scale fillers.
+
+The fixture's purpose is to pin the raw-GDB9 PARSER path and the
+end-to-end example flow (VERDICT r02 item 6 / missing item 2) — not to
+claim DFT accuracy. Swap in the real download at examples/qm9
+--data dataset/qm9/raw and nothing else changes.
+
+Regenerate: python tests/data/make_gdb9_fixture.py
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "gdb9_fixture")
+
+SYM = {1: "H", 6: "C", 7: "N", 8: "O", 9: "F"}
+VALENCE = {6: 4, 7: 3, 8: 2, 9: 1}
+BOND = {  # idealized single-bond lengths, Angstrom
+    (6, 6): 1.54, (6, 7): 1.47, (6, 8): 1.43, (6, 9): 1.35,
+    (7, 7): 1.45, (7, 8): 1.40, (8, 8): 1.48,
+    (7, 9): 1.42, (8, 9): 1.41,
+    (1, 6): 1.09, (1, 7): 1.01, (1, 8): 0.96, (1, 9): 0.92,
+}
+ENEG = {1: 2.20, 6: 2.55, 7: 3.04, 8: 3.44, 9: 3.98}
+# additive atomic contributions (Hartree-scale), the learnable signal
+CONTRIB = {1: -0.5, 6: -38.0, 7: -54.5, 8: -75.0, 9: -99.7}
+
+_T = np.asarray(
+    [[1, 1, 1], [1, -1, -1], [-1, 1, -1], [-1, -1, 1]], np.float64
+) / np.sqrt(3.0)
+
+
+def _bond(a: int, b: int) -> float:
+    return BOND[(min(a, b), max(a, b))]
+
+
+def _rot_to(v: np.ndarray) -> np.ndarray:
+    """Rotation matrix mapping _T[0] onto unit vector v."""
+    a, b = _T[0], v / np.linalg.norm(v)
+    c = float(a @ b)
+    if c > 0.9999:
+        return np.eye(3)
+    if c < -0.9999:
+        return -np.eye(3)
+    axis = np.cross(a, b)
+    s = np.linalg.norm(axis)
+    axis = axis / s
+    k = np.asarray(
+        [[0, -axis[2], axis[1]], [axis[2], 0, -axis[0]], [-axis[1], axis[0], 0]]
+    )
+    return np.eye(3) + s * k + (1 - c) * (k @ k)
+
+
+def _twist(v: np.ndarray, angle: float) -> np.ndarray:
+    """Rotation about axis v by angle."""
+    v = v / np.linalg.norm(v)
+    k = np.asarray([[0, -v[2], v[1]], [v[2], 0, -v[0]], [-v[1], v[0], 0]])
+    return np.eye(3) + np.sin(angle) * k + (1 - np.cos(angle)) * (k @ k)
+
+
+def build_molecule(rng: np.random.Generator):
+    """One valence-correct acyclic CHNOF molecule with an idealized 3D
+    embedding. Returns (Z list, pos [n,3], heavy_tree edges) or None if
+    the embedding has a steric clash (caller retries)."""
+    n_heavy = int(rng.integers(2, 10))
+    zs = [6] + [
+        int(rng.choice([6, 7, 8, 9], p=[0.62, 0.15, 0.15, 0.08]))
+        for _ in range(n_heavy - 1)
+    ]
+    # random tree over heavy atoms respecting valence
+    deg = [0] * n_heavy
+    parent = [-1] * n_heavy
+    for i in range(1, n_heavy):
+        cands = [j for j in range(i) if deg[j] < VALENCE[zs[j]]]
+        if not cands:
+            return None
+        # prefer recent atoms: chain-like molecules, fewer clashes
+        w = np.asarray([1.0 + 3.0 * (j / i) for j in cands])
+        parent[i] = int(rng.choice(cands, p=w / w.sum()))
+        deg[parent[i]] += 1
+        deg[i] += 1
+
+    # append hydrogens to fill valences
+    all_z = list(zs)
+    all_parent = list(parent)
+    for i in range(n_heavy):
+        for _ in range(VALENCE[zs[i]] - deg[i]):
+            all_z.append(1)
+            all_parent.append(i)
+
+    n = len(all_z)
+    children = [[] for _ in range(n)]
+    for i in range(1, n):
+        children[all_parent[i]].append(i)
+
+    pos = np.zeros((n, 3))
+    # BFS embedding with tetrahedral directions + deterministic twist
+    order = [0]
+    dirs_of = {}
+    r0 = _twist(np.asarray([0.0, 0.0, 1.0]), float(rng.uniform(0, 2 * np.pi)))
+    dirs_of[0] = (_T @ r0.T, 0)  # (direction set, next free slot)
+    while order:
+        i = order.pop(0)
+        dset, used = dirs_of[i]
+        for ch in children[i]:
+            d = dset[used]
+            used += 1
+            pos[ch] = pos[i] + d * _bond(all_z[i], all_z[ch])
+            back = -d
+            rot = _rot_to(back)
+            tw = _twist(back, float(rng.uniform(0, 2 * np.pi)))
+            dirs_of[ch] = ((_T[1:] @ rot.T) @ tw.T, 0)
+            order.append(ch)
+        dirs_of[i] = (dset, used)
+
+    # steric check between non-bonded atoms
+    bonded = {(min(i, all_parent[i]), max(i, all_parent[i])) for i in range(1, n)}
+    d2 = np.linalg.norm(pos[:, None] - pos[None, :], axis=-1) + np.eye(n) * 9.9
+    for i in range(n):
+        for j in range(i + 1, n):
+            if (i, j) not in bonded and d2[i, j] < 1.25:
+                return None
+    heavy_edges = [(i, parent[i]) for i in range(1, n_heavy)]
+    return all_z, pos, heavy_edges
+
+
+def smiles_of(zs, heavy_edges, n_heavy) -> str:
+    """Minimal valid SMILES for the heavy-atom tree (H implicit)."""
+    adj = [[] for _ in range(n_heavy)]
+    for a, b in heavy_edges:
+        adj[a].append(b)
+        adj[b].append(a)
+
+    def dfs(i, prev):
+        s = SYM[zs[i]]
+        kids = [j for j in adj[i] if j != prev]
+        if not kids:
+            return s
+        *branches, last = kids
+        return s + "".join(f"({dfs(j, i)})" for j in branches) + dfs(last, i)
+
+    return dfs(0, -1)
+
+
+def formula_of(zs) -> str:
+    from collections import Counter
+
+    c = Counter(SYM[z] for z in zs)
+    out = ""
+    for sym in ("C", "H", "F", "N", "O"):  # Hill-ish order
+        if c[sym]:
+            out += sym + (str(c[sym]) if c[sym] > 1 else "")
+    return out
+
+
+def _fortran(x: float) -> str:
+    """GDB9's Fortran-style float: mantissa*^exponent."""
+    s = f"{x:.6e}"
+    mant, exp = s.split("e")
+    return f"{mant}*^{int(exp)}"
+
+
+def free_energy(zs, pos) -> float:
+    """The learnable surrogate target: additive element contributions +
+    smooth pair interaction over the ACTUAL geometry (same functional
+    family as examples/qm9 generate_synthetic_qm9, so thresholds
+    transfer)."""
+    g = sum(CONTRIB[z] for z in zs)
+    d = np.linalg.norm(pos[:, None] - pos[None, :], axis=-1)
+    np.fill_diagonal(d, np.inf)
+    return float(g - 2.0 * np.exp(-d / 1.5).sum() / 2.0)
+
+
+def write_molecule(idx: int, zs, pos, heavy_edges, rng) -> str:
+    n = len(zs)
+    n_heavy = sum(1 for z in zs if z != 1)
+    g = free_energy(zs, pos)
+    r2 = float((pos**2).sum())
+    n_hetero = sum(1 for z in zs if z in (7, 8, 9))
+    mu = round(0.4 + 0.9 * n_hetero + 0.1 * float(rng.normal()), 4)
+    homo = round(-0.24 - 0.01 * n_hetero + 0.005 * float(rng.normal()), 4)
+    lumo = round(0.03 + 0.008 * float(rng.normal()), 4)
+    props = [
+        round(3.0 + 8.0 / max(n_heavy, 1), 5),  # A (GHz)
+        round(1.0 + 2.0 / max(n_heavy, 1), 5),  # B
+        round(0.8 + 1.5 / max(n_heavy, 1), 5),  # C
+        mu, round(6.0 + 1.4 * n_heavy, 2),       # mu, alpha
+        homo, lumo, round(lumo - homo, 4),       # homo, lumo, gap
+        round(r2, 4),                            # <R^2>
+        round(0.015 * n, 5),                     # zpve
+        round(g + 0.02, 5), round(g + 0.025, 5), round(g + 0.026, 5),  # U0,U,H
+        round(g, 5),                             # G  <- index 13, the target
+        round(4.0 + 2.2 * n_heavy, 3),           # Cv
+    ]
+    # Mulliken charges: electronegativity-weighted, tiny
+    qs = np.asarray([ENEG[z] - 2.55 for z in zs])
+    qs = qs - qs.mean()
+    lines = [str(n)]
+    ptoks = []
+    for k, p in enumerate(props):
+        # exercise the Fortran float path on a deterministic subset
+        if (idx + k) % 7 == 0:
+            ptoks.append(_fortran(float(p)))
+        else:
+            ptoks.append(f"{p:g}")
+    lines.append("gdb " + str(idx) + "\t" + "\t".join(ptoks))
+    for i in range(n):
+        q = qs[i] * 0.12
+        qtok = _fortran(q) if (idx + i) % 5 == 0 else f"{q: .6f}"
+        x, y, z = pos[i]
+        xtok = _fortran(float(x)) if (idx + i) % 11 == 0 else f"{x: .7f}"
+        lines.append(f"{SYM[zs[i]]}\t{xtok}\t{y: .7f}\t{z: .7f}\t{qtok}")
+    freqs = sorted(abs(rng.normal(1500, 700)) for _ in range(min(3 * n - 6, 9)))
+    lines.append("\t".join(f"{f:.4f}" for f in freqs))
+    smi = smiles_of(zs, heavy_edges, n_heavy)
+    lines.append(f"{smi}\t{smi}")
+    inchi = f"InChI=1S/{formula_of(zs)}"
+    lines.append(f"{inchi}\t{inchi}")
+    return "\n".join(lines) + "\n"
+
+
+def main(n_molecules: int = 100, seed: int = 20260731) -> None:
+    os.makedirs(OUT, exist_ok=True)
+    rng = np.random.default_rng(seed)
+    made = 0
+    while made < n_molecules:
+        mol = build_molecule(rng)
+        if mol is None:
+            continue
+        zs, pos, heavy_edges = mol
+        made += 1
+        text = write_molecule(made, zs, pos, heavy_edges, rng)
+        with open(os.path.join(OUT, f"dsgdb9nsd_{made:06d}.xyz"), "w") as f:
+            f.write(text)
+    print(f"wrote {made} molecules to {OUT}")
+
+
+if __name__ == "__main__":
+    main()

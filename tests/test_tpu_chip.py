@@ -1,0 +1,44 @@
+"""Opt-in real-chip TPU kernel suite (VERDICT r02 item 3).
+
+The in-process pytest session pins a virtual CPU mesh before jax loads
+(conftest), so the on-chip checks run in a SUBPROCESS with a clean
+environment where the image's default backend (the tunneled TPU) wins.
+Gated behind HYDRAGNN_TPU_TESTS=1: the checks dispatch against the real
+chip and are budgeted under its post-burst throttle (~40 dispatches).
+
+Run via ``CI_TPU=1 ./ci.sh`` or directly:
+``HYDRAGNN_TPU_TESTS=1 python -m pytest tests/test_tpu_chip.py -q``.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("HYDRAGNN_TPU_TESTS") != "1",
+    reason="real-chip suite: set HYDRAGNN_TPU_TESTS=1 (needs a TPU)",
+)
+
+_REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir)
+
+
+def pytest_tpu_kernel_selfcheck():
+    env = dict(os.environ)
+    # drop any CPU pin the caller exported; the subprocess must see the
+    # image default (axon TPU plugin)
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("XLA_FLAGS", None)
+    env.pop("HYDRAGNN_PALLAS", None)
+    proc = subprocess.run(
+        [sys.executable, "-m", "hydragnn_tpu.tools.tpu_selfcheck"],
+        cwd=_REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=1800,
+    )
+    sys.stdout.write(proc.stdout)
+    sys.stderr.write(proc.stderr[-2000:])
+    assert proc.returncode == 0, f"on-chip selfcheck failed (rc={proc.returncode})"
