@@ -35,7 +35,7 @@ def _round_up(x: int, m: int) -> int:
 def pad_plan_for(
     samples: Sequence[GraphSample],
     batch_size: int,
-    node_multiple: int = 8,
+    node_multiple: int = 16,
     edge_multiple: int = 8,
 ) -> tuple:
     """Static (n_node_pad, n_edge_pad, n_graph_pad) covering any batch of
@@ -84,7 +84,7 @@ class GraphLoader:
         num_shards: int = 1,
         shard_rank: int = 0,
         device_stack: int = 1,
-        node_multiple: int = 8,
+        node_multiple: int = 16,
         edge_multiple: int = 8,
         drop_last: bool = False,
         cache_device_batches: bool = False,

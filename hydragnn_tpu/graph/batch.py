@@ -85,7 +85,7 @@ def batch_graphs(
     n_node_pad: Optional[int] = None,
     n_edge_pad: Optional[int] = None,
     n_graph_pad: Optional[int] = None,
-    node_multiple: int = 8,
+    node_multiple: int = 16,
     edge_multiple: int = 8,
 ) -> GraphBatch:
     """Concatenate a list of single graphs and pad to static shapes.
@@ -116,7 +116,9 @@ def batch_graphs(
             raise ValueError("node_targets keys differ across graphs")
 
     # One extra padding graph absorbs padding nodes/edges; at least one
-    # padding node/edge must exist for them to point at.
+    # padding node/edge must exist for them to point at. node_multiple
+    # defaults to 16 = ops.segment_pallas.ALIGN so the CSR-broadcast
+    # kernel never re-pads (copies) the node table per call.
     if n_graph_pad is None:
         n_graph_pad = n_graphs + 1
     if n_node_pad is None:

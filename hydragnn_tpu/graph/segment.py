@@ -108,7 +108,9 @@ def _segment_extremum_bwd(num_segments, indices_are_sorted, is_max, res, g):
     from hydragnn_tpu.ops.segment_pallas import segment_sum_fast
 
     data, segment_ids, out = res
-    sel = data == out[segment_ids]
+    # CSR-broadcast kernel for sorted ids: XLA's [N,H]->[E,H] row gather
+    # is the r03 trace's dominant backward cost (docs/PERF.md)
+    sel = data == _gather_fwd_impl(out, segment_ids, indices_are_sorted)
     # tie count: a full-width segment sum — the Pallas CSR kernel when
     # ids are sorted on TPU (this is a backward hot path: PNA pays it
     # every layer). The 0/1 tie mask travels in the DATA dtype (half
@@ -125,7 +127,8 @@ def _segment_extremum_bwd(num_segments, indices_are_sorted, is_max, res, g):
     share = g.astype(jnp.float32) / jnp.maximum(cnt, 1.0)
     # cast BEFORE the [E, H]-widening gather: halves the gather's HBM
     # write under bf16; the final cotangent is data.dtype anyway
-    grad = jnp.where(sel, share.astype(data.dtype)[segment_ids], 0)
+    share = share.astype(data.dtype)
+    grad = jnp.where(sel, _gather_fwd_impl(share, segment_ids, indices_are_sorted), 0)
     ids_zero = jnp.zeros(segment_ids.shape, dtype=jax.dtypes.float0)
     return grad, ids_zero
 
@@ -235,12 +238,22 @@ def gather_rows(
     without an ordering hint; routing it through
     :func:`hydragnn_tpu.ops.segment_pallas.segment_sum_fast` uses the
     Pallas CSR kernel on TPU for sorted ids (the per-layer
-    receiver-gather backward in every conv)."""
+    receiver-gather backward in every conv). The forward itself also
+    takes the CSR-broadcast kernel for sorted ids (XLA's row gather
+    loops serially on TPU — docs/PERF.md r03 trace)."""
+    return _gather_fwd_impl(x, ids, indices_are_sorted)
+
+
+def _gather_fwd_impl(x, ids, indices_are_sorted):
+    if indices_are_sorted and x.ndim == 2:
+        from hydragnn_tpu.ops.segment_pallas import gather_rows_sorted_fast
+
+        return gather_rows_sorted_fast(x, ids)
     return x[ids]
 
 
 def _gather_rows_fwd(x, ids, num_rows, indices_are_sorted):
-    return x[ids], ids
+    return _gather_fwd_impl(x, ids, indices_are_sorted), ids
 
 
 def _gather_rows_bwd(num_rows, indices_are_sorted, ids, g):

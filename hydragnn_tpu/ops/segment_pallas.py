@@ -408,6 +408,215 @@ def segment_sum_pallas(
     return _SUM_OP(data, segment_ids, num_segments, interpret)
 
 
+# ---------------------------------------------------------------------------
+# CSR broadcast (sorted-ids row gather): out[e] = table[ids[e]]
+# ---------------------------------------------------------------------------
+#
+# XLA lowers a [N, H] -> [E, H] row gather on TPU to a serial per-row
+# loop — measured 6-9 ms at E=699k, H=128 on v5e (~19 GB/s effective),
+# and the PNA backward pays ~36 of them per step (g_sum[recv],
+# g_sumsq[recv], extremum out[recv]/share[recv] per layer): 280 of the
+# 471 ms step (r03 trace, docs/PERF.md). For SORTED ids the gather is a
+# CSR broadcast with perfect locality: edge chunk k reads only table
+# rows [recv[k*CE], recv[k*CE] + CE], so a one-hot MXU matmul
+# (out_chunk = onehot[CE, W] @ window[W, H]) streams the output at
+# bandwidth instead of looping rows. Exactness: each output row is
+# 1.0 * table_row summed once — exact for bf16 inputs with f32
+# accumulation; f32 inputs use HIGHEST (the f32-as-3xbf16 split times
+# exact 1.0 reconstructs exactly).
+
+ALIGN = 16  # window starts/sizes are 16-row aligned: Mosaic must prove
+# HBM slice starts divisible by the tiling — 8 rows for f32, 16 for
+# packed bf16 (8-sublane tile x 2-row packing)
+BW = CE + ALIGN  # table-window rows per chunk: CE sorted edges span
+# <= CE distinct rows; +ALIGN covers the aligned window start
+
+
+def _bcast_kernel(scal_ref, table_hbm, recv_ref, out_ref,
+                  win_vmem, acc_ref, sems):
+    """Grid step k: out rows [k*CE, (k+1)*CE) = table[recv rows].
+    recv chunk and out chunk are Pallas-pipelined BlockSpec windows; the
+    data-dependent table windows are manual DMAs (BlockSpec index maps
+    cannot express data-dependent starts).
+
+    A chunk's CE sorted ids hold <= CE distinct VALUES but may SPAN an
+    arbitrary row range (ids can skip nodes), so the chunk loops over
+    as many BW-wide windows as its span needs — ``scal_ref[1, k]``
+    (prefetched) holds the count, 1 in the dense-receiver common case.
+    Window DMA starts are clamped to stay in bounds; a logical range
+    check keeps overlapping clamped windows from double-selecting."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    k = pl.program_id(0)
+    astart = scal_ref[0, k]
+    wcnt = scal_ref[1, k]
+    n_clamp = scal_ref[2, 0]  # n_pad - BW: max legal DMA start
+    recv = recv_ref[0, :]
+    acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    def dma(slot, wstart):
+        return pltpu.make_async_copy(
+            table_hbm.at[
+                pl.ds(pl.multiple_of(jnp.minimum(wstart, n_clamp), ALIGN), BW), :
+            ],
+            win_vmem.at[slot],
+            sems.at[slot],
+        )
+
+    dma(0, astart).start()
+
+    def window_body(w, _):
+        slot = w % 2
+        wstart = astart + w * BW
+
+        @pl.when(w + 1 < wcnt)
+        def _prefetch():
+            dma((w + 1) % 2, wstart + BW).start()
+
+        dma(slot, wstart).wait()
+        cstart = jnp.minimum(wstart, n_clamp)
+        local = recv - cstart  # [CE]
+        # fold the logical-range check into the index vector (Mosaic
+        # cannot broadcast a 1-bit vector into a minor dim): ids outside
+        # [wstart, wstart + BW) get a poison index no iota lane matches
+        in_range = (recv >= wstart) & (recv < wstart + BW)
+        local = jnp.where(in_range, local, -1)
+        onehot = (
+            local[:, None] == jax.lax.broadcasted_iota(jnp.int32, (CE, BW), 1)
+        )
+        win = win_vmem[slot]
+        if win.dtype == jnp.float32:
+            acc_ref[:] += jax.lax.dot_general(
+                onehot.astype(jnp.float32), win, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST,
+            )
+        else:
+            acc_ref[:] += jax.lax.dot_general(
+                onehot.astype(win.dtype), win, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+        return 0
+
+    jax.lax.fori_loop(0, wcnt, window_body, 0)
+    out_ref[:] = acc_ref[:].astype(out_ref.dtype)
+
+
+def _bcast_kernel_call(table, ids, interpret):
+    """Shard-local sorted-row-gather kernel invocation."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    e = ids.shape[0]
+    n, h = table.shape
+    if e == 0:
+        return table[:0]
+    n_pad = max(((n + ALIGN - 1) // ALIGN) * ALIGN, BW)
+    if n_pad != n:
+        table = jnp.concatenate(
+            [table, jnp.zeros((n_pad - n, h), table.dtype)], axis=0
+        )
+    e_pad = ((e + CE - 1) // CE) * CE
+    # sentinel rows land outside every logical window -> zero rows
+    recv = jnp.concatenate(
+        [ids.astype(jnp.int32), jnp.full((e_pad - e,), n_pad, jnp.int32)]
+    )
+    n_chunks = e_pad // CE
+    # per-chunk window plan: aligned start at the chunk's first id, and
+    # the number of BW-wide windows covering its real-id span (sorted
+    # ids hold <= CE distinct values but may SPAN any range)
+    first = recv[::CE][:n_chunks]
+    astart = first & ~jnp.int32(ALIGN - 1)
+    last_real = jnp.minimum(recv[CE - 1 :: CE][:n_chunks], recv[e - 1])
+    wcnt = jnp.maximum(1, (last_real + 1 - astart + BW - 1) // BW)
+    scal = jnp.stack(
+        [
+            astart,
+            wcnt,
+            jnp.full((n_chunks,), n_pad - BW, jnp.int32),
+        ]
+    ).astype(jnp.int32)
+    vma = frozenset(getattr(jax.typeof(recv), "vma", frozenset())) | frozenset(
+        getattr(jax.typeof(table), "vma", frozenset())
+    )
+    out_sds = jax.ShapeDtypeStruct((e_pad, h), table.dtype, vma=vma)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_chunks,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec((1, CE), lambda k, ptr: (0, k)),
+        ],
+        out_specs=pl.BlockSpec((CE, h), lambda k, ptr: (k, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, BW, h), table.dtype),
+            pltpu.VMEM((CE, h), jnp.float32),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    out = pl.pallas_call(
+        _bcast_kernel,
+        out_shape=out_sds,
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(scal, table, recv[None, :])
+    return out[:e]
+
+
+def _make_partitioned_bcast():
+    """custom_partitioning wrapper: ids may be GSPMD-sharded on the edge
+    axis (each shard's slice is contiguous and sorted — the giant-graph
+    path); the table is replicated and each device gathers its local
+    rows. Output follows the ids' edge sharding; no collective."""
+
+    def base(table, ids, interpret):
+        return _bcast_kernel_call(table, ids, interpret)
+
+    op = custom_partitioning(base, static_argnums=(2,))
+
+    def infer(interpret, mesh, arg_shapes, result_shape):
+        ids_spec = arg_shapes[1].sharding.spec
+        edge_axis = ids_spec[0] if len(ids_spec) >= 1 else None
+        return NamedSharding(mesh, P(edge_axis, None))
+
+    def partition(interpret, mesh, arg_shapes, result_shape):
+        ids_spec = arg_shapes[1].sharding.spec
+        edge_axis = ids_spec[0] if len(ids_spec) >= 1 else None
+
+        def lower_fn(table, ids):
+            return _bcast_kernel_call(table, ids, interpret)
+
+        arg_sh = (
+            NamedSharding(mesh, P(None, None)),
+            NamedSharding(mesh, P(edge_axis)),
+        )
+        return mesh, lower_fn, NamedSharding(mesh, P(edge_axis, None)), arg_sh
+
+    op.def_partition(
+        partition=partition,
+        infer_sharding_from_operands=infer,
+        sharding_rule="n h, e -> e h",
+    )
+    return op
+
+
+_BCAST_OP = _make_partitioned_bcast()
+
+
+def gather_rows_sorted_fast(table: jnp.ndarray, ids: jnp.ndarray) -> jnp.ndarray:
+    """``table[ids]`` for SORTED ids: the CSR-broadcast Pallas kernel on
+    TPU (one-hot MXU matmul per edge chunk — streams at bandwidth where
+    XLA's row gather loops serially), plain indexing otherwise. NOT
+    differentiated — callers are custom backward functions (the gather's
+    own VJP would be a sorted segment sum). Same knob contract as
+    :func:`segment_sum_family`; requires 2-D [N, H] table with
+    H % 128 == 0 for the kernel path."""
+    if ids.shape[0] > 0 and _use_pallas(table, indices_are_sorted=True):
+        return _BCAST_OP(table, ids, _interpret_mode())
+    return table[ids]
+
+
 def _use_pallas(data: jnp.ndarray, indices_are_sorted: bool) -> bool:
     """Shared HYDRAGNN_PALLAS knob contract (module docstring): "1"
     forces the kernel on TPU, "interpret" forces it in interpret mode
@@ -505,15 +714,26 @@ def _family_bwd(num_segments, indices_are_sorted, use_pallas, res, g):
     # the final cotangent is data.dtype regardless
     g_sum = g_sum.astype(data.dtype)
     g_sumsq = g_sumsq.astype(data.dtype)
-    sumsq_term = 2.0 * data * g_sumsq[segment_ids]
+    if indices_are_sorted:
+        # ONE stacked CSR-broadcast instead of two serial XLA row
+        # gathers (the r03 trace's dominant backward cost: 6-9 ms each
+        # at E=699k vs ~0.5 ms through the kernel)
+        both = gather_rows_sorted_fast(
+            jnp.concatenate([g_sum, g_sumsq], axis=-1), segment_ids
+        )
+        h = data.shape[1]
+        g_sum_e, g_sumsq_e = both[:, :h], both[:, h:]
+    else:
+        g_sum_e, g_sumsq_e = g_sum[segment_ids], g_sumsq[segment_ids]
+    sumsq_term = 2.0 * data * g_sumsq_e
     if mask is None:
-        grad = g_sum[segment_ids] + sumsq_term
+        grad = g_sum_e + sumsq_term
         mask_zero = None
     else:
         # weighted closed form: out_sum = sum(m*d), out_sumsq = sum(m^2*d^2)
         # => d/dd = m*g_sum[ids] + 2*m^2*d*g_sumsq[ids]
         m = mask.astype(g_sum.dtype)[:, None]
-        grad = m * (g_sum[segment_ids] + m * sumsq_term)
+        grad = m * (g_sum_e + m * sumsq_term)
         # the mask is non-differentiable by contract (stop_gradient on
         # entry in segment_sum_family): bool/int masks take a float0
         # cotangent, float weight masks a true-zero one

@@ -97,6 +97,20 @@ def check_kernels() -> bool:
         good = _allclose(out, ref, 1e-5, 1e-4)
         (_ok if good else _fail)(f"sum_E{e}_f32_mask-bool")
         ok &= good
+    # CSR-broadcast row gather (r03: the backward's widening gathers):
+    # must be bit-exact vs indexing on-chip — dense, jumpy (low-degree,
+    # multi-window chunks), f32 and bf16
+    from hydragnn_tpu.ops.segment_pallas import _bcast_kernel_call
+
+    for e, n, h, tag in ((120_000, 5136, 128, "dense"), (8192, 60_000, 128, "jumpy")):
+        ids = jnp.asarray(np.sort(rng.integers(0, n, e)).astype(np.int32))
+        table32 = jnp.asarray(rng.normal(size=(n, h)).astype(np.float32))
+        for dtype in (jnp.float32, jnp.bfloat16):
+            table = table32.astype(dtype)
+            out = _bcast_kernel_call(table, ids, interpret=False)
+            good = bool(np.array_equal(np.asarray(out), np.asarray(table[ids])))
+            (_ok if good else _fail)(f"bcast_{tag}_{dtype.__name__}")
+            ok &= good
     return ok
 
 

@@ -412,3 +412,77 @@ def pytest_pallas_knob_1_requires_tpu_backend(monkeypatch):
     monkeypatch.setenv("HYDRAGNN_PALLAS", "1")
     assert jax.default_backend() == "cpu"
     assert not _use_pallas(data, True)  # CPU: knob 1 falls back
+
+
+def pytest_bcast_gather_matches_indexing():
+    """CSR-broadcast row gather (sorted ids): kernel output must be
+    bit-exact against plain indexing across chunk boundaries, window
+    clamping near the table end, low- and high-degree id patterns, f32
+    and bf16 tables."""
+    from hydragnn_tpu.ops.segment_pallas import _bcast_kernel_call
+
+    rng = np.random.default_rng(23)
+    cases = [
+        (700, 100, 128, "f32"),      # single-chunk tail
+        (3000, 40, 128, "f32"),      # high degree, few rows (clamped windows)
+        (2048, 2000, 128, "f32"),    # low degree ~1: chunk spans ~CE rows
+        (1537, 77, 256, "bf16"),     # multi-chunk + ragged tail + wide H
+    ]
+    for e, n, h, dt in cases:
+        ids = jnp.asarray(np.sort(rng.integers(0, n, e)).astype(np.int32))
+        table = jnp.asarray(rng.normal(size=(n, h)).astype(np.float32))
+        if dt == "bf16":
+            table = table.astype(jnp.bfloat16)
+        out = _bcast_kernel_call(table, ids, interpret=True)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(table[ids]))
+
+
+def pytest_bcast_gather_in_vjps_interpret(monkeypatch):
+    """The family and extremum backward passes route their widening
+    gathers through the CSR-broadcast kernel when ids are sorted: grads
+    under HYDRAGNN_PALLAS=interpret must match HYDRAGNN_PALLAS=0."""
+    from hydragnn_tpu.graph import segment as S
+    from hydragnn_tpu.ops import segment_sum_family
+
+    rng = np.random.default_rng(29)
+    e, h, n = 900, 128, 120
+    data = jnp.asarray(rng.normal(size=(e, h)).astype(np.float32))
+    seg = jnp.asarray(np.sort(rng.integers(0, n, e)).astype(np.int32))
+    mask = jnp.asarray(rng.random(e) > 0.2)
+
+    def loss(d):
+        s, sq, c = segment_sum_family(d, seg, n, mask=mask, indices_are_sorted=True)
+        mx = S.segment_max(d, seg, n, mask=mask, indices_are_sorted=True)
+        mn = S.segment_min(d, seg, n, mask=mask, indices_are_sorted=True)
+        xr = S.gather_rows(jnp.tanh(s), seg, n, True)
+        return (s * s).sum() + sq.sum() + (mx * mn).sum() + xr.sum()
+
+    monkeypatch.setenv("HYDRAGNN_PALLAS", "0")
+    g_xla = jax.jit(jax.grad(loss))(data)
+    monkeypatch.setenv("HYDRAGNN_PALLAS", "interpret")
+    g_k = jax.jit(jax.grad(loss))(data)
+    np.testing.assert_allclose(
+        np.asarray(g_k), np.asarray(g_xla), rtol=1e-5, atol=1e-5
+    )
+
+
+def pytest_bcast_gather_edge_sharded_mesh(monkeypatch):
+    """The CSR-broadcast op's custom_partitioning rule: edge-sharded ids
+    on the 8-device CPU mesh gather per-shard from a replicated table
+    and match plain indexing."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from hydragnn_tpu.ops.segment_pallas import gather_rows_sorted_fast
+
+    rng = np.random.default_rng(31)
+    e, h, n = 1024, 128, 96
+    ids = jnp.asarray(np.sort(rng.integers(0, n, e)).astype(np.int32))
+    table = jnp.asarray(rng.normal(size=(n, h)).astype(np.float32))
+
+    mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+    ids_s = jax.device_put(ids, NamedSharding(mesh, P("data")))
+    table_s = jax.device_put(table, NamedSharding(mesh, P()))
+
+    monkeypatch.setenv("HYDRAGNN_PALLAS", "interpret")
+    out = jax.jit(gather_rows_sorted_fast)(table_s, ids_s)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(table[ids]))

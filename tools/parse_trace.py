@@ -1,0 +1,68 @@
+"""Parse an xplane trace dir into a per-HLO-op time table (via the
+xprof converter's hlo_stats tool; the tensorboard-plugin-profile
+converter in this image has a protobuf mismatch, xprof's works).
+
+Usage: python tools/parse_trace.py /tmp/tb_flagship [n_top]
+"""
+
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    trace_dir = sys.argv[1] if len(sys.argv) > 1 else "/tmp/tb_flagship"
+    n_top = int(sys.argv[2]) if len(sys.argv) > 2 else 40
+    planes = glob.glob(f"{trace_dir}/**/*.xplane.pb", recursive=True)
+    if not planes:
+        raise SystemExit(f"no xplane files under {trace_dir}")
+
+    from tensorboard_plugin_profile.convert import raw_to_tool_data as rd
+
+    params = {"tqx": "out:csv;"}
+    for tool in ("hlo_stats", "framework_op_stats"):
+        try:
+            data, _ = rd.xspace_to_tool_data(planes, tool, params)
+        except Exception as e:
+            print(f"{tool}: FAILED {e!r}")
+            continue
+        if isinstance(data, bytes):
+            data = data.decode("utf-8", "replace")
+        out = f"/tmp/{tool}.csv"
+        with open(out, "w") as f:
+            f.write(data)
+        print(f"{tool}: wrote {out} ({len(data)} bytes)")
+        lines = data.splitlines()
+        print(lines[0] if lines else "(empty)")
+        break
+    else:
+        # fallback: raw xplane decode via xprof protos
+        try:
+            from xprof.protobuf import xplane_pb2  # type: ignore
+        except ImportError:
+            from tensorboard_plugin_profile.protobuf import xplane_pb2  # type: ignore
+
+        import collections
+
+        tot = collections.Counter()
+        for p in planes:
+            xs = xplane_pb2.XSpace()
+            xs.ParseFromString(open(p, "rb").read())
+            for plane in xs.planes:
+                if "TPU" not in plane.name and "Device" not in plane.name:
+                    continue
+                ev_names = {k: v for k, v in plane.event_metadata.items()}
+                for line in plane.lines:
+                    for ev in line.events:
+                        md = ev_names.get(ev.metadata_id)
+                        name = md.name if md else str(ev.metadata_id)
+                        tot[name] += ev.duration_ps
+        for name, ps in tot.most_common(n_top):
+            print(f"{ps/1e9:10.3f} ms  {name}")
+
+
+if __name__ == "__main__":
+    main()
