@@ -187,22 +187,49 @@ def _csr_chunk_loop(block_ptr_ref, msg_hbm, recv_hbm,
 
         for cp in dmas(slot, k):
             cp.wait()
-        # upcast bf16 DMA payloads in registers; matmuls accumulate f32
-        msg = msg_vmem[slot].astype(jnp.float32)
+        raw = msg_vmem[slot]
         rows = jax.lax.broadcasted_iota(jnp.int32, (BN, CE), 0) + i * BN
-        onehot_t = (recv_vmem[slot] == rows).astype(jnp.float32)
-        # precision=HIGHEST: the MXU default rounds f32 inputs to bf16
-        sum_ref[:] += jax.lax.dot_general(
-            onehot_t, msg, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
-        )
-        if sumsq_ref is not None:
-            sumsq_ref[:] += jax.lax.dot_general(
-                onehot_t, msg * msg, (((1,), (0,)), ((), ())),
+        onehot = recv_vmem[slot] == rows
+        if raw.dtype == jnp.bfloat16:
+            # native-MXU bf16 path: onehot x value products are exact
+            # (0/1 times an already-bf16 value) and accumulation is f32
+            # — no need for the 6x-cost HIGHEST f32 emulation. The
+            # squares are NOT bf16-exact, so sumsq splits the exact f32
+            # square into hi + lo bf16 terms (two native matmuls):
+            # products then roundtrip within ~2^-16 relative of f32,
+            # matching the XLA reference's upcast-then-square.
+            onehot_t = onehot.astype(jnp.bfloat16)
+            sum_ref[:] += jax.lax.dot_general(
+                onehot_t, raw, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            if sumsq_ref is not None:
+                sq = raw.astype(jnp.float32)
+                sq = sq * sq
+                hi = sq.astype(jnp.bfloat16)
+                lo = (sq - hi.astype(jnp.float32)).astype(jnp.bfloat16)
+                sumsq_ref[:] += jax.lax.dot_general(
+                    onehot_t, hi, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                ) + jax.lax.dot_general(
+                    onehot_t, lo, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+        else:
+            msg = raw.astype(jnp.float32)
+            onehot_t = onehot.astype(jnp.float32)
+            # precision=HIGHEST: the MXU default rounds f32 inputs to bf16
+            sum_ref[:] += jax.lax.dot_general(
+                onehot_t, msg, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
                 precision=jax.lax.Precision.HIGHEST,
             )
+            if sumsq_ref is not None:
+                sumsq_ref[:] += jax.lax.dot_general(
+                    onehot_t, msg * msg, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                    precision=jax.lax.Precision.HIGHEST,
+                )
         return 0
 
     jax.lax.fori_loop(k0, k1, chunk_body, 0)
@@ -611,10 +638,32 @@ def gather_rows_sorted_fast(table: jnp.ndarray, ids: jnp.ndarray) -> jnp.ndarray
     differentiated — callers are custom backward functions (the gather's
     own VJP would be a sorted segment sum). Same knob contract as
     :func:`segment_sum_family`; requires 2-D [N, H] table with
-    H % 128 == 0 for the kernel path."""
-    if ids.shape[0] > 0 and _use_pallas(table, indices_are_sorted=True):
+    H % 128 == 0 for the kernel path (narrower tables are lane-padded
+    in and sliced back — :func:`_lane_pad`)."""
+    if ids.shape[0] == 0 or table.ndim != 2:
+        return table[ids]
+    h = _narrow_kernel_width(table, indices_are_sorted=True)
+    if h is not None:
+        return _BCAST_OP(_lane_pad(table), ids, _interpret_mode())[:, :h]
+    if _use_pallas(table, indices_are_sorted=True):
         return _BCAST_OP(table, ids, _interpret_mode())
     return table[ids]
+
+
+def _kernel_eligible(indices_are_sorted: bool) -> bool:
+    """Knob/backend part of the dispatch decision (no shape check)."""
+    if _FORCE_XLA.get():
+        return False
+    knob = os.environ.get("HYDRAGNN_PALLAS", "auto")
+    if knob == "0":
+        return False
+    if not pallas_available():
+        return False
+    if knob == "interpret":
+        return True
+    if knob == "1":
+        return jax.default_backend() == "tpu"
+    return indices_are_sorted and jax.default_backend() == "tpu"
 
 
 def _use_pallas(data: jnp.ndarray, indices_are_sorted: bool) -> bool:
@@ -623,19 +672,40 @@ def _use_pallas(data: jnp.ndarray, indices_are_sorted: bool) -> bool:
     on any backend, "0" forces XLA, default auto = Pallas on TPU for
     sorted, 2-D, 128-lane-multiple data. :func:`xla_segment_ops`
     overrides everything (vmap has no custom_partitioning rule)."""
-    if _FORCE_XLA.get():
-        return False
-    knob = os.environ.get("HYDRAGNN_PALLAS", "auto")
-    if knob == "0":
-        return False
     tiles = data.ndim == 2 and data.shape[1] % 128 == 0
-    if not (pallas_available() and tiles):
-        return False
-    if knob == "interpret":
-        return True
-    if knob == "1":
-        return jax.default_backend() == "tpu"
-    return indices_are_sorted and jax.default_backend() == "tpu"
+    return tiles and _kernel_eligible(indices_are_sorted)
+
+
+def _narrow_kernel_width(data: jnp.ndarray, indices_are_sorted: bool):
+    """The shared narrow-data dispatch test: returns the original width
+    ``h`` when ``data`` is 2-D, NOT 128-lane aligned, and the knob /
+    backend allow the kernel — i.e. the caller should ``_lane_pad`` the
+    data in and slice ``[:, :h]`` back out. None otherwise. One
+    definition so the eligibility contract cannot diverge between the
+    gather / sum / family dispatchers."""
+    if data.ndim != 2:
+        return None
+    h = data.shape[1]
+    if h % 128 != 0 and _kernel_eligible(indices_are_sorted):
+        return h
+    return None
+
+
+def _lane_pad(data: jnp.ndarray) -> jnp.ndarray:
+    """Zero-pad the feature axis up to the next 128-lane multiple.
+
+    XLA's scatter/gather segment lowerings loop PER ROW on TPU, so a
+    narrow op (e.g. the first conv layer, whose width is the raw
+    feature count) costs the same 5-9 ms as a 128-wide one while the
+    Pallas kernels stream rows in bulk — padding lanes to reach the
+    kernel is a large net win (r03 trace: conv_0's XLA-fallback ops
+    were ~40 ms of the step). Callers slice the result back; under AD
+    the pad's transpose slices cotangents automatically."""
+    h = data.shape[1]
+    hp = ((h + 127) // 128) * 128
+    return jnp.concatenate(
+        [data, jnp.zeros((data.shape[0], hp - h), data.dtype)], axis=1
+    )
 
 
 def _interpret_mode() -> bool:
@@ -658,7 +728,17 @@ def segment_sum_fast(
     of input dtype — the kernel accumulates f32 natively (bf16 inputs
     DMA half the bytes, exact for 0/1-valued data like tie masks), and
     the XLA fallback upcasts sub-f32 inputs first. Callers may
-    therefore pass bf16 cotangents/masks purely for bandwidth."""
+    therefore pass bf16 cotangents/masks purely for bandwidth.
+
+    Narrow data is lane-padded into the kernel (see :func:`_lane_pad`)."""
+    h = _narrow_kernel_width(data, indices_are_sorted)
+    if h is not None:
+        out = segment_sum_pallas(
+            _lane_pad(data), segment_ids, num_segments, mask,
+            interpret=_interpret_mode(),
+            indices_are_sorted=indices_are_sorted,
+        )
+        return out[:, :h]
     if _use_pallas(data, indices_are_sorted):
         return segment_sum_pallas(
             data, segment_ids, num_segments, mask,
@@ -762,9 +842,18 @@ def segment_sum_family(
     otherwise. The kernel op carries a custom_partitioning rule, so it
     composes with GSPMD edge sharding (module docstring); only vmap
     contexts need :func:`xla_segment_ops`. The mask (edge validity or
-    float weights) is non-differentiable by contract."""
+    float weights) is non-differentiable by contract. Narrow data is
+    lane-padded into the kernel (:func:`_lane_pad`; the pad's AD
+    transpose slices the cotangent back automatically)."""
     if mask is not None:
         mask = jax.lax.stop_gradient(mask)
+    h = _narrow_kernel_width(data, indices_are_sorted)
+    if h is not None:
+        s, sq, cnt = _family(
+            _lane_pad(data), segment_ids, num_segments, mask,
+            indices_are_sorted, True,
+        )
+        return s[:, :h], sq[:, :h], cnt
     use_pallas = _use_pallas(data, indices_are_sorted)
     return _family(data, segment_ids, num_segments, mask,
                    indices_are_sorted, use_pallas)

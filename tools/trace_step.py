@@ -46,7 +46,8 @@ np.asarray(loss)
 log(f"warmup done loss={float(loss):.4f}")
 
 trace_dir = os.environ.get("TRACE_DIR", "/tmp/tb_flagship")
-os.system(f"rm -rf {trace_dir}")
+import shutil
+shutil.rmtree(trace_dir, ignore_errors=True)
 with jax.profiler.trace(trace_dir):
     for i in range(3):
         state, loss, _ = compiled(state, batches[(i + 1) % len(batches)])
