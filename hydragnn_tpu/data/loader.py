@@ -321,10 +321,19 @@ class GraphLoader:
 
 
 def _mask_out(batch: GraphBatch) -> GraphBatch:
-    """Turn a batch into pure padding (all masks False, counts zero)."""
+    """Turn a batch into pure padding (all masks False, counts zero).
+
+    Edges are repointed at the last node slot (always a padding slot —
+    ``batch_graphs`` reserves one) to keep the loader-wide invariant
+    that masked edges never target a real node: the chassis degree
+    shortcut (``models/convs.py:sorted_in_degree``) counts edges
+    without consulting the mask."""
     import numpy as _np
 
+    pad_slot = batch.num_nodes - 1
     return batch.replace(
+        senders=_np.full_like(_np.asarray(batch.senders), pad_slot),
+        receivers=_np.full_like(_np.asarray(batch.receivers), pad_slot),
         node_mask=_np.zeros_like(_np.asarray(batch.node_mask)),
         edge_mask=_np.zeros_like(_np.asarray(batch.edge_mask)),
         graph_mask=_np.zeros_like(_np.asarray(batch.graph_mask)),

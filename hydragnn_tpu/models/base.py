@@ -258,6 +258,7 @@ class HydraModel(nn.Module):
             # backward (convs._gather_senders) — the sorted segment sum
             # beats XLA's unsorted scatter-add ~2x at flagship shapes
             sender_perm=jnp.argsort(batch.senders),
+            in_degree=C.sorted_in_degree(batch.receivers, batch.num_nodes),
         )
 
     def _apply_conv(self, conv, x, ctx, train: bool):

@@ -44,6 +44,24 @@ class EdgeContext:
     # layer's sender-gather backward run as a SORTED segment sum (the
     # Pallas CSR kernel on TPU) instead of XLA's unsorted scatter-add
     sender_perm: Optional[jnp.ndarray] = None  # [E] int32
+    # per-node count of REAL incoming edges, computed once per step by
+    # the chassis WITHOUT a scatter (receivers are sorted, so it is a
+    # searchsorted difference; padding edges point at a padding node and
+    # never inflate a real node's count). Layers that need degree (PNA
+    # scalers/has, MFC dispatch) read this instead of paying the [E,1]
+    # count scatter XLA otherwise emits (~6 ms at E=699k, r03 trace).
+    in_degree: Optional[jnp.ndarray] = None  # [N] float32
+
+
+def sorted_in_degree(receivers: jnp.ndarray, num_nodes: int) -> jnp.ndarray:
+    """Per-node incoming-edge count from SORTED receivers — two
+    searchsorted passes instead of XLA's per-row [E,1] count scatter.
+    Valid when masked edges cannot point at real nodes (the loader
+    contract: padding edges target a padding node)."""
+    bounds = jnp.searchsorted(
+        receivers, jnp.arange(num_nodes + 1, dtype=receivers.dtype), side="left"
+    )
+    return (bounds[1:] - bounds[:-1]).astype(jnp.float32)
 
 
 def _gather_senders(x: jnp.ndarray, ctx: EdgeContext) -> jnp.ndarray:
@@ -110,7 +128,10 @@ class MFConv(nn.Module):
             _gather_senders(x, ctx), ctx.receivers, n,
             mask=ctx.edge_mask, indices_are_sorted=True,
         )
-        deg = S.node_degree(ctx.receivers, n, mask=ctx.edge_mask).astype(jnp.int32)
+        if ctx.in_degree is not None:
+            deg = ctx.in_degree.astype(jnp.int32)
+        else:
+            deg = S.node_degree(ctx.receivers, n, mask=ctx.edge_mask).astype(jnp.int32)
         deg = jnp.clip(deg, 0, self.max_degree)
 
         # init parity with the reference: PyG MFConv holds one torch
@@ -267,17 +288,24 @@ class PNAConv(nn.Module):
         if use_edge:
             v = v + nn.Dense(fin)(ctx.edge_attr) @ w[2 * fin :]
 
-        # mean/std share one fused sum-family pass over v (sum, sumsq,
-        # count read v once — hydragnn_tpu/ops/segment_pallas.py).
+        # ONE fused aggregation op: sum + sumsq (family kernel) and the
+        # [v,-v] scatter-max forward, with the two-kernel fused backward
+        # that emits the complete grad_v in a single pass
+        # (hydragnn_tpu/ops/segment_pallas.py:pna_aggregate).
         # indices_are_sorted: the data pipeline emits edges receiver-major
         # sorted (data/radius_graph.py:_cap_and_sort; batch_graphs keeps
         # per-graph order under increasing node offsets), which also
-        # enables the Pallas kernel's CSR path on TPU.
-        from hydragnn_tpu.ops import segment_sum_family
+        # enables the Pallas CSR kernels on TPU.
+        from hydragnn_tpu.ops import pna_aggregate
 
-        vsum, vsumsq, cnt = segment_sum_family(
+        vsum, vsumsq, cnt, both = pna_aggregate(
             v, ctx.receivers, n, mask=ctx.edge_mask, indices_are_sorted=True
         )
+        if ctx.in_degree is not None:
+            # chassis-precomputed degree (searchsorted over the sorted
+            # receivers): the aggregate's own count scatter then has no
+            # consumer and XLA dead-code-eliminates it
+            cnt = ctx.in_degree
         # mean/var formed in f32 (the family op accumulates f32); cast
         # back to the compute dtype only after the cancellation
         safe_cnt = jnp.maximum(cnt, 1.0)[:, None]
@@ -289,17 +317,7 @@ class PNAConv(nn.Module):
         # empty receivers sqrt(eps), digit-identical to the message form
         var = jax.nn.relu(vsumsq / safe_cnt - mean_v * mean_v)
         std = jnp.sqrt(var + 1e-5)
-        # min/max as ONE fused [v,-v] scatter-max: XLA's TPU
-        # scatter-extremum is row-bound (the r03 trace measured 6.5 ms
-        # per pass at E=699k regardless of width), so one 2H-wide pass
-        # costs about one H-wide pass and halves the per-layer scatter
-        # count; the shared backward also computes one tie-mask family
-        # instead of two
         has_c = has.astype(v.dtype)
-        both = S.segment_max(
-            jnp.concatenate([v, -v], axis=-1),
-            ctx.receivers, n, mask=ctx.edge_mask, indices_are_sorted=True,
-        )
         max_v = both[:, : v.shape[1]]
         min_v = -both[:, v.shape[1] :]
         aggs = [

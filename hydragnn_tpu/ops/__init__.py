@@ -1,5 +1,6 @@
 from hydragnn_tpu.ops.segment_pallas import (
     pallas_available,
+    pna_aggregate,
     segment_sum_family,
     segment_sum_family_pallas,
     segment_sum_family_xla,

@@ -459,19 +459,22 @@ BW = CE + ALIGN  # table-window rows per chunk: CE sorted edges span
 # <= CE distinct rows; +ALIGN covers the aligned window start
 
 
-def _bcast_kernel(scal_ref, table_hbm, recv_ref, out_ref,
-                  win_vmem, acc_ref, sems):
-    """Grid step k: out rows [k*CE, (k+1)*CE) = table[recv rows].
-    recv chunk and out chunk are Pallas-pipelined BlockSpec windows; the
-    data-dependent table windows are manual DMAs (BlockSpec index maps
-    cannot express data-dependent starts).
+def _window_gather_acc(scal_ref, table_hbm, recv_ref, win_vmem, acc_ref, sems):
+    """Shared windowed-gather loop: accumulate ``table[recv]`` for the
+    current grid step's edge chunk into ``acc_ref`` (f32).
 
     A chunk's CE sorted ids hold <= CE distinct VALUES but may SPAN an
     arbitrary row range (ids can skip nodes), so the chunk loops over
     as many BW-wide windows as its span needs — ``scal_ref[1, k]``
     (prefetched) holds the count, 1 in the dense-receiver common case.
     Window DMA starts are clamped to stay in bounds; a logical range
-    check keeps overlapping clamped windows from double-selecting."""
+    check keeps overlapping clamped windows from double-selecting.
+    Exactness: each output row accumulates exactly one 1.0 x value
+    product in f32 — native bf16 matmul for bf16 tables, HIGHEST for
+    f32 (the f32-as-3xbf16 split times exact 1.0 reconstructs
+    exactly). This is the subtlest logic in the file; it is shared by
+    the bcast gather and the fused PNA backward's K2 so the two cannot
+    diverge."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -527,6 +530,30 @@ def _bcast_kernel(scal_ref, table_hbm, recv_ref, out_ref,
         return 0
 
     jax.lax.fori_loop(0, wcnt, window_body, 0)
+
+
+def _window_plan(recv, e, n_pad_t, n_chunks):
+    """Host-side per-chunk window plan (scalar-prefetch operand for
+    :func:`_window_gather_acc`): [astart; wcnt; n_clamp] as int32
+    [3, n_chunks]. ``recv`` is the CE-padded sorted id vector whose
+    sentinels are >= ``n_pad_t`` (outside every logical window)."""
+    first = recv[::CE][:n_chunks]
+    astart = first & ~jnp.int32(ALIGN - 1)
+    last_real = jnp.minimum(recv[CE - 1 :: CE][:n_chunks], recv[e - 1])
+    wcnt = jnp.maximum(1, (last_real + 1 - astart + BW - 1) // BW)
+    return jnp.stack(
+        [astart, wcnt, jnp.full((n_chunks,), n_pad_t - BW, jnp.int32)]
+    ).astype(jnp.int32)
+
+
+def _bcast_kernel(scal_ref, table_hbm, recv_ref, out_ref,
+                  win_vmem, acc_ref, sems):
+    """Grid step k: out rows [k*CE, (k+1)*CE) = table[recv rows].
+    recv chunk and out chunk are Pallas-pipelined BlockSpec windows; the
+    data-dependent table windows are manual DMAs (BlockSpec index maps
+    cannot express data-dependent starts) — see
+    :func:`_window_gather_acc`."""
+    _window_gather_acc(scal_ref, table_hbm, recv_ref, win_vmem, acc_ref, sems)
     out_ref[:] = acc_ref[:].astype(out_ref.dtype)
 
 
@@ -550,20 +577,7 @@ def _bcast_kernel_call(table, ids, interpret):
         [ids.astype(jnp.int32), jnp.full((e_pad - e,), n_pad, jnp.int32)]
     )
     n_chunks = e_pad // CE
-    # per-chunk window plan: aligned start at the chunk's first id, and
-    # the number of BW-wide windows covering its real-id span (sorted
-    # ids hold <= CE distinct values but may SPAN any range)
-    first = recv[::CE][:n_chunks]
-    astart = first & ~jnp.int32(ALIGN - 1)
-    last_real = jnp.minimum(recv[CE - 1 :: CE][:n_chunks], recv[e - 1])
-    wcnt = jnp.maximum(1, (last_real + 1 - astart + BW - 1) // BW)
-    scal = jnp.stack(
-        [
-            astart,
-            wcnt,
-            jnp.full((n_chunks,), n_pad - BW, jnp.int32),
-        ]
-    ).astype(jnp.int32)
+    scal = _window_plan(recv, e, n_pad, n_chunks)
     vma = frozenset(getattr(jax.typeof(recv), "vma", frozenset())) | frozenset(
         getattr(jax.typeof(table), "vma", frozenset())
     )
@@ -826,6 +840,416 @@ def _family_bwd(num_segments, indices_are_sorted, use_pallas, res, g):
 
 
 _family.defvjp(_family_fwd, _family_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Fused PNA aggregation: (sum, sumsq, [max(v), max(-v)]) with a two-kernel
+# backward
+# ---------------------------------------------------------------------------
+#
+# The r03 retrace showed the PNA backward still paying ~128 ms/step in
+# edge-space fragments: per layer, two widening gathers for the family
+# cotangents, tie-mask construction + a count kernel + a share gather
+# per extremum, then three [E, H] cotangent branches concatenated and
+# added. Fusing the WHOLE aggregation backward into two CSR kernels
+# collapses all of it to three [E, *] passes per layer:
+#
+#   K1 (node-block grid): one pass over v computing the min/max tie
+#      counts [N, 2H] — the per-edge extremum values arrive via a
+#      one-hot MXU matmul against the node-blocked `both` array, so the
+#      tie masks never touch HBM.
+#   K2 (edge-chunk grid): one pass over v emitting the COMPLETE grad_v
+#      — all six node-level tables (g_sum, g_sumsq, both, shares) are
+#      stacked into one [N, 6H] table and gathered per chunk with a
+#      single windowed one-hot matmul (the bcast kernel's window plan),
+#      then combined in VMEM:
+#        grad = m * (g_sum_e + 2 v g_sumsq_e
+#                    + (v == max_e) shmax_e - ((-v) == negmin_e) shmin_e)
+#
+# Exactness of the tie compares: one-hot x bf16 products are exact and
+# each output row accumulates exactly one nonzero product in f32, so the
+# gathered extremum is a bit-exact row copy and `v == max_e` matches the
+# unfused semantics. Masked edges carry vv = -inf in K1 (never tie in a
+# real segment) and are zeroed by the final m factor in K2; `both` is
+# empty-cleaned to 0 before the backward, so empty segments tie nothing.
+#
+# The float-weight-mask case (m^2 factor on the sumsq term) and
+# non-kernel contexts fall back to an unfused composition of the same
+# formulas.
+
+
+def _pna_bwd_count_kernel(block_ptr_ref, v_hbm, recv_hbm, mask_hbm, both_ref,
+                          cnt_ref, v_vmem, recv_vmem, mask_vmem, sems):
+    """K1: per node block, count min/max ties over the block's edges."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    i = pl.program_id(0)
+    lo = block_ptr_ref[i]
+    hi = block_ptr_ref[i + 1]
+    cnt_ref[:] = jnp.zeros_like(cnt_ref)
+    k0 = lo // CE
+    k1 = (hi + CE - 1) // CE
+    has_mask = mask_hbm is not None
+
+    def dmas(slot, k):
+        start = pl.multiple_of(k * CE, CE)
+        cps = [
+            pltpu.make_async_copy(
+                v_hbm.at[pl.ds(start, CE), :], v_vmem.at[slot], sems.at[slot, 0]
+            ),
+            pltpu.make_async_copy(
+                recv_hbm.at[:, pl.ds(start, CE)], recv_vmem.at[slot], sems.at[slot, 1]
+            ),
+        ]
+        if has_mask:
+            cps.append(
+                pltpu.make_async_copy(
+                    mask_hbm.at[:, pl.ds(start, CE)], mask_vmem.at[slot],
+                    sems.at[slot, 2],
+                )
+            )
+        return cps
+
+    @pl.when(k0 < k1)
+    def _warmup():
+        for cp in dmas(k0 % 2, k0):
+            cp.start()
+
+    def chunk_body(k, _):
+        slot = k % 2
+
+        @pl.when(k + 1 < k1)
+        def _prefetch():
+            for cp in dmas((k + 1) % 2, k + 1):
+                cp.start()
+
+        for cp in dmas(slot, k):
+            cp.wait()
+        v = v_vmem[slot]
+        # tie detection runs in f32 regardless of data dtype (the v5e
+        # VPU has no bf16 compare): bf16 -> f32 is exact, and the
+        # gathered extremum rows are f32 accumulations of exact values
+        neg = float(jnp.finfo(v.dtype).min)
+        vv = jnp.concatenate([v, -v], axis=-1).astype(jnp.float32)  # [CE, 2H]
+        if has_mask:
+            # arithmetic masking (avoids broadcasting a 1-bit vector):
+            # unmasked rows keep their value, masked rows become the
+            # forward's where(mask, vv, finfo.min) sentinel
+            m = (mask_vmem[slot][0, :][:, None] > 0).astype(jnp.float32)
+            vv = jnp.maximum(vv * m + (1.0 - m) * neg, neg)
+        rows = jax.lax.broadcasted_iota(jnp.int32, (BN, CE), 0) + i * BN
+        onehot = recv_vmem[slot] == rows  # [BN, CE]
+        # per-edge extremum rows via one-hot matmul against the node
+        # block: exact row copies — native bf16 for bf16 data (0/1
+        # products exact, single nonzero per row, f32 accumulation),
+        # HIGHEST for f32 (the 3x-bf16 split times exact 1.0
+        # reconstructs exactly)
+        if v.dtype == jnp.bfloat16:
+            oh = onehot.astype(jnp.bfloat16)
+            both_e = jax.lax.dot_general(
+                oh, both_ref[:], (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            sel = (vv == both_e).astype(jnp.bfloat16)
+            cnt_ref[:] += jax.lax.dot_general(
+                oh, sel, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+        else:
+            oh = onehot.astype(jnp.float32)
+            both_e = jax.lax.dot_general(
+                oh, both_ref[:].astype(jnp.float32), (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST,
+            )
+            sel = (vv == both_e).astype(jnp.float32)
+            cnt_ref[:] += jax.lax.dot_general(
+                oh, sel, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST,
+            )
+        return 0
+
+    jax.lax.fori_loop(k0, k1, chunk_body, 0)
+
+
+def _pna_bwd_grad_kernel(scal_ref, table_hbm, recv_ref, v_ref, mask_ref,
+                         grad_ref, win_vmem, acc_ref, sems):
+    """K2: per edge chunk, gather the stacked [N, 6H] cotangent table
+    (shared window plan/loop — :func:`_window_gather_acc`) and emit the
+    complete grad_v chunk."""
+    _window_gather_acc(scal_ref, table_hbm, recv_ref, win_vmem, acc_ref, sems)
+
+    v = v_ref[:]
+    h = v.shape[1]
+    # combine in f32: the acc rows are exact copies of the (possibly
+    # bf16) table values, v upcasts exactly, and the v5e VPU has no
+    # bf16 compare anyway — only the final grad casts back
+    vf = v.astype(jnp.float32)
+    g = acc_ref[:]  # [CE, 6H] f32
+    gs, gss = g[:, :h], g[:, h : 2 * h]
+    mx, mnn = g[:, 2 * h : 3 * h], g[:, 3 * h : 4 * h]
+    shx, shn = g[:, 4 * h : 5 * h], g[:, 5 * h :]
+    grad = gs + 2.0 * vf * gss
+    grad = grad + jnp.where(vf == mx, shx, 0.0)
+    grad = grad - jnp.where(-vf == mnn, shn, 0.0)
+    if mask_ref is not None:
+        m = (mask_ref[0, :] > 0).astype(jnp.float32)
+        # bool-mask semantics: m == m^2, one factor gates everything
+        grad = grad * m[:, None]
+    grad_ref[:] = grad.astype(grad_ref.dtype)
+
+
+def _pna_bwd_kernels(v, receivers, mask, both, g_sum, g_sumsq, g_both,
+                     num_segments, interpret):
+    """Shard-local fused backward: K1 tie counts, node-level shares,
+    K2 full grad. Requires sorted receivers, H % 128 == 0, bool mask."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    e, h = v.shape
+    vd = v.dtype
+    n_pad_out = ((num_segments + BN - 1) // BN) * BN
+    e_pad = ((e + CE - 1) // CE) * CE
+    recv = jnp.concatenate(
+        [receivers.astype(jnp.int32), jnp.full((e_pad - e,), n_pad_out, jnp.int32)]
+    )
+    v_p = jnp.concatenate([v, jnp.zeros((e_pad - e, h), vd)], axis=0)
+    if mask is not None:
+        mask_i = jnp.concatenate(
+            [mask.astype(jnp.int32), jnp.zeros((e_pad - e,), jnp.int32)]
+        )
+    else:
+        mask_i = None
+
+    # ---- K1: tie counts [n_pad_out, 2H] ----
+    both_p = jnp.concatenate(
+        [both, jnp.zeros((n_pad_out - num_segments, 2 * h), both.dtype)], axis=0
+    )
+    n_blocks = n_pad_out // BN
+    boundaries = jnp.arange(n_blocks + 1, dtype=jnp.int32) * BN
+    block_ptr = jnp.searchsorted(recv[:e], boundaries, side="left").astype(jnp.int32)
+    in_specs = [
+        pl.BlockSpec(memory_space=pl.ANY),  # v
+        pl.BlockSpec(memory_space=pl.ANY),  # recv
+    ]
+    operands = [v_p, recv[None, :]]
+    if mask_i is not None:
+        in_specs.append(pl.BlockSpec(memory_space=pl.ANY))
+        operands.append(mask_i[None, :])
+    in_specs.append(pl.BlockSpec((BN, 2 * h), lambda i, ptr: (i, 0)))  # both
+    operands.append(both_p)
+
+    def k1_kernel(*args):
+        if mask_i is not None:
+            ptr, vh, rh, mh, bh, cnt, vv, rv, mv, sems = args
+            _pna_bwd_count_kernel(ptr, vh, rh, mh, bh, cnt, vv, rv, mv, sems)
+        else:
+            ptr, vh, rh, bh, cnt, vv, rv, sems = args
+            _pna_bwd_count_kernel(ptr, vh, rh, None, bh, cnt, vv, rv, None, sems)
+
+    scratch = [
+        pltpu.VMEM((2, CE, h), vd),
+        pltpu.VMEM((2, 1, CE), jnp.int32),
+    ]
+    if mask_i is not None:
+        scratch.append(pltpu.VMEM((2, 1, CE), jnp.int32))
+    scratch.append(pltpu.SemaphoreType.DMA((2, 3)))
+    cnt_both = pl.pallas_call(
+        k1_kernel,
+        out_shape=jax.ShapeDtypeStruct((n_pad_out, 2 * h), jnp.float32),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(n_blocks,),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec((BN, 2 * h), lambda i, ptr: (i, 0)),
+            scratch_shapes=scratch,
+        ),
+        interpret=interpret,
+    )(block_ptr, *operands)[:num_segments]
+
+    # ---- node-level shares, stacked table ----
+    share = (g_both.astype(jnp.float32) / jnp.maximum(cnt_both, 1.0)).astype(vd)
+    table = jnp.concatenate(
+        [g_sum.astype(vd), g_sumsq.astype(vd), both.astype(vd), share], axis=-1
+    )  # [num_segments, 6H]
+
+    # ---- K2: full grad via the bcast window plan over the 6H table ----
+    n = table.shape[0]
+    n_pad_t = max(((n + ALIGN - 1) // ALIGN) * ALIGN, BW)
+    table_p = jnp.concatenate(
+        [table, jnp.zeros((n_pad_t - n, 6 * h), vd)], axis=0
+    )
+    recv_t = jnp.where(recv >= n, n_pad_t, recv)  # sentinels beyond windows
+    n_chunks = e_pad // CE
+    scal = _window_plan(recv_t, e, n_pad_t, n_chunks)
+
+    in_specs2 = [
+        pl.BlockSpec(memory_space=pl.ANY),  # table
+        pl.BlockSpec((1, CE), lambda k, ptr: (0, k)),  # recv
+        pl.BlockSpec((CE, h), lambda k, ptr: (k, 0)),  # v
+    ]
+    operands2 = [table_p, recv_t[None, :], v_p]
+    if mask_i is not None:
+        in_specs2.append(pl.BlockSpec((1, CE), lambda k, ptr: (0, k)))
+        operands2.append(mask_i[None, :])
+
+    def k2_kernel(*args):
+        if mask_i is not None:
+            scal_r, th, rr, vr, mr, gr, wv, ac, sems = args
+            _pna_bwd_grad_kernel(scal_r, th, rr, vr, mr, gr, wv, ac, sems)
+        else:
+            scal_r, th, rr, vr, gr, wv, ac, sems = args
+            _pna_bwd_grad_kernel(scal_r, th, rr, vr, None, gr, wv, ac, sems)
+
+    grad = pl.pallas_call(
+        k2_kernel,
+        out_shape=jax.ShapeDtypeStruct((e_pad, h), vd),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(n_chunks,),
+            in_specs=in_specs2,
+            out_specs=pl.BlockSpec((CE, h), lambda k, ptr: (k, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((2, BW, 6 * h), vd),
+                pltpu.VMEM((CE, 6 * h), jnp.float32),
+                pltpu.SemaphoreType.DMA((2,)),
+            ],
+        ),
+        interpret=interpret,
+    )(scal, *operands2)
+    return grad[:e]
+
+
+def _pna_bwd_unfused(v, receivers, mask, both, g_sum, g_sumsq, g_both,
+                     num_segments, indices_are_sorted):
+    """Reference composition of the same backward (CPU / vmap / float
+    masks): identical math, built from the dispatching building blocks."""
+    vd = v.dtype
+    h = v.shape[1]
+    neg = jnp.finfo(vd).min
+    vv = jnp.concatenate([v, -v], axis=-1)
+    if mask is not None:
+        vv = jnp.where(mask[:, None], vv, neg)
+    from hydragnn_tpu.graph.segment import _gather_fwd_impl
+
+    both_e = _gather_fwd_impl(both.astype(vd), receivers, indices_are_sorted)
+    sel = vv == both_e
+    cnt_both = segment_sum_fast(
+        sel.astype(vd), receivers, num_segments,
+        indices_are_sorted=indices_are_sorted,
+    ).astype(jnp.float32)
+    share = (g_both.astype(jnp.float32) / jnp.maximum(cnt_both, 1.0)).astype(vd)
+    gpack = _gather_fwd_impl(
+        jnp.concatenate([g_sum.astype(vd), g_sumsq.astype(vd), share], axis=-1),
+        receivers, indices_are_sorted,
+    )
+    gs, gss, sh = gpack[:, :h], gpack[:, h : 2 * h], gpack[:, 2 * h :]
+    ties = jnp.where(sel, sh, vd.type(0))
+    tie_term = ties[:, :h] - ties[:, h:]
+    if mask is None:
+        grad = gs + 2.0 * v * gss + tie_term
+    elif jnp.issubdtype(mask.dtype, jnp.floating):
+        # float masks WEIGHT the sums (m on sum, m^2 on sumsq — the
+        # family's weighted closed form) but only GATE the extremum
+        # (the forward's where(mask, vv, -inf) is a boolean gate)
+        m = mask.astype(vd)[:, None]
+        mb = (mask != 0).astype(vd)[:, None]
+        grad = m * gs + m * m * 2.0 * v * gss + mb * tie_term
+    else:
+        m = mask.astype(vd)[:, None]
+        grad = m * (gs + 2.0 * v * gss + tie_term)
+    return grad.astype(vd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 4))
+def _pna_aggregate(v, receivers, num_segments, mask, indices_are_sorted):
+    s, sq, cnt = _family_impl(
+        v, receivers, num_segments, mask, indices_are_sorted,
+        _use_pallas(v, indices_are_sorted),
+    )
+    vd = v.dtype
+    neg = jnp.finfo(vd).min
+    vv = jnp.concatenate([v, -v], axis=-1)
+    if mask is not None:
+        vv = jnp.where(mask[:, None], vv, neg)
+    raw = jax.ops.segment_max(
+        vv, receivers, num_segments, indices_are_sorted=indices_are_sorted
+    )
+    both = jnp.where(raw <= neg, vd.type(0), raw)  # empty-cleaned
+    return s, sq, cnt, both
+
+
+def _pna_aggregate_fwd(v, receivers, num_segments, mask, indices_are_sorted):
+    out = _pna_aggregate(v, receivers, num_segments, mask, indices_are_sorted)
+    return out, (v, receivers, mask, out[3])
+
+
+def _pna_aggregate_bwd(num_segments, indices_are_sorted, res, g):
+    v, receivers, mask, both = res
+    g_sum, g_sumsq, _, g_both = g  # count is data-independent
+    float_mask = mask is not None and jnp.issubdtype(mask.dtype, jnp.floating)
+    if (
+        indices_are_sorted
+        and v.ndim == 2
+        and v.shape[1] % 128 == 0
+        and not float_mask
+        and _kernel_eligible(indices_are_sorted)
+    ):
+        grad = _pna_bwd_kernels(
+            v, receivers, mask, both.astype(v.dtype), g_sum, g_sumsq, g_both,
+            num_segments, _interpret_mode(),
+        )
+    else:
+        grad = _pna_bwd_unfused(
+            v, receivers, mask, both.astype(v.dtype), g_sum, g_sumsq, g_both,
+            num_segments, indices_are_sorted,
+        )
+    ids_zero = jnp.zeros(receivers.shape, dtype=jax.dtypes.float0)
+    if mask is None:
+        mask_zero = None
+    elif jnp.issubdtype(mask.dtype, jnp.floating):
+        mask_zero = jnp.zeros(mask.shape, dtype=mask.dtype)
+    else:
+        mask_zero = jnp.zeros(mask.shape, dtype=jax.dtypes.float0)
+    return grad, ids_zero, mask_zero
+
+
+_pna_aggregate.defvjp(_pna_aggregate_fwd, _pna_aggregate_bwd)
+
+
+def pna_aggregate(
+    v: jnp.ndarray,
+    receivers: jnp.ndarray,
+    num_segments: int,
+    mask: Optional[jnp.ndarray] = None,
+    indices_are_sorted: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Fused PNA aggregation statistics of ``v`` grouped by receiver.
+
+    Returns ``(vsum f32, vsumsq f32, cnt f32, both)`` where
+    ``both[:, :H] = segment_max(v)`` and ``both[:, H:] =
+    segment_max(-v)`` (= -min), masked, with EMPTY segments already
+    cleaned to 0; ``cnt`` is the mask-aware per-segment edge count the
+    family pass computes anyway (data-independent cotangent — callers
+    with a precomputed degree can ignore it and XLA dead-code
+    eliminates it). The backward is the two-kernel fused pass
+    documented above (falls back to an unfused composition off-TPU /
+    under vmap / for float masks). The mask is non-differentiable by
+    contract. Narrow data is lane-padded into the kernels
+    (:func:`_lane_pad`) and the outputs sliced back."""
+    if mask is not None:
+        mask = jax.lax.stop_gradient(mask)
+    h = _narrow_kernel_width(v, indices_are_sorted)
+    if h is not None:
+        s, sq, cnt, both = _pna_aggregate(
+            _lane_pad(v), receivers, num_segments, mask, indices_are_sorted
+        )
+        hp = (h + 127) // 128 * 128
+        both = jnp.concatenate([both[:, :h], both[:, hp : hp + h]], axis=-1)
+        return s[:, :h], sq[:, :h], cnt, both
+    return _pna_aggregate(v, receivers, num_segments, mask, indices_are_sorted)
 
 
 def segment_sum_family(

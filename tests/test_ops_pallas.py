@@ -486,3 +486,104 @@ def pytest_bcast_gather_edge_sharded_mesh(monkeypatch):
     monkeypatch.setenv("HYDRAGNN_PALLAS", "interpret")
     out = jax.jit(gather_rows_sorted_fast)(table_s, ids_s)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(table[ids]))
+
+
+def _pna_reference(v, recv, n, mask):
+    """Composed reference for pna_aggregate from the plain building
+    blocks (the pre-fusion formulation)."""
+    from hydragnn_tpu.graph import segment as S
+    from hydragnn_tpu.ops import segment_sum_family
+
+    s, sq, cnt = segment_sum_family(v, recv, n, mask=mask, indices_are_sorted=True)
+    mx = S.segment_max(v, recv, n, mask=mask, indices_are_sorted=True)
+    mn = S.segment_min(v, recv, n, mask=mask, indices_are_sorted=True)
+    return s, sq, cnt, mx, mn
+
+
+def pytest_pna_aggregate_matches_composed(monkeypatch):
+    """pna_aggregate forward AND gradient must match the composed
+    segment ops — f32/bf16, with/without mask, deliberate ties, both
+    the unfused (HYDRAGNN_PALLAS=0) and kernel (interpret) backwards."""
+    rng = np.random.default_rng(37)
+    e, h, n = 1200, 128, 90
+    recv = jnp.asarray(np.sort(rng.integers(0, n, e)).astype(np.int32))
+    base = rng.normal(size=(e, h)).astype(np.float32)
+    # deliberate ties: quantize so segments share extrema
+    base = np.round(base * 4) / 4
+    mask_b = jnp.asarray(rng.random(e) > 0.2)
+
+    from hydragnn_tpu.ops import pna_aggregate
+
+    for dtype in (jnp.float32, jnp.bfloat16):
+        v0 = jnp.asarray(base).astype(dtype)
+        for mask in (None, mask_b):
+            def loss_f(v, agg):
+                s, sq, cnt, both = agg(v)
+                mx, mn = both[:, :h], -both[:, h:]
+                return (
+                    (s * s).sum() + sq.sum()
+                    + (mx.astype(jnp.float32) * 2.0).sum()
+                    + (mn.astype(jnp.float32) * 3.0).sum()
+                )
+
+            def agg_fused(v, _mask=mask):
+                return pna_aggregate(v, recv, n, mask=_mask, indices_are_sorted=True)
+
+            def agg_ref(v, _mask=mask):
+                s, sq, cnt, mx, mn = _pna_reference(v, recv, n, _mask)
+                return s, sq, cnt, jnp.concatenate([mx, -mn], axis=-1)
+
+            for knob in ("0", "interpret"):
+                monkeypatch.setenv("HYDRAGNN_PALLAS", knob)
+                out_f = jax.jit(lambda v: agg_fused(v))(v0)
+                monkeypatch.setenv("HYDRAGNN_PALLAS", "0")
+                out_r = jax.jit(lambda v: agg_ref(v))(v0)
+                np.testing.assert_allclose(
+                    np.asarray(out_f[2]), np.asarray(out_r[2]), rtol=1e-6,
+                    err_msg=f"cnt {dtype} mask={mask is not None} {knob}",
+                )
+                for a, b, name in zip(out_f[:2], out_r[:2], ("sum", "sumsq")):
+                    np.testing.assert_allclose(
+                        np.asarray(a), np.asarray(b), rtol=2e-2, atol=2e-2,
+                        err_msg=f"{name} {dtype} mask={mask is not None} {knob}",
+                    )
+                np.testing.assert_array_equal(
+                    np.asarray(out_f[3]), np.asarray(out_r[3]),
+                    err_msg=f"both {dtype} mask={mask is not None} {knob}",
+                )
+
+                monkeypatch.setenv("HYDRAGNN_PALLAS", knob)
+                g_f = jax.jit(jax.grad(lambda v: loss_f(v, agg_fused)))(v0)
+                monkeypatch.setenv("HYDRAGNN_PALLAS", "0")
+                g_r = jax.jit(jax.grad(lambda v: loss_f(v, agg_ref)))(v0)
+                np.testing.assert_allclose(
+                    np.asarray(g_f, np.float32), np.asarray(g_r, np.float32),
+                    rtol=2e-2, atol=2e-2,
+                    err_msg=f"grad {dtype} mask={mask is not None} {knob}",
+                )
+
+
+def pytest_pna_aggregate_narrow_width_lane_pads(monkeypatch):
+    """conv_0-shaped narrow widths must lane-pad through the fused op
+    (kernel backward in interpret mode) and match the unfused path."""
+    rng = np.random.default_rng(41)
+    e, h, n = 900, 24, 70
+    recv = jnp.asarray(np.sort(rng.integers(0, n, e)).astype(np.int32))
+    v0 = jnp.asarray(np.round(rng.normal(size=(e, h)) * 4) / 4, dtype=jnp.float32)
+    mask = jnp.asarray(rng.random(e) > 0.25)
+
+    from hydragnn_tpu.ops import pna_aggregate
+
+    def loss(v):
+        s, sq, cnt, both = pna_aggregate(v, recv, n, mask=mask, indices_are_sorted=True)
+        return (s * s).sum() + sq.sum() + both.sum() * 2.0 + cnt.sum()
+
+    monkeypatch.setenv("HYDRAGNN_PALLAS", "0")
+    ref_out = jax.jit(lambda v: pna_aggregate(v, recv, n, mask=mask, indices_are_sorted=True))(v0)
+    ref_g = jax.jit(jax.grad(loss))(v0)
+    monkeypatch.setenv("HYDRAGNN_PALLAS", "interpret")
+    k_out = jax.jit(lambda v: pna_aggregate(v, recv, n, mask=mask, indices_are_sorted=True))(v0)
+    k_g = jax.jit(jax.grad(loss))(v0)
+    for a, b in zip(k_out, ref_out):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(k_g), np.asarray(ref_g), rtol=1e-5, atol=1e-5)
