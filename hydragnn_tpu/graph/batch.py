@@ -63,6 +63,17 @@ class GraphBatch:
     pos: Optional[jnp.ndarray] = None
     graph_targets: Dict[str, jnp.ndarray] = dataclasses.field(default_factory=dict)
     node_targets: Dict[str, jnp.ndarray] = dataclasses.field(default_factory=dict)
+    # Dense per-node edge-slot map (host-emitted, free: receivers are
+    # already receiver-major sorted so node n's edges are contiguous).
+    # Lets aggregations run as DENSE [N, D, H] reshape reductions — one
+    # fused XLA pass forward, pure broadcasts backward — instead of
+    # scatter/segment ops (XLA's TPU scatter-extremum is row-bound:
+    # ~7-9 ms per pass at E=699k, docs/PERF.md r03). D is the dataset
+    # max in-degree (static across batches); padding slots carry
+    # mask=False and point at a padding edge/node.
+    dense_senders: Optional[jnp.ndarray] = None  # [N, D] int32
+    dense_mask: Optional[jnp.ndarray] = None  # [N, D] bool
+    dense_edge_attr: Optional[jnp.ndarray] = None  # [N, D, De]
 
     @property
     def num_nodes(self) -> int:
@@ -87,6 +98,7 @@ def batch_graphs(
     n_graph_pad: Optional[int] = None,
     node_multiple: int = 16,
     edge_multiple: int = 8,
+    dense_slots: Optional[int] = None,
 ) -> GraphBatch:
     """Concatenate a list of single graphs and pad to static shapes.
 
@@ -213,6 +225,30 @@ def batch_graphs(
         if has_edge_attr:
             edge_attr = edge_attr[perm]
 
+    dense_senders = dense_mask = dense_edge_attr = None
+    if dense_slots is not None and dense_slots > 0:
+        # receiver-major sorted + only padding edges masked (targeting a
+        # padding node), so node n's real edges occupy the contiguous
+        # range [row_ptr[n], row_ptr[n] + deg[n])
+        deg = np.bincount(receivers[edge_mask], minlength=n_node_pad)
+        dmax = int(deg.max(initial=0))
+        if dmax > dense_slots:
+            raise ValueError(
+                f"dense_slots={dense_slots} < batch max in-degree {dmax}"
+            )
+        row_ptr = np.zeros(n_node_pad, dtype=np.int64)
+        row_ptr[1:] = np.cumsum(deg)[:-1]
+        slot = np.arange(dense_slots, dtype=np.int64)[None, :]
+        dense_mask = slot < deg[:, None]
+        # host-side slot->edge positions (a local temporary: consumers
+        # only ever need the gathered senders / edge features)
+        dense_edge_pos = np.where(
+            dense_mask, row_ptr[:, None] + slot, n_edge_pad - 1
+        ).astype(np.int32)
+        dense_senders = senders[dense_edge_pos]
+        if has_edge_attr:
+            dense_edge_attr = edge_attr[dense_edge_pos]
+
     return GraphBatch(
         nodes=jnp.asarray(nodes),
         senders=jnp.asarray(senders),
@@ -227,6 +263,9 @@ def batch_graphs(
         pos=jnp.asarray(pos) if pos is not None else None,
         graph_targets={k: jnp.asarray(v) for k, v in graph_targets.items()},
         node_targets={k: jnp.asarray(v) for k, v in n_targets.items()},
+        dense_senders=jnp.asarray(dense_senders) if dense_senders is not None else None,
+        dense_mask=jnp.asarray(dense_mask) if dense_mask is not None else None,
+        dense_edge_attr=jnp.asarray(dense_edge_attr) if dense_edge_attr is not None else None,
     )
 
 
@@ -275,6 +314,11 @@ def pad_batch(batch: GraphBatch, n_node: int, n_edge: int, n_graph: int) -> Grap
         pos=pad0(batch.pos, dn),
         graph_targets={k: pad0(v, dg) for k, v in batch.graph_targets.items()},
         node_targets={k: pad0(v, dn) for k, v in batch.node_targets.items()},
+        # new dense rows are all-padding slots: mask False, senders at a
+        # padding node, positions at the (old) last edge slot
+        dense_senders=pad0(batch.dense_senders, dn, pad_node_id),
+        dense_mask=pad0(batch.dense_mask, dn, False),
+        dense_edge_attr=pad0(batch.dense_edge_attr, dn),
     )
 
 

@@ -259,6 +259,18 @@ class HydraModel(nn.Module):
             # beats XLA's unsorted scatter-add ~2x at flagship shapes
             sender_perm=jnp.argsort(batch.senders),
             in_degree=C.sorted_in_degree(batch.receivers, batch.num_nodes),
+            dense_senders=batch.dense_senders,
+            dense_mask=batch.dense_mask,
+            dense_edge_attr=(
+                batch.dense_edge_attr.reshape(-1, batch.dense_edge_attr.shape[-1])
+                if batch.dense_edge_attr is not None
+                else None
+            ),
+            dense_sender_perm=(
+                jnp.argsort(batch.dense_senders.reshape(-1))
+                if batch.dense_senders is not None
+                else None
+            ),
         )
 
     def _apply_conv(self, conv, x, ctx, train: bool):

@@ -51,6 +51,16 @@ class EdgeContext:
     # scalers/has, MFC dispatch) read this instead of paying the [E,1]
     # count scatter XLA otherwise emits (~6 ms at E=699k, r03 trace).
     in_degree: Optional[jnp.ndarray] = None  # [N] float32
+    # dense per-node edge-slot map (loader-emitted — graph/batch.py):
+    # lets PNA run its aggregations as DENSE [N, D, H] reductions (one
+    # fused XLA pass fwd, broadcasts bwd) instead of scatter/segment
+    # ops. dense_edge_attr is FLAT [N*D, De]; dense_sender_perm is
+    # argsort of the flattened dense senders, computed once per step by
+    # the chassis for the sender-gather backward (like sender_perm).
+    dense_senders: Optional[jnp.ndarray] = None  # [N, D] int32
+    dense_mask: Optional[jnp.ndarray] = None  # [N, D] bool
+    dense_edge_attr: Optional[jnp.ndarray] = None  # [N*D, De]
+    dense_sender_perm: Optional[jnp.ndarray] = None  # [N*D] int32
 
 
 def sorted_in_degree(receivers: jnp.ndarray, num_nodes: int) -> jnp.ndarray:
@@ -281,33 +291,70 @@ class PNAConv(nn.Module):
         a = x @ w[:fin] + b_pre.astype(x.dtype)  # receiver part [N, fin]
         bsend = x @ w[fin : 2 * fin]  # sender part [N, fin]
 
-        # the ONLY edge-width intermediate: v_e = bsend[send_e] (+ edge
-        # term). The sender gather's backward is a sorted segment sum via
-        # the chassis-provided argsort (convs._gather_senders).
-        v = _gather_senders(bsend, ctx)
-        if use_edge:
-            v = v + nn.Dense(fin)(ctx.edge_attr) @ w[2 * fin :]
-
-        # ONE fused aggregation op: sum + sumsq (family kernel) and the
-        # [v,-v] scatter-max forward, with the two-kernel fused backward
-        # that emits the complete grad_v in a single pass
-        # (hydragnn_tpu/ops/segment_pallas.py:pna_aggregate).
-        # indices_are_sorted: the data pipeline emits edges receiver-major
-        # sorted (data/radius_graph.py:_cap_and_sort; batch_graphs keeps
-        # per-graph order under increasing node offsets), which also
-        # enables the Pallas CSR kernels on TPU.
-        from hydragnn_tpu.ops import pna_aggregate
-
-        vsum, vsumsq, cnt, both = pna_aggregate(
-            v, ctx.receivers, n, mask=ctx.edge_mask, indices_are_sorted=True
+        # DENSE path (loader-emitted slot map): aggregations become
+        # [N, D, fin] reshape reductions — one fused XLA pass forward,
+        # pure broadcasts backward — skipping every scatter/segment op
+        # (XLA's TPU scatter-extremum alone is ~7-9 ms per pass at
+        # E=699k; docs/PERF.md r03). The sender gather and its
+        # permuted-CSR backward are unchanged in structure.
+        dense = ctx.dense_senders is not None and (
+            not use_edge or ctx.dense_edge_attr is not None
         )
-        if ctx.in_degree is not None:
-            # chassis-precomputed degree (searchsorted over the sorted
-            # receivers): the aggregate's own count scatter then has no
-            # consumer and XLA dead-code-eliminates it
-            cnt = ctx.in_degree
-        # mean/var formed in f32 (the family op accumulates f32); cast
-        # back to the compute dtype only after the cancellation
+        if dense:
+            nslots = ctx.dense_senders.shape[1]
+            flat = ctx.dense_senders.reshape(-1)
+            v = S.gather_rows_permuted(bsend, flat, ctx.dense_sender_perm, n)
+            if use_edge:
+                v = v + nn.Dense(fin)(ctx.dense_edge_attr) @ w[2 * fin :]
+            v3 = v.reshape(n, nslots, fin)
+            m3 = ctx.dense_mask[:, :, None]
+            # one fused read of v3 computes sum, sumsq, max and min —
+            # accumulation in f32 like the family kernel contract
+            vf = jnp.where(m3, v3, 0).astype(jnp.float32)
+            vsum = vf.sum(axis=1)
+            vsumsq = (vf * vf).sum(axis=1)
+            neg = jnp.finfo(v.dtype).min
+            vmax = jnp.where(m3, v3, neg).max(axis=1)
+            vmin = jnp.where(m3, v3, -neg).min(axis=1)
+            cnt = (
+                ctx.in_degree
+                if ctx.in_degree is not None
+                else ctx.dense_mask.sum(axis=1).astype(jnp.float32)
+            )
+            # empty-clean from the fill value itself (like the CSR
+            # path's both-cleanup): cnt/in_degree counts the padding
+            # NODE's masked edges by design, so it cannot be the gate
+            max_v = jnp.where(vmax <= neg, 0, vmax).astype(v.dtype)
+            min_v = jnp.where(vmin >= -neg, 0, vmin).astype(v.dtype)
+        else:
+            # CSR path: the ONLY edge-width intermediate is v_e =
+            # bsend[send_e] (+ edge term); the sender gather's backward
+            # is a sorted segment sum via the chassis-provided argsort.
+            # Aggregation is ONE fused op: sum + sumsq (family kernel)
+            # and the [v,-v] scatter-max forward, with the two-kernel
+            # fused backward emitting the complete grad_v in one pass
+            # (hydragnn_tpu/ops/segment_pallas.py:pna_aggregate).
+            # indices_are_sorted: the data pipeline emits edges
+            # receiver-major sorted (data/radius_graph.py:_cap_and_sort;
+            # batch_graphs canonicalizes), which also enables the Pallas
+            # CSR kernels on TPU.
+            from hydragnn_tpu.ops import pna_aggregate
+
+            v = _gather_senders(bsend, ctx)
+            if use_edge:
+                v = v + nn.Dense(fin)(ctx.edge_attr) @ w[2 * fin :]
+            vsum, vsumsq, cnt, both = pna_aggregate(
+                v, ctx.receivers, n, mask=ctx.edge_mask, indices_are_sorted=True
+            )
+            if ctx.in_degree is not None:
+                # chassis-precomputed degree (searchsorted over the
+                # sorted receivers): the aggregate's own count scatter
+                # then has no consumer and XLA dead-code-eliminates it
+                cnt = ctx.in_degree
+            max_v = both[:, :fin]
+            min_v = -both[:, fin:]
+        # mean/var formed in f32 (both paths accumulate f32); cast back
+        # to the compute dtype only after the cancellation
         safe_cnt = jnp.maximum(cnt, 1.0)[:, None]
         has = (cnt > 0.0)[:, None]
         mean_v = vsum / safe_cnt
@@ -318,8 +365,6 @@ class PNAConv(nn.Module):
         var = jax.nn.relu(vsumsq / safe_cnt - mean_v * mean_v)
         std = jnp.sqrt(var + 1e-5)
         has_c = has.astype(v.dtype)
-        max_v = both[:, : v.shape[1]]
-        min_v = -both[:, v.shape[1] :]
         aggs = [
             mean.astype(v.dtype),
             (a + min_v) * has_c,

@@ -89,6 +89,26 @@ def xla_segment_ops():
         _FORCE_XLA.reset(tok)
 
 
+def _vma_of(*arrays) -> frozenset:
+    """Union of the manual-mesh axes the given arrays vary over (empty
+    outside shard_map)."""
+    out: frozenset = frozenset()
+    for a in arrays:
+        out = out | frozenset(getattr(jax.typeof(a), "vma", frozenset()))
+    return out
+
+
+def _match_vma(x, vma: frozenset):
+    """Promote ``x`` to vary over ``vma`` (jax.lax.pvary) — constructed
+    operands (zero padding, window plans) otherwise arrive non-varying
+    inside shard_map with check_vma=True and fail the interpreter's
+    per-operand vma match."""
+    need = vma - frozenset(getattr(jax.typeof(x), "vma", frozenset()))
+    if need:
+        return jax.lax.pvary(x, tuple(need))
+    return x
+
+
 def pallas_available() -> bool:
     try:
         from jax.experimental import pallas as pl  # noqa: F401
@@ -280,10 +300,12 @@ def _csr_kernel_call(data, segment_ids, mask, num_segments, interpret, family):
     )
     n_out = 2 if family else 1
     # under shard_map with check_vma=True the out_shape must declare which
-    # manual mesh axes the result varies over — same set as the inputs
-    vma = frozenset(getattr(jax.typeof(data), "vma", frozenset())) | frozenset(
-        getattr(jax.typeof(recv), "vma", frozenset())
-    )
+    # manual mesh axes the result varies over, and every operand
+    # (including constructed padding/pointer arrays) must carry them
+    vma = _vma_of(data, recv)
+    data = _match_vma(data, vma)
+    recv = _match_vma(recv, vma)
+    block_ptr = _match_vma(block_ptr, vma)
     out_sds = jax.ShapeDtypeStruct((n_pad, h), jnp.float32, vma=vma)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
@@ -578,9 +600,10 @@ def _bcast_kernel_call(table, ids, interpret):
     )
     n_chunks = e_pad // CE
     scal = _window_plan(recv, e, n_pad, n_chunks)
-    vma = frozenset(getattr(jax.typeof(recv), "vma", frozenset())) | frozenset(
-        getattr(jax.typeof(table), "vma", frozenset())
-    )
+    vma = _vma_of(recv, table)
+    table = _match_vma(table, vma)
+    recv = _match_vma(recv, vma)
+    scal = _match_vma(scal, vma)
     out_sds = jax.ShapeDtypeStruct((e_pad, h), table.dtype, vma=vma)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
@@ -1056,9 +1079,15 @@ def _pna_bwd_kernels(v, receivers, mask, both, g_sum, g_sumsq, g_both,
     if mask_i is not None:
         scratch.append(pltpu.VMEM((2, 1, CE), jnp.int32))
     scratch.append(pltpu.SemaphoreType.DMA((2, 3)))
+    # under shard_map with check_vma=True the out_shape must declare
+    # which manual mesh axes the result varies over, and every operand
+    # must carry them (same as the family/bcast kernels)
+    vma = _vma_of(v_p, recv, both_p)
+    operands = [_match_vma(o, vma) for o in operands]
+    block_ptr = _match_vma(block_ptr, vma)
     cnt_both = pl.pallas_call(
         k1_kernel,
-        out_shape=jax.ShapeDtypeStruct((n_pad_out, 2 * h), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((n_pad_out, 2 * h), jnp.float32, vma=vma),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(n_blocks,),
@@ -1103,9 +1132,12 @@ def _pna_bwd_kernels(v, receivers, mask, both, g_sum, g_sumsq, g_both,
             scal_r, th, rr, vr, gr, wv, ac, sems = args
             _pna_bwd_grad_kernel(scal_r, th, rr, vr, None, gr, wv, ac, sems)
 
+    vma2 = vma | _vma_of(table_p)
+    operands2 = [_match_vma(o, vma2) for o in operands2]
+    scal = _match_vma(scal, vma2)
     grad = pl.pallas_call(
         k2_kernel,
-        out_shape=jax.ShapeDtypeStruct((e_pad, h), vd),
+        out_shape=jax.ShapeDtypeStruct((e_pad, h), vd, vma=vma2),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(n_chunks,),

@@ -411,3 +411,49 @@ def test_pna_decomposition_matches_message_form():
     g = jax.grad(lambda pp: (conv.apply(pp, x, ctx) ** 2).sum())(params)
     for leaf in jax.tree_util.tree_leaves(g):
         assert np.isfinite(np.asarray(leaf)).all()
+
+
+def pytest_pna_dense_slot_path_matches_csr():
+    """The loader-emitted dense slot map must produce the same PNA
+    forward AND gradients as the CSR segment path (same batch, dense
+    fields stripped)."""
+    import jax
+    import jax.numpy as jnp
+
+    from hydragnn_tpu.flagship import build_flagship
+    from hydragnn_tpu.train import create_train_state, select_optimizer
+    from hydragnn_tpu.train.state import _train_step_body
+
+    for edge_lengths in (False, True):
+        config, model, variables, loader = build_flagship(
+            n_samples=40, hidden_dim=16, num_conv_layers=2, batch_size=8,
+            unit_cells=(2, 3), edge_lengths=edge_lengths,
+        )
+        batch = next(iter(loader))
+        assert batch.dense_senders is not None  # loader emits by default
+        if edge_lengths:
+            assert batch.dense_edge_attr is not None
+        batch_csr = batch.replace(
+            dense_senders=None, dense_mask=None,
+            dense_edge_attr=None,
+        )
+        tx = select_optimizer(config["NeuralNetwork"]["Training"])
+        body = _train_step_body(model, tx)
+        state = create_train_state(variables, tx, seed=0)
+        _, loss_dense, _ = body(state, batch)
+        _, loss_csr, _ = body(state, batch_csr)
+        np.testing.assert_allclose(
+            float(loss_dense), float(loss_csr), rtol=1e-5,
+            err_msg=f"edge_lengths={edge_lengths}",
+        )
+        def loss_of(p, b):
+            return body(state.replace(params=p), b)[1]
+
+        g_dense = jax.grad(lambda p: loss_of(p, batch))(state.params)
+        g_csr = jax.grad(lambda p: loss_of(p, batch_csr))(state.params)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-5
+            ),
+            g_dense, g_csr,
+        )

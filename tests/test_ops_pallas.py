@@ -587,3 +587,43 @@ def pytest_pna_aggregate_narrow_width_lane_pads(monkeypatch):
     for a, b in zip(k_out, ref_out):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5)
     np.testing.assert_allclose(np.asarray(k_g), np.asarray(ref_g), rtol=1e-5, atol=1e-5)
+
+
+def pytest_pna_aggregate_grad_inside_shard_map(monkeypatch):
+    """pna_aggregate's fused backward must trace and match the XLA path
+    under jax.shard_map (the DP train-step context). check_vma=False
+    like every in-tree shard_map: interpret-mode pallas' internal grid
+    indexing is not vma-aware (hlo_interpreter dynamic_slice), so
+    check_vma=True only works with the compiled Mosaic kernels on a
+    real TPU — where the K1/K2 out_shapes now declare their vma and
+    operands are pvary-promoted like the sibling kernels."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from hydragnn_tpu.ops import pna_aggregate
+
+    rng = np.random.default_rng(43)
+    d_dev, e, h, n = 8, 512, 128, 40
+    data = np.round(rng.normal(size=(d_dev, e, h)) * 4).astype(np.float32) / 4
+    seg = np.sort(rng.integers(0, n, (d_dev, e)), axis=1).astype(np.int32)
+
+    mesh = Mesh(np.array(jax.devices()[:d_dev]), ("data",))
+    monkeypatch.setenv("HYDRAGNN_PALLAS", "interpret")
+
+    def local_loss(d, i):
+        s, sq, cnt, both = pna_aggregate(d[0], i[0], n, indices_are_sorted=True)
+        return ((s * s).sum() + sq.sum() + both.sum())[None]
+
+    def loss(d, i):
+        per = jax.shard_map(
+            local_loss, mesh=mesh, in_specs=(P("data"), P("data")),
+            out_specs=P("data"), check_vma=False,
+        )(d, i)
+        return per.sum()
+
+    g = jax.jit(jax.grad(loss))(jnp.asarray(data), jnp.asarray(seg))
+
+    monkeypatch.setenv("HYDRAGNN_PALLAS", "0")
+    g_ref = jax.jit(jax.grad(loss))(jnp.asarray(data), jnp.asarray(seg))
+    np.testing.assert_allclose(
+        np.asarray(g), np.asarray(g_ref), rtol=1e-5, atol=1e-5
+    )
