@@ -376,9 +376,22 @@ def _mask_out(batch: GraphBatch) -> GraphBatch:
         dense["dense_senders"] = _np.full_like(
             _np.asarray(batch.dense_senders), pad_slot
         )
+        if batch.dense_sender_perm is not None:
+            # all-equal senders: stable argsort is the identity
+            dense["dense_sender_perm"] = _np.arange(
+                batch.dense_senders.size, dtype=_np.int32
+            )
+    derived = {}
+    if batch.sender_perm is not None:
+        derived["sender_perm"] = _np.arange(batch.num_edges, dtype=_np.int32)
+    if batch.in_degree is not None:
+        deg = _np.zeros(batch.num_nodes, dtype=_np.float32)
+        deg[pad_slot] = batch.num_edges
+        derived["in_degree"] = deg
     return batch.replace(
         senders=_np.full_like(_np.asarray(batch.senders), pad_slot),
         receivers=_np.full_like(_np.asarray(batch.receivers), pad_slot),
+        **derived,
         node_mask=_np.zeros_like(_np.asarray(batch.node_mask)),
         edge_mask=_np.zeros_like(_np.asarray(batch.edge_mask)),
         graph_mask=_np.zeros_like(_np.asarray(batch.graph_mask)),

@@ -74,6 +74,16 @@ class GraphBatch:
     dense_senders: Optional[jnp.ndarray] = None  # [N, D] int32
     dense_mask: Optional[jnp.ndarray] = None  # [N, D] bool
     dense_edge_attr: Optional[jnp.ndarray] = None  # [N, D, De]
+    # Host-precomputed edge-structure derivatives, pure functions of
+    # senders/receivers. The model chassis (models/base.py:_conv_args)
+    # consumes these instead of recomputing argsort/searchsorted inside
+    # the jitted step every iteration — at flagship scale (E=699k) the
+    # in-step sorts are serial row-bound ops worth ~ms/step (r03 trace,
+    # docs/PERF.md). Batches built outside batch_graphs/pad_batch may
+    # leave them None; the chassis falls back to in-jit computation.
+    sender_perm: Optional[jnp.ndarray] = None  # [E] int32, stable argsort(senders)
+    in_degree: Optional[jnp.ndarray] = None  # [N] f32, edge count per receiver
+    dense_sender_perm: Optional[jnp.ndarray] = None  # [N*D] int32
 
     @property
     def num_nodes(self) -> int:
@@ -225,7 +235,7 @@ def batch_graphs(
         if has_edge_attr:
             edge_attr = edge_attr[perm]
 
-    dense_senders = dense_mask = dense_edge_attr = None
+    dense_senders = dense_mask = dense_edge_attr = dense_sender_perm = None
     if dense_slots is not None and dense_slots > 0:
         # receiver-major sorted + only padding edges masked (targeting a
         # padding node), so node n's real edges occupy the contiguous
@@ -248,6 +258,18 @@ def batch_graphs(
         dense_senders = senders[dense_edge_pos]
         if has_edge_attr:
             dense_edge_attr = edge_attr[dense_edge_pos]
+        dense_sender_perm = np.argsort(
+            dense_senders.reshape(-1), kind="stable"
+        ).astype(np.int32)
+
+    # Stable argsort matches jnp.argsort's tie-breaking, so the sorted
+    # segment-sum reduction order (hence bf16 numerics) is identical to
+    # the previous in-jit computation.
+    sender_perm = np.argsort(senders, kind="stable").astype(np.int32)
+    # Counts ALL edges per receiver (masked edges target padding nodes,
+    # so real-node counts are exact) — same semantics as
+    # models/convs.py:sorted_in_degree.
+    in_degree = np.bincount(receivers, minlength=n_node_pad).astype(np.float32)
 
     return GraphBatch(
         nodes=jnp.asarray(nodes),
@@ -266,6 +288,11 @@ def batch_graphs(
         dense_senders=jnp.asarray(dense_senders) if dense_senders is not None else None,
         dense_mask=jnp.asarray(dense_mask) if dense_mask is not None else None,
         dense_edge_attr=jnp.asarray(dense_edge_attr) if dense_edge_attr is not None else None,
+        sender_perm=jnp.asarray(sender_perm),
+        in_degree=jnp.asarray(in_degree),
+        dense_sender_perm=(
+            jnp.asarray(dense_sender_perm) if dense_sender_perm is not None else None
+        ),
     )
 
 
@@ -300,6 +327,33 @@ def pad_batch(batch: GraphBatch, n_node: int, n_edge: int, n_graph: int) -> Grap
         if bool(batch.node_mask[-1]):
             raise ValueError("cannot pad edges: batch has no padding node slot")
         pad_node_id = batch.num_nodes - 1
+    # Precomputed edge-structure derivatives extend without a re-sort:
+    # appended padding edges sit at the tail with sender/receiver value
+    # pad_node_id >= every existing value (real ids < tot_nodes <=
+    # pad_node_id), and stable argsort tie-breaks old-index-first — so
+    # the stable argsort of the padded array is exactly
+    # concat(old_perm, arange(old_E, new_E)). in_degree only gains the
+    # de new edges, all targeting pad_node_id (a padding slot).
+    sender_perm = batch.sender_perm
+    if sender_perm is not None:
+        sender_perm = jnp.concatenate(
+            [sender_perm, jnp.arange(batch.num_edges, n_edge, dtype=sender_perm.dtype)]
+        )
+    in_degree = batch.in_degree
+    if in_degree is not None:
+        in_degree = pad0(in_degree, dn)
+        if de > 0:
+            in_degree = in_degree.at[pad_node_id].add(float(de))
+    dense_sender_perm = batch.dense_sender_perm
+    if dense_sender_perm is not None and batch.dense_senders is not None:
+        old_flat = batch.dense_senders.size
+        new_flat = old_flat + dn * batch.dense_senders.shape[1]
+        dense_sender_perm = jnp.concatenate(
+            [
+                dense_sender_perm,
+                jnp.arange(old_flat, new_flat, dtype=dense_sender_perm.dtype),
+            ]
+        )
     return batch.replace(
         nodes=pad0(batch.nodes, dn),
         senders=pad0(batch.senders, de, pad_node_id),
@@ -319,6 +373,9 @@ def pad_batch(batch: GraphBatch, n_node: int, n_edge: int, n_graph: int) -> Grap
         dense_senders=pad0(batch.dense_senders, dn, pad_node_id),
         dense_mask=pad0(batch.dense_mask, dn, False),
         dense_edge_attr=pad0(batch.dense_edge_attr, dn),
+        sender_perm=sender_perm,
+        in_degree=in_degree,
+        dense_sender_perm=dense_sender_perm,
     )
 
 

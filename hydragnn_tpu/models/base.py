@@ -254,11 +254,22 @@ class HydraModel(nn.Module):
             node_mask=batch.node_mask,
             edge_attr=edge_attr,
             edge_weight=edge_weight,
-            # one argsort per step, reused by every layer's sender-gather
+            # argsort(senders), reused by every layer's sender-gather
             # backward (convs._gather_senders) — the sorted segment sum
-            # beats XLA's unsorted scatter-add ~2x at flagship shapes
-            sender_perm=jnp.argsort(batch.senders),
-            in_degree=C.sorted_in_degree(batch.receivers, batch.num_nodes),
+            # beats XLA's unsorted scatter-add ~2x at flagship shapes.
+            # The loader precomputes it on host (graph/batch.py) because
+            # the in-step argsort is a serial row-bound op (~ms at
+            # E=699k); recompute only for externally-built batches.
+            sender_perm=(
+                batch.sender_perm
+                if batch.sender_perm is not None
+                else jnp.argsort(batch.senders)
+            ),
+            in_degree=(
+                batch.in_degree
+                if batch.in_degree is not None
+                else C.sorted_in_degree(batch.receivers, batch.num_nodes)
+            ),
             dense_senders=batch.dense_senders,
             dense_mask=batch.dense_mask,
             dense_edge_attr=(
@@ -267,9 +278,13 @@ class HydraModel(nn.Module):
                 else None
             ),
             dense_sender_perm=(
-                jnp.argsort(batch.dense_senders.reshape(-1))
-                if batch.dense_senders is not None
-                else None
+                batch.dense_sender_perm
+                if batch.dense_sender_perm is not None
+                else (
+                    jnp.argsort(batch.dense_senders.reshape(-1))
+                    if batch.dense_senders is not None
+                    else None
+                )
             ),
         )
 

@@ -172,7 +172,7 @@ def place_dp_edge_batch(mesh: Mesh, batch):
     # pad must stay data-sharded only.
     import dataclasses as _dc
 
-    edge_fields = {"senders", "receivers", "edge_mask", "edge_attr"}
+    edge_fields = {"senders", "receivers", "edge_mask", "edge_attr", "sender_perm"}
     shardings = {}
     for f in _dc.fields(batch):
         v = getattr(batch, f.name)

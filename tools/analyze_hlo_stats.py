@@ -1,0 +1,97 @@
+"""Aggregate /tmp/hlo_stats.csv (tools/parse_trace.py output) into a
+per-component time/bytes table.
+
+Prints (a) top-K ops by total self time, (b) category rollup, and
+(c) trace-measured HBM bytes per step — self_time x measured BW summed
+over ops — the measurement that replaces cost-model bytes in bench.py
+(VERDICT r03 Weak #2).
+
+Usage: python tools/analyze_hlo_stats.py [/tmp/hlo_stats.csv] [n_steps] [n_top]
+"""
+
+import csv
+import json
+import sys
+from collections import defaultdict
+
+
+def main():
+    path = sys.argv[1] if len(sys.argv) > 1 else "/tmp/hlo_stats.csv"
+    n_steps = int(sys.argv[2]) if len(sys.argv) > 2 else 3
+    n_top = int(sys.argv[3]) if len(sys.argv) > 3 else 30
+
+    raw = open(path).read()
+    rows = []
+    if raw.lstrip().startswith("{"):  # gviz JSON despite the .csv name
+        tab = json.loads(raw)
+        cols = [c["id"] for c in tab["cols"]]
+        dicts = [
+            {cols[i]: (cell or {}).get("v") for i, cell in enumerate(row["c"])}
+            for row in tab["rows"]
+        ]
+    else:
+        dicts = list(csv.DictReader(raw.splitlines()))
+    for r in dicts:
+        try:
+            t_us = float(r.get("total_self_time", 0) or 0)
+        except ValueError:
+            continue
+        if t_us <= 0:
+            continue
+        bw = float(r.get("measured_memory_bw", 0) or 0)  # GiB/s
+        rows.append(
+            {
+                "op": str(r.get("hlo_op_name", "")),
+                "cat": str(r.get("category", "")),
+                "tf": str(r.get("tf_op_name", "")),
+                "n": int(float(r.get("occurrences", 1) or 1)),
+                "us": t_us,
+                "bytes": bw * (2**30) * (t_us / 1e6),
+                "bound": str(r.get("bound_by", "")),
+                "expr": str(r.get("hlo_op_expression", "") or "")[:160],
+            }
+        )
+
+    if not rows:
+        raise SystemExit(f"no rows with positive self time parsed from {path}")
+    tot_ms = sum(r["us"] for r in rows) / 1e3
+    tot_bytes = sum(r["bytes"] for r in rows)
+    print(f"total device self time: {tot_ms:.1f} ms over {n_steps} steps "
+          f"-> {tot_ms / n_steps:.1f} ms/step")
+    print(f"trace-measured HBM traffic: {tot_bytes / 1e9:.2f} GB "
+          f"-> {tot_bytes / n_steps / 1e9:.2f} GB/step "
+          f"-> {tot_bytes / (tot_ms / 1e3) / 1e9:.1f} GB/s average")
+    print()
+
+    print(f"== top {n_top} ops by self time (ms/step) ==")
+    for r in sorted(rows, key=lambda r: -r["us"])[:n_top]:
+        print(
+            f"{r['us']/1e3/n_steps:8.2f} ms {r['bytes']/n_steps/1e9:7.2f} GB "
+            f"{r['cat'][:18]:18s} {r['bound'][:10]:10s} {r['op'][:28]:28s} "
+            f"{r['tf'][:70]}"
+        )
+
+    print()
+    print("== category rollup (ms/step) ==")
+    cats = defaultdict(lambda: [0.0, 0.0, 0])
+    for r in rows:
+        c = cats[r["cat"]]
+        c[0] += r["us"]
+        c[1] += r["bytes"]
+        c[2] += r["n"]
+    for name, (us, b, n) in sorted(cats.items(), key=lambda kv: -kv[1][0]):
+        print(f"{us/1e3/n_steps:8.2f} ms {b/n_steps/1e9:7.2f} GB  n={n:5d}  {name}")
+
+    out = {
+        "ms_per_step": tot_ms / n_steps,
+        "measured_bytes_per_step": tot_bytes / n_steps,
+        "measured_hbm_gbps": tot_bytes / (tot_ms / 1e3) / 1e9,
+        "n_steps": n_steps,
+    }
+    with open("/tmp/hlo_summary.json", "w") as f:
+        json.dump(out, f)
+    print("\nwrote /tmp/hlo_summary.json:", json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -20,7 +20,10 @@ def main():
     if not planes:
         raise SystemExit(f"no xplane files under {trace_dir}")
 
-    from tensorboard_plugin_profile.convert import raw_to_tool_data as rd
+    try:
+        from xprof.convert import raw_to_tool_data as rd
+    except ImportError:  # the tb-plugin converter has a protobuf mismatch here
+        from tensorboard_plugin_profile.convert import raw_to_tool_data as rd
 
     params = {"tqx": "out:csv;"}
     for tool in ("hlo_stats", "framework_op_stats"):
