@@ -1,0 +1,315 @@
+"""One-shot importer for existing HydraGNN datasets.
+
+Existing HydraGNN deployments hold their preprocessed datasets in one of
+two on-disk formats (reference: hydragnn/utils/pickledataset.py:12-146
+sharded-pickle layout; hydragnn/utils/adiosdataset.py:79-179 ADIOS2
+schema). This module reads the sharded-pickle layout WITHOUT torch or
+torch_geometric being importable as packages in their reference form —
+the pickles contain torch_geometric ``Data`` objects, which are
+reconstructed through a tolerant unpickler that stubs every
+``torch_geometric.*`` class and then walks the captured state for the
+tensor payload — and converts it into an HGC container
+(:mod:`hydragnn_tpu.data.container`), the native dataset format here.
+
+Layout read (pickledataset.py):
+  <basedir>/<label>-meta.pkl   5 sequential pickles: minmax_node_feature,
+                               minmax_graph_feature, ntotal, use_subdir,
+                               nmax_persubdir
+  <basedir>/<label>-<k>.pkl    one pickled PyG Data per sample
+                               (under <k // nmax_persubdir>/ subdirs when
+                               use_subdir)
+
+The ADIOS2 schema (group arrays + per-variable concatenated payloads
+with ragged offsets) needs the adios2 reader library, which is not in
+this image; its schema is documented in PARITY.md and the converter
+raises a clear error pointing at the pickle path for it.
+
+The reference's ragged ``data.y`` + ``y_loc`` offset table (written by
+serialized_dataset_loader.py:262-303) is unpacked into the dict-of-heads
+``GraphSample`` layout when present; otherwise ``y`` is kept as the
+graph-level target vector.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import pickle
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from hydragnn_tpu.data.dataset import GraphSample
+
+
+class _Stub:
+    """Stand-in for any unimportable class found in a reference pickle:
+    captures constructor args and state without executing any foreign
+    code (also a safety property — reference pickles are untrusted, and
+    the allowlist below means no arbitrary class is ever instantiated)."""
+
+    _args: tuple = ()
+    _state: Any = None
+
+    def __init__(self, *args, **kwargs):
+        self._args = args
+
+    def __setstate__(self, state):
+        self._state = state
+
+    # PyG BaseStorage pickles may invoke __setitem__-style protocols on
+    # append-capable reductions; accept and record them.
+    def append(self, item):
+        self._args = self._args + (item,)
+
+    def extend(self, items):
+        self._args = self._args + tuple(items)
+
+
+_SAFE_MODULES = ("torch", "numpy", "collections", "builtins", "copyreg")
+
+
+class _TolerantUnpickler(pickle.Unpickler):
+    """Unpickler that loads torch/numpy payloads normally and maps every
+    other class (torch_geometric.*, mpi4py leftovers, ...) to _Stub.
+
+    Anything outside the torch/numpy allowlist is NEVER executed — its
+    state is captured structurally. That makes loading a foreign pickle
+    no more dangerous than parsing it."""
+
+    def find_class(self, module: str, name: str):
+        root = module.split(".")[0]
+        if root in _SAFE_MODULES:
+            return super().find_class(module, name)
+        return _Stub
+
+
+def _load_pickle_stream(path: str, count: int) -> list:
+    out = []
+    with open(path, "rb") as f:
+        for _ in range(count):
+            out.append(_TolerantUnpickler(f).load())
+    return out
+
+
+def _to_numpy(v) -> Optional[np.ndarray]:
+    """torch.Tensor / ndarray / scalar -> ndarray, else None."""
+    if v is None:
+        return None
+    if isinstance(v, np.ndarray):
+        return v
+    if hasattr(v, "detach") and hasattr(v, "numpy"):  # torch.Tensor
+        try:
+            return v.detach().cpu().numpy()
+        except Exception:
+            return None
+    if isinstance(v, (int, float)):
+        return np.asarray([v], dtype=np.float32)
+    return None
+
+
+def _tensor_mapping(obj, depth: int = 0) -> Dict[str, np.ndarray]:
+    """Walk a stubbed object graph for the innermost dict holding the
+    tensor payload (PyG Data stores it at Data.__dict__['_store']
+    ._mapping across 2.x versions; older versions keep tensors directly
+    in __dict__). Returns {key: ndarray}."""
+    if depth > 6:
+        return {}
+    found: Dict[str, np.ndarray] = {}
+    state = None
+    if isinstance(obj, dict):
+        state = obj
+    elif isinstance(obj, _Stub):
+        state = obj._state if isinstance(obj._state, dict) else None
+        if state is None and obj._args and isinstance(obj._args[-1], dict):
+            state = obj._args[-1]
+    if state is None:
+        return {}
+    for k, v in state.items():
+        if not isinstance(k, str):
+            continue
+        arr = _to_numpy(v)
+        if arr is not None:
+            found[k.lstrip("_")] = arr
+        elif isinstance(v, (dict, _Stub)):
+            inner = _tensor_mapping(v, depth + 1)
+            # deeper mappings win only for keys not already present
+            for ik, iv in inner.items():
+                found.setdefault(ik, iv)
+    return found
+
+
+def _unpack_y(
+    fields: Dict[str, np.ndarray],
+    head_types: Optional[Sequence[str]] = None,
+    head_names: Optional[Sequence[str]] = None,
+) -> Dict[str, Any]:
+    """Split the reference's packed ``y`` + ``y_loc`` into the
+    dict-of-heads layout (update_predicted_values packing:
+    serialized_dataset_loader.py:262-303 — head h occupies rows
+    [y_loc[h], y_loc[h+1]), node heads store num_nodes x dim
+    row-major)."""
+    y = fields.get("y")
+    y_loc = fields.get("y_loc")
+    n_nodes = fields["x"].shape[0]
+    out: Dict[str, Any] = {"graph_targets": {}, "node_targets": {}, "graph_y": None}
+    if y is None:
+        return out
+    y = y.reshape(-1).astype(np.float32)
+    if y_loc is None:
+        out["graph_y"] = y
+        return out
+    y_loc = y_loc.reshape(-1).astype(np.int64)
+    n_heads = y_loc.shape[0] - 1
+    for h in range(n_heads):
+        seg = y[y_loc[h] : y_loc[h + 1]]
+        name = (
+            head_names[h]
+            if head_names is not None and h < len(head_names)
+            else f"head{h}"
+        )
+        htype = (
+            head_types[h]
+            if head_types is not None and h < len(head_types)
+            else ("node" if seg.shape[0] % n_nodes == 0 and seg.shape[0] >= n_nodes else "graph")
+        )
+        if htype == "node":
+            out["node_targets"][name] = seg.reshape(n_nodes, -1)
+        else:
+            out["graph_targets"][name] = seg
+    return out
+
+
+def data_object_to_sample(
+    obj,
+    head_types: Optional[Sequence[str]] = None,
+    head_names: Optional[Sequence[str]] = None,
+) -> GraphSample:
+    """Stubbed PyG ``Data`` -> :class:`GraphSample`."""
+    fields = _tensor_mapping(obj)
+    if "x" not in fields:
+        raise ValueError(
+            f"no 'x' tensor found in pickled object (keys: {sorted(fields)})"
+        )
+    x = fields["x"].astype(np.float32)
+    x = x[:, None] if x.ndim == 1 else x
+    ei = fields.get("edge_index")
+    heads = _unpack_y(fields, head_types, head_names)
+    ea = fields.get("edge_attr")
+    if ea is not None:
+        ea = ea.astype(np.float32)
+        ea = ea[:, None] if ea.ndim == 1 else ea
+    return GraphSample(
+        x=x,
+        pos=None if fields.get("pos") is None else fields["pos"].astype(np.float32),
+        edge_index=None if ei is None else ei.astype(np.int32),
+        edge_attr=ea,
+        graph_y=heads["graph_y"],
+        graph_targets=heads["graph_targets"],
+        node_targets=heads["node_targets"],
+    )
+
+
+class ReferencePickleReader:
+    """Reader for the reference sharded-pickle layout."""
+
+    def __init__(self, basedir: str, label: str):
+        meta_path = os.path.join(basedir, f"{label}-meta.pkl")
+        if not os.path.exists(meta_path):
+            raise FileNotFoundError(
+                f"{meta_path} not found — expected the reference layout "
+                "written by hydragnn/utils/pickledataset.py:SimplePickleWriter"
+            )
+        (
+            self.minmax_node_feature,
+            self.minmax_graph_feature,
+            self.ntotal,
+            self.use_subdir,
+            self.nmax_persubdir,
+        ) = _load_pickle_stream(meta_path, 5)
+        self.basedir = basedir
+        self.label = label
+
+    def __len__(self) -> int:
+        return int(self.ntotal)
+
+    def _path(self, k: int) -> str:
+        fname = f"{self.label}-{k}.pkl"
+        if self.use_subdir:
+            return os.path.join(self.basedir, str(k // self.nmax_persubdir), fname)
+        return os.path.join(self.basedir, fname)
+
+    def read(
+        self,
+        k: int,
+        head_types: Optional[Sequence[str]] = None,
+        head_names: Optional[Sequence[str]] = None,
+    ) -> GraphSample:
+        with open(self._path(k), "rb") as f:
+            obj = _TolerantUnpickler(f).load()
+        return data_object_to_sample(obj, head_types, head_names)
+
+    def samples(
+        self,
+        head_types: Optional[Sequence[str]] = None,
+        head_names: Optional[Sequence[str]] = None,
+    ) -> List[GraphSample]:
+        return [self.read(k, head_types, head_names) for k in range(len(self))]
+
+
+def import_pickle_dataset(
+    basedir: str,
+    label: str,
+    out_path: str,
+    head_types: Optional[Sequence[str]] = None,
+    head_names: Optional[Sequence[str]] = None,
+) -> int:
+    """Convert one reference pickle dataset (``<basedir>/<label>-*.pkl``)
+    into an HGC container at ``out_path``. Returns the sample count.
+
+    The reference minmax metadata rides along as container globals so
+    downstream normalization (data/ingest.py) can reuse it."""
+    from hydragnn_tpu.data.container import ContainerWriter
+
+    reader = ReferencePickleReader(basedir, label)
+    writer = ContainerWriter(out_path)
+    writer.add(reader.samples(head_types, head_names))
+    for name, val in (
+        ("minmax_node_feature", reader.minmax_node_feature),
+        ("minmax_graph_feature", reader.minmax_graph_feature),
+    ):
+        arr = _to_numpy(val)
+        if arr is not None:
+            writer.add_global(name, arr)
+    writer.save()
+    return len(reader)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> None:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        description="Convert a reference HydraGNN sharded-pickle dataset "
+        "into an HGC container."
+    )
+    p.add_argument("basedir", help="directory holding <label>-meta.pkl")
+    p.add_argument("label", help="dataset label (e.g. 'trainset', 'total')")
+    p.add_argument("out", help="output .hgc container path")
+    p.add_argument(
+        "--head-type",
+        action="append",
+        choices=["graph", "node"],
+        help="per-head type, in y_loc order (repeat; inferred if omitted)",
+    )
+    p.add_argument(
+        "--head-name", action="append", help="per-head name, in y_loc order"
+    )
+    args = p.parse_args(argv)
+    n = import_pickle_dataset(
+        args.basedir, args.label, args.out, args.head_type, args.head_name
+    )
+    print(f"imported {n} samples -> {args.out}")
+
+
+if __name__ == "__main__":
+    main()

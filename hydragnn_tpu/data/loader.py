@@ -91,6 +91,7 @@ class GraphLoader:
         prefetch: Optional[int] = None,
         scan_reshuffle_every: int = 0,
         dense_slots: bool | int = True,
+        run_align: bool | int = True,
     ):
         if device_stack > 1 and batch_size % device_stack != 0:
             raise ValueError(
@@ -158,6 +159,34 @@ class GraphLoader:
             self.dense_slots = int(dense_slots)
         else:
             self.dense_slots = None
+        # Run-aligned edge layout (graph/batch.py run_align): pads each
+        # node's receiver-run to a multiple of K so segment reductions
+        # pre-reduce K-fold before the serial scatter. AUTO (True):
+        # K = 8 whenever the dense map is off (they answer the same
+        # scatter-cost problem; dense wins for tight degree
+        # distributions, run-align for wide ones) and the dataset has
+        # edges. The pad plan widens to the ALIGNED worst case. An int
+        # pins K; False/0 disables.
+        if run_align is True:
+            self.run_align = 8 if self.dense_slots is None else 0
+        else:
+            self.run_align = int(run_align) if run_align else 0
+            if self.run_align > 1 and self.dense_slots is not None:
+                raise ValueError(
+                    "run_align and dense_slots are mutually exclusive — pass "
+                    "dense_slots=False alongside an explicit run_align"
+                )
+        if self.run_align > 1:
+            aligned = _aligned_edge_counts(self.all_samples, self.run_align)
+            if aligned is None:
+                self.run_align = 0  # no edge_index anywhere — nothing to align
+            else:
+                sub = batch_size // device_stack
+                worst = sorted(aligned, reverse=True)[:sub]
+                mult = math.lcm(edge_multiple, self.run_align)
+                self.pad_edges = _round_up(
+                    max(sum(worst) + 1, self.pad_edges), mult
+                )
         self._dicts = samples_to_graph_dicts(self.samples)
 
     def set_epoch(self, epoch: int) -> None:
@@ -207,6 +236,7 @@ class GraphLoader:
             n_edge_pad=self.pad_edges,
             n_graph_pad=self.pad_graphs,
             dense_slots=self.dense_slots,
+            run_align=self.run_align,
         )
 
     def _make_batch(self, chunk: Sequence[int]) -> GraphBatch:
@@ -343,6 +373,26 @@ class GraphLoader:
         return self._stacked
 
 
+def _aligned_edge_counts(samples, k: int):
+    """Per-sample edge-slot count under run-K alignment
+    (sum over nodes of roundup(in_degree, k)), or None when any sample
+    lacks an edge_index."""
+    import numpy as _np
+
+    out = []
+    for s in samples:
+        ei = getattr(s, "edge_index", None)
+        if ei is None:
+            return None
+        r = _np.asarray(ei)[1]
+        if r.size:
+            deg = _np.bincount(r)
+            out.append(int((((deg + k - 1) // k) * k * (deg > 0)).sum()))
+        else:
+            out.append(0)
+    return out
+
+
 def max_in_degree(samples) -> int:
     """Dataset-wide max node in-degree (the static dense-slot count).
     Returns 0 when any sample lacks an edge_index (dense map disabled)."""
@@ -357,6 +407,12 @@ def max_in_degree(samples) -> int:
         if r.size:
             worst = max(worst, int(_np.bincount(r).max()))
     return worst
+
+
+def _bn() -> int:
+    from hydragnn_tpu.ops.segment_pallas import BN
+
+    return BN
 
 
 def _mask_out(batch: GraphBatch) -> GraphBatch:
@@ -381,13 +437,20 @@ def _mask_out(batch: GraphBatch) -> GraphBatch:
             dense["dense_sender_perm"] = _np.arange(
                 batch.dense_senders.size, dtype=_np.int32
             )
+        if batch.dense_sender_win is not None:
+            w = _np.zeros_like(_np.asarray(batch.dense_sender_win))
+            w[1, pad_slot // _bn()] = batch.dense_senders.size
+            dense["dense_sender_win"] = w
     derived = {}
     if batch.sender_perm is not None:
         derived["sender_perm"] = _np.arange(batch.num_edges, dtype=_np.int32)
     if batch.in_degree is not None:
-        deg = _np.zeros(batch.num_nodes, dtype=_np.float32)
-        deg[pad_slot] = batch.num_edges
-        derived["in_degree"] = deg
+        # in_degree counts real edges only; a fully-masked batch has none
+        derived["in_degree"] = _np.zeros(batch.num_nodes, dtype=_np.float32)
+    if batch.sender_win is not None:
+        w = _np.zeros_like(_np.asarray(batch.sender_win))
+        w[1, pad_slot // _bn()] = batch.num_edges
+        derived["sender_win"] = w
     return batch.replace(
         senders=_np.full_like(_np.asarray(batch.senders), pad_slot),
         receivers=_np.full_like(_np.asarray(batch.receivers), pad_slot),

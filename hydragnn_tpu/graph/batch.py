@@ -30,6 +30,39 @@ def _round_up(x: int, multiple: int) -> int:
     return ((x + multiple - 1) // multiple) * multiple
 
 
+def _block_windows(ids: np.ndarray, perm: np.ndarray, num_rows: int) -> np.ndarray:
+    """Host-side per-node-block position windows [2, n_blocks] for the
+    local-window kernels: every position p with ``ids[p] // BN == i``
+    satisfies ``win[0, i] <= p < win[1, i]``. ``perm`` must be a stable
+    argsort of ``ids`` (already on the batch).
+
+    Windows are ALWAYS emitted (a data-dependent None would make the
+    pytree structure vary per batch — breaking device_stack stacking
+    and flapping the jit cache). Tightness, not validity, depends on
+    locality: batches from :func:`batch_graphs` are graph-contiguous,
+    bounding the kernel's scan at a small multiple of a sorted
+    layout's; a pathologically shuffled node order degrades to
+    wide windows — slower, never wrong (the one-hot match filters
+    strays). The giant-graph path strips windows before GSPMD sharding
+    (parallel/edge_sharded.py:place_giant_batch)."""
+    from hydragnn_tpu.ops.segment_pallas import BN
+
+    n_blocks = _round_up(max(num_rows, 1), BN) // BN
+    lo = np.zeros(n_blocks, dtype=np.int64)
+    hi = np.zeros(n_blocks, dtype=np.int64)
+    if ids.size:
+        sblk = ids[perm] // BN  # sorted ids -> sorted block ids
+        starts = np.searchsorted(sblk, np.arange(n_blocks), side="left")
+        ends = np.searchsorted(sblk, np.arange(n_blocks), side="right")
+        ne = ends > starts
+        if ne.any():
+            # nonempty block segments tile the sorted array contiguously,
+            # so reduceat over their starts reduces exactly [start, end)
+            lo[ne] = np.minimum.reduceat(perm, starts[ne])
+            hi[ne] = np.maximum.reduceat(perm, starts[ne]) + 1
+    return np.stack([lo, hi]).astype(np.int32)
+
+
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass(frozen=True)
 class GraphBatch:
@@ -84,6 +117,28 @@ class GraphBatch:
     sender_perm: Optional[jnp.ndarray] = None  # [E] int32, stable argsort(senders)
     in_degree: Optional[jnp.ndarray] = None  # [N] f32, edge count per receiver
     dense_sender_perm: Optional[jnp.ndarray] = None  # [N*D] int32
+    # Per-node-block edge-position windows for the local-window Pallas
+    # kernels (ops/segment_pallas.py:segment_sum_local_pallas): every
+    # edge e with senders[e] // BN == i lies in [win[0,i], win[1,i]).
+    # Tight for batched graphs (graph g's senders live in g's
+    # contiguous node block); lets the sender-gather backward scatter
+    # WITHOUT the [E, H] cotangent permute. batch_graphs ALWAYS emits
+    # them (pathological id layouts just get wide, slow-but-correct
+    # windows); None only for externally-built batches and the
+    # GSPMD-sharded giant-graph path, which strips them.
+    sender_win: Optional[jnp.ndarray] = None  # [2, ceil(N/BN)] int32
+    dense_sender_win: Optional[jnp.ndarray] = None  # [2, ceil(N/BN)] int32
+    # STATIC (pytree meta): run-aligned edge layout factor. When K > 0,
+    # every node's receiver-run is padded to a multiple of K with MASKED
+    # self-loop edges (sender = receiver = the node), so every K-group
+    # of edge slots lies within one node's run (or the batch tail) and
+    # segment reductions can PRE-REDUCE each group with one fused
+    # elementwise pass — shrinking the serial scatter/segment work K-fold
+    # (XLA's TPU scatter loops per ROW; docs/PERF.md r03/r04). Downstream
+    # contracts that change under K > 0: masked edges may target REAL
+    # nodes (always as self-loops), so consumers MUST apply edge_mask —
+    # all in-tree convs do; in_degree counts REAL edges only (either way).
+    run_align: int = dataclasses.field(default=0, metadata=dict(static=True))
 
     @property
     def num_nodes(self) -> int:
@@ -100,6 +155,81 @@ class GraphBatch:
     def replace(self, **kwargs) -> "GraphBatch":
         return dataclasses.replace(self, **kwargs)
 
+    def check_invariants(self) -> None:
+        """Validate the loader contracts the model chassis SILENTLY
+        relies on (r03 advisor): raises AssertionError with a named
+        violation. Host-side debug helper — call it on batches built
+        outside :func:`batch_graphs`/:func:`pad_batch` (which maintain
+        these by construction); never inside jit.
+
+          1. receivers sorted ascending (segment reductions pass
+             indices_are_sorted=True — a violated hint silently corrupts
+             sums on TPU);
+          2. every masked edge targets a padding node (the degree
+             shortcut counts edges without consulting the mask);
+          3. sender_perm is a stable argsort of senders, in_degree
+             matches the receiver bincount, and the block windows cover
+             every edge position of their id block.
+        """
+        import numpy as np_
+
+        recv = np_.asarray(self.receivers)
+        send = np_.asarray(self.senders)
+        emask = np_.asarray(self.edge_mask)
+        nmask = np_.asarray(self.node_mask)
+        assert np_.all(recv[:-1] <= recv[1:]), "receivers not sorted ascending"
+        masked_idx = np_.flatnonzero(~emask)
+        if masked_idx.size:
+            to_real = nmask[recv[masked_idx]]
+            if self.run_align:
+                # run-aligned layout: masked edges at real nodes must be
+                # SELF-LOOPS (they then cannot corrupt any masked
+                # aggregation, and sender locality is preserved)
+                bad = to_real & (send[masked_idx] != recv[masked_idx])
+                assert not bad.any(), (
+                    "masked edge targets a real node without being a "
+                    "self-loop (run_align contract)"
+                )
+            else:
+                assert not to_real.any(), (
+                    "masked edge targets a REAL node (degree shortcut + "
+                    "dense map assume padding edges only ever point at "
+                    "padding nodes)"
+                )
+        if self.sender_perm is not None:
+            sp = np_.asarray(self.sender_perm)
+            assert np_.all(send[sp][:-1] <= send[sp][1:]), (
+                "sender_perm does not sort senders"
+            )
+        if self.in_degree is not None:
+            deg = np_.asarray(self.in_degree)
+            real = recv[emask]
+            ref = np_.bincount(real, minlength=real.max() + 1 if real.size else 0)
+            assert np_.array_equal(deg[: ref.shape[0]], ref) and not deg[
+                ref.shape[0]:
+            ].any(), "in_degree != bincount(real receivers)"
+        for ids, win, label in (
+            (send, self.sender_win, "sender_win"),
+            (
+                None
+                if self.dense_senders is None
+                else np_.asarray(self.dense_senders).reshape(-1),
+                self.dense_sender_win,
+                "dense_sender_win",
+            ),
+        ):
+            if win is None or ids is None:
+                continue
+            from hydragnn_tpu.ops.segment_pallas import BN
+
+            w = np_.asarray(win)
+            blk = ids // BN
+            pos = np_.arange(ids.shape[0])
+            lo, hi = w[0][blk], w[1][blk]
+            assert np_.all((pos >= lo) & (pos < hi)), (
+                f"{label} does not cover every position of its id block"
+            )
+
 
 def batch_graphs(
     graphs: Sequence[Dict[str, Any]],
@@ -109,6 +239,7 @@ def batch_graphs(
     node_multiple: int = 16,
     edge_multiple: int = 8,
     dense_slots: Optional[int] = None,
+    run_align: int = 0,
 ) -> GraphBatch:
     """Concatenate a list of single graphs and pad to static shapes.
 
@@ -116,6 +247,13 @@ def batch_graphs(
     [e] (or ``edge_index`` [2, e]), optional ``edge_attr``, ``pos``,
     ``graph_targets`` {name: [d]}, ``node_targets`` {name: [n, d]}.
     All numpy; this runs on host in the input pipeline.
+
+    ``run_align=K`` (K > 1) emits the run-aligned edge layout: each
+    node's receiver-run padded to a multiple of K with masked self-loop
+    edges (see GraphBatch.run_align). Mutually exclusive with
+    ``dense_slots`` — they are alternative answers to the same
+    scatter-cost problem, dense for tight degree distributions,
+    run-align for wide ones.
     """
     if not graphs:
         raise ValueError("graphs must be non-empty")
@@ -235,6 +373,47 @@ def batch_graphs(
         if has_edge_attr:
             edge_attr = edge_attr[perm]
 
+    if run_align and run_align > 1:
+        if dense_slots:
+            raise ValueError("run_align and dense_slots are mutually exclusive")
+        K = int(run_align)
+        if n_edge_pad % K:
+            raise ValueError(f"n_edge_pad={n_edge_pad} not a multiple of run_align={K}")
+        # Real edges occupy [0, tot_edges): real receivers < tot_nodes
+        # strictly, padding receivers == tot_nodes, and the sort is
+        # receiver-major. Re-lay runs on K-aligned starts; pad slots are
+        # masked SELF-LOOPS at their node (receivers stay sorted, sender
+        # locality preserved, and a self-loop cannot corrupt any masked
+        # aggregation). The tail keeps the padding-node sentinel.
+        deg = np.bincount(receivers[:tot_edges], minlength=n_node_pad)
+        adeg = ((deg + K - 1) // K) * K * (deg > 0)
+        total = int(adeg.sum())
+        if total > n_edge_pad:
+            raise ValueError(
+                f"run_align={K} needs {total} edge slots > n_edge_pad={n_edge_pad}; "
+                "size the pad from the ALIGNED per-sample counts "
+                "(data/loader.py:_aligned_edge_counts — GraphLoader does "
+                "this automatically)"
+            )
+        rs = np.zeros(n_node_pad + 1, dtype=np.int64)
+        rs[1:] = np.cumsum(adeg)
+        row_ptr = np.zeros(n_node_pad + 1, dtype=np.int64)
+        row_ptr[1:] = np.cumsum(deg)
+        r = receivers[:tot_edges]
+        new_pos = rs[r] + (np.arange(tot_edges) - row_ptr[r])
+        fill = np.repeat(np.arange(n_node_pad, dtype=np.int32), adeg)
+        new_recv = np.full(n_edge_pad, tot_nodes, dtype=np.int32)
+        new_recv[:total] = fill
+        new_send = new_recv.copy()
+        new_mask = np.zeros(n_edge_pad, dtype=bool)
+        new_send[new_pos] = senders[:tot_edges]
+        new_mask[new_pos] = True
+        if has_edge_attr:
+            new_ea = np.zeros_like(edge_attr)
+            new_ea[new_pos] = edge_attr[:tot_edges]
+            edge_attr = new_ea
+        senders, receivers, edge_mask = new_send, new_recv, new_mask
+
     dense_senders = dense_mask = dense_edge_attr = dense_sender_perm = None
     if dense_slots is not None and dense_slots > 0:
         # receiver-major sorted + only padding edges masked (targeting a
@@ -266,10 +445,20 @@ def batch_graphs(
     # segment-sum reduction order (hence bf16 numerics) is identical to
     # the previous in-jit computation.
     sender_perm = np.argsort(senders, kind="stable").astype(np.int32)
-    # Counts ALL edges per receiver (masked edges target padding nodes,
-    # so real-node counts are exact) — same semantics as
-    # models/convs.py:sorted_in_degree.
-    in_degree = np.bincount(receivers, minlength=n_node_pad).astype(np.float32)
+    # Counts REAL edges per receiver. Real-node values match
+    # models/convs.py:sorted_in_degree (masked edges never target a real
+    # node except as run_align self-loop padding, excluded here by the
+    # mask); padding-node rows are 0 rather than the masked-tail count —
+    # strictly cleaner for every consumer (PNA has-gate, MFC dispatch).
+    in_degree = np.bincount(
+        receivers[edge_mask], minlength=n_node_pad
+    ).astype(np.float32)
+    sender_win = _block_windows(senders, sender_perm, n_node_pad)
+    dense_sender_win = (
+        _block_windows(dense_senders.reshape(-1), dense_sender_perm, n_node_pad)
+        if dense_sender_perm is not None
+        else None
+    )
 
     return GraphBatch(
         nodes=jnp.asarray(nodes),
@@ -293,6 +482,11 @@ def batch_graphs(
         dense_sender_perm=(
             jnp.asarray(dense_sender_perm) if dense_sender_perm is not None else None
         ),
+        sender_win=jnp.asarray(sender_win) if sender_win is not None else None,
+        dense_sender_win=(
+            jnp.asarray(dense_sender_win) if dense_sender_win is not None else None
+        ),
+        run_align=int(run_align) if run_align and run_align > 1 else 0,
     )
 
 
@@ -303,6 +497,11 @@ def pad_batch(batch: GraphBatch, n_node: int, n_edge: int, n_graph: int) -> Grap
     dg = n_graph - batch.num_graphs
     if dn < 0 or de < 0 or dg < 0:
         raise ValueError("target shape smaller than current batch")
+    if batch.run_align and n_edge % batch.run_align:
+        raise ValueError(
+            f"n_edge={n_edge} must stay a multiple of run_align="
+            f"{batch.run_align} (the model reshapes edges into K-groups)"
+        )
     if dn == de == dg == 0:
         return batch
 
@@ -339,11 +538,11 @@ def pad_batch(batch: GraphBatch, n_node: int, n_edge: int, n_graph: int) -> Grap
         sender_perm = jnp.concatenate(
             [sender_perm, jnp.arange(batch.num_edges, n_edge, dtype=sender_perm.dtype)]
         )
+    # in_degree counts REAL edges only; appended padding edges are
+    # masked, so only zero-extension is needed
     in_degree = batch.in_degree
     if in_degree is not None:
         in_degree = pad0(in_degree, dn)
-        if de > 0:
-            in_degree = in_degree.at[pad_node_id].add(float(de))
     dense_sender_perm = batch.dense_sender_perm
     if dense_sender_perm is not None and batch.dense_senders is not None:
         old_flat = batch.dense_senders.size
@@ -353,6 +552,39 @@ def pad_batch(batch: GraphBatch, n_node: int, n_edge: int, n_graph: int) -> Grap
                 dense_sender_perm,
                 jnp.arange(old_flat, new_flat, dtype=dense_sender_perm.dtype),
             ]
+        )
+
+    def _extend_win(win, n_appended, old_len, new_len):
+        """Appended tail positions all carry id pad_node_id: widen that
+        block's window to cover [old_len, new_len) (lo stays — it is
+        <= old_len unless the block was empty)."""
+        if win is None:
+            return None
+        from hydragnn_tpu.ops.segment_pallas import BN
+
+        n_blocks = (n_node + BN - 1) // BN
+        if win.shape[1] < n_blocks:
+            win = jnp.concatenate(
+                [win, jnp.zeros((2, n_blocks - win.shape[1]), win.dtype)], axis=1
+            )
+        if n_appended <= 0:
+            return win
+        b = pad_node_id // BN
+        empty = win[0, b] == win[1, b]
+        lo = jnp.where(empty, old_len, jnp.minimum(win[0, b], old_len))
+        win = win.at[0, b].set(lo.astype(win.dtype))
+        return win.at[1, b].set(new_len)
+
+    sender_win = _extend_win(
+        batch.sender_win, de, batch.num_edges, n_edge
+    )
+    dense_sender_win = batch.dense_sender_win
+    if dense_sender_win is not None and batch.dense_senders is not None:
+        dense_sender_win = _extend_win(
+            dense_sender_win,
+            dn * batch.dense_senders.shape[1],
+            batch.dense_senders.size,
+            batch.dense_senders.size + dn * batch.dense_senders.shape[1],
         )
     return batch.replace(
         nodes=pad0(batch.nodes, dn),
@@ -376,6 +608,8 @@ def pad_batch(batch: GraphBatch, n_node: int, n_edge: int, n_graph: int) -> Grap
         sender_perm=sender_perm,
         in_degree=in_degree,
         dense_sender_perm=dense_sender_perm,
+        sender_win=sender_win,
+        dense_sender_win=dense_sender_win,
     )
 
 

@@ -286,6 +286,9 @@ class HydraModel(nn.Module):
                     else None
                 )
             ),
+            sender_win=batch.sender_win,
+            dense_sender_win=batch.dense_sender_win,
+            run_align=batch.run_align,
         )
 
     def _apply_conv(self, conv, x, ctx, train: bool):

@@ -61,6 +61,56 @@ class EdgeContext:
     dense_mask: Optional[jnp.ndarray] = None  # [N, D] bool
     dense_edge_attr: Optional[jnp.ndarray] = None  # [N*D, De]
     dense_sender_perm: Optional[jnp.ndarray] = None  # [N*D] int32
+    # loader-emitted per-node-block position windows (graph/batch.py:
+    # _block_windows): when present, sender gathers ride the windowed
+    # kernels in BOTH directions — no cotangent permute in the backward
+    sender_win: Optional[jnp.ndarray] = None  # [2, ceil(N/BN)] int32
+    dense_sender_win: Optional[jnp.ndarray] = None  # [2, ceil(N/BN)] int32
+    # static: run-aligned edge layout factor (GraphBatch.run_align).
+    # K > 0 guarantees every K-group of edge slots shares one receiver
+    # (or is batch tail), so segment reductions pre-reduce K-fold with
+    # one fused pass (_run_groups) before the serial scatter/segment op.
+    run_align: int = 0
+
+
+def _local_kernels() -> bool:
+    from hydragnn_tpu.ops.segment_pallas import local_kernel_active
+
+    return local_kernel_active()
+
+
+def _run_presum(vals: jnp.ndarray, ctx: EdgeContext) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Pre-reduce masked edge values over the K-aligned run groups:
+    one fused [E/K, K, H] reshape-sum (accumulated f32 — the family
+    contract) replaces K-1 of every K rows the downstream segment sum
+    would otherwise scatter serially (XLA's TPU scatter loops per ROW
+    at ~6-9 ms per 699k-row pass regardless of width; docs/PERF.md).
+    Returns (summed [E/K, H] f32, receivers[::K]) — valid because the
+    run-aligned layout guarantees each K-group lies within one node's
+    receiver-run or the batch tail, and masked slots contribute 0."""
+    K = ctx.run_align
+    vf = jnp.where(ctx.edge_mask[:, None], vals, 0).astype(jnp.float32)
+    v8 = vf.reshape(-1, K, vals.shape[-1]).sum(axis=1)
+    return v8, ctx.receivers[::K]
+
+
+def _segment_sum_edges(vals: jnp.ndarray, ctx: EdgeContext, n: int) -> jnp.ndarray:
+    """Masked sum of per-edge values into receiver rows — pre-reduced
+    K-fold on run-aligned batches, the plain masked sorted segment sum
+    otherwise. Returns the values' dtype."""
+    if ctx.run_align:
+        v8, recv8 = _run_presum(vals, ctx)
+        return S.segment_sum_sorted(v8, recv8, n).astype(vals.dtype)
+    return S.segment_sum(
+        vals, ctx.receivers, n, mask=ctx.edge_mask, indices_are_sorted=True
+    )
+
+
+def _edge_count(ctx: EdgeContext, n: int) -> jnp.ndarray:
+    """Real in-degree: the loader-precomputed field, else a masked count."""
+    if ctx.in_degree is not None:
+        return ctx.in_degree
+    return S.segment_count(ctx.receivers, n, mask=ctx.edge_mask, indices_are_sorted=True)
 
 
 def sorted_in_degree(receivers: jnp.ndarray, num_nodes: int) -> jnp.ndarray:
@@ -75,8 +125,12 @@ def sorted_in_degree(receivers: jnp.ndarray, num_nodes: int) -> jnp.ndarray:
 
 
 def _gather_senders(x: jnp.ndarray, ctx: EdgeContext) -> jnp.ndarray:
-    """x[ctx.senders] with the fast permuted-gather backward when the
-    chassis provided ``sender_perm``."""
+    """x[ctx.senders] with the fastest available backward: the
+    local-window kernel pair when the loader emitted block windows AND
+    the kernels lower here (no cotangent permute at all), else the
+    permuted sorted segment sum via the chassis ``sender_perm``."""
+    if ctx.sender_win is not None and _local_kernels():
+        return S.gather_rows_local(x, ctx.senders, ctx.sender_win, x.shape[0])
     if ctx.sender_perm is not None:
         return S.gather_rows_permuted(x, ctx.senders, ctx.sender_perm, x.shape[0])
     return x[ctx.senders]
@@ -91,10 +145,7 @@ class GINConv(nn.Module):
     @nn.compact
     def __call__(self, x: jnp.ndarray, ctx: EdgeContext) -> jnp.ndarray:
         eps = self.param("eps", lambda _: jnp.asarray(100.0, jnp.float32))
-        agg = S.segment_sum(
-            _gather_senders(x, ctx), ctx.receivers, x.shape[0],
-            mask=ctx.edge_mask, indices_are_sorted=True,
-        )
+        agg = _segment_sum_edges(_gather_senders(x, ctx), ctx, x.shape[0])
         h = (1.0 + eps) * x + agg
         h = nn.Dense(self.out_dim)(h)
         h = nn.relu(h)
@@ -110,10 +161,10 @@ class SAGEConv(nn.Module):
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, ctx: EdgeContext) -> jnp.ndarray:
-        agg = S.segment_mean(
-            _gather_senders(x, ctx), ctx.receivers, x.shape[0],
-            mask=ctx.edge_mask, indices_are_sorted=True,
-        )
+        n = x.shape[0]
+        total = _segment_sum_edges(_gather_senders(x, ctx), ctx, n)
+        cnt = _edge_count(ctx, n)
+        agg = total / jnp.maximum(cnt, 1.0)[:, None].astype(total.dtype)
         return nn.Dense(self.out_dim)(agg) + nn.Dense(self.out_dim, use_bias=False)(x)
 
 
@@ -134,15 +185,8 @@ class MFConv(nn.Module):
     def __call__(self, x: jnp.ndarray, ctx: EdgeContext) -> jnp.ndarray:
         n, fin = x.shape
         ndeg = self.max_degree + 1
-        agg = S.segment_sum(
-            _gather_senders(x, ctx), ctx.receivers, n,
-            mask=ctx.edge_mask, indices_are_sorted=True,
-        )
-        if ctx.in_degree is not None:
-            deg = ctx.in_degree.astype(jnp.int32)
-        else:
-            deg = S.node_degree(ctx.receivers, n, mask=ctx.edge_mask).astype(jnp.int32)
-        deg = jnp.clip(deg, 0, self.max_degree)
+        agg = _segment_sum_edges(_gather_senders(x, ctx), ctx, n)
+        deg = jnp.clip(_edge_count(ctx, n).astype(jnp.int32), 0, self.max_degree)
 
         # init parity with the reference: PyG MFConv holds one torch
         # Linear per degree — lins_l with kaiming-uniform weights
@@ -187,10 +231,7 @@ class CGConv(nn.Module):
         z = jnp.concatenate(z, axis=-1)
         gate = jax.nn.sigmoid(nn.Dense(self.out_dim)(z))
         core = jax.nn.softplus(nn.Dense(self.out_dim)(z))
-        agg = S.segment_sum(
-            gate * core, ctx.receivers, x.shape[0],
-            mask=ctx.edge_mask, indices_are_sorted=True,
-        )
+        agg = _segment_sum_edges(gate * core, ctx, x.shape[0]).astype(x.dtype)
         return x + agg
 
 
@@ -303,7 +344,10 @@ class PNAConv(nn.Module):
         if dense:
             nslots = ctx.dense_senders.shape[1]
             flat = ctx.dense_senders.reshape(-1)
-            v = S.gather_rows_permuted(bsend, flat, ctx.dense_sender_perm, n)
+            if ctx.dense_sender_win is not None and _local_kernels():
+                v = S.gather_rows_local(bsend, flat, ctx.dense_sender_win, n)
+            else:
+                v = S.gather_rows_permuted(bsend, flat, ctx.dense_sender_perm, n)
             if use_edge:
                 v = v + nn.Dense(fin)(ctx.dense_edge_attr) @ w[2 * fin :]
             v3 = v.reshape(n, nslots, fin)
@@ -343,14 +387,41 @@ class PNAConv(nn.Module):
             v = _gather_senders(bsend, ctx)
             if use_edge:
                 v = v + nn.Dense(fin)(ctx.edge_attr) @ w[2 * fin :]
-            vsum, vsumsq, cnt, both = pna_aggregate(
-                v, ctx.receivers, n, mask=ctx.edge_mask, indices_are_sorted=True
-            )
-            if ctx.in_degree is not None:
-                # chassis-precomputed degree (searchsorted over the
-                # sorted receivers): the aggregate's own count scatter
-                # then has no consumer and XLA dead-code-eliminates it
-                cnt = ctx.in_degree
+            if ctx.run_align:
+                # Run-aligned pre-reduction (graph/batch.py run_align):
+                # every aggregation statistic first collapses K-fold
+                # with fused elementwise passes, then the segment ops
+                # run on E/K rows — the serial scatter-max that
+                # dominated the r04 trace (6 x ~9 ms at E=699k) costs
+                # 1/K, and the fused K1/K2 backward kernels are
+                # replaced by plain AD through broadcasts + the
+                # E/K-scale segment VJPs.
+                K = ctx.run_align
+                m = ctx.edge_mask[:, None]
+                vf = jnp.where(m, v, 0).astype(jnp.float32)
+                sum8 = vf.reshape(-1, K, fin).sum(axis=1)
+                sumsq8 = (vf * vf).reshape(-1, K, fin).sum(axis=1)
+                recv8 = ctx.receivers[::K]
+                pair = S.segment_sum_sorted(
+                    jnp.concatenate([sum8, sumsq8], axis=-1), recv8, n
+                )
+                vsum, vsumsq = pair[:, :fin], pair[:, fin:]
+                neg = jnp.finfo(v.dtype).min
+                both_e = jnp.where(m, jnp.concatenate([v, -v], axis=-1), neg)
+                both8 = both_e.reshape(-1, K, 2 * fin).max(axis=1)
+                both = S.segment_max(
+                    both8, recv8, n, indices_are_sorted=True, empty_value=0.0
+                )
+                cnt = _edge_count(ctx, n)
+            else:
+                vsum, vsumsq, cnt, both = pna_aggregate(
+                    v, ctx.receivers, n, mask=ctx.edge_mask, indices_are_sorted=True
+                )
+                if ctx.in_degree is not None:
+                    # chassis-precomputed degree (searchsorted over the
+                    # sorted receivers): the aggregate's own count scatter
+                    # then has no consumer and XLA dead-code-eliminates it
+                    cnt = ctx.in_degree
             max_v = both[:, :fin]
             min_v = -both[:, fin:]
         # mean/var formed in f32 (both paths accumulate f32); cast back
@@ -373,7 +444,14 @@ class PNAConv(nn.Module):
         ]
         agg = jnp.concatenate(aggs, axis=-1)  # [N, 4*fin]
 
-        deg = jnp.maximum(cnt, 1.0).astype(v.dtype)
+        # Padding-node slots: cnt counts their masked edges (thousands at
+        # flagship scale), and an ungated 'linear' scaler would amplify
+        # the padding rows by ~deg/avg_deg — bounded-magnitude garbage
+        # only because downstream consumers mask padding nodes. Gate on
+        # node_mask so padding rows scale by exactly 1 (r03 advisor).
+        deg = jnp.where(
+            ctx.node_mask, jnp.maximum(cnt, 1.0), 1.0
+        ).astype(v.dtype)
         log_deg = jnp.log(deg + 1.0)[:, None]
         amplification = log_deg / self.avg_deg_log
         attenuation = self.avg_deg_log / log_deg
@@ -438,10 +516,7 @@ class CFConv(nn.Module):
 
         h = nn.Dense(self.num_filters, use_bias=False, kernel_init=xavier)(x)
         msg = _gather_senders(h, ctx) * w
-        agg = S.segment_sum(
-            msg, ctx.receivers, x.shape[0],
-            mask=ctx.edge_mask, indices_are_sorted=True,
-        )
+        agg = _segment_sum_edges(msg, ctx, x.shape[0]).astype(x.dtype)
         return nn.Dense(self.out_dim, kernel_init=xavier)(agg)
 
 

@@ -151,7 +151,10 @@ def _family_kernel(block_ptr_ref, msg_hbm, recv_hbm,
     aligned (Mosaic tiling) and stray edges from neighbouring blocks are
     excluded by the one-hot receiver match itself. Chunks are
     DOUBLE-BUFFERED (see :func:`_csr_chunk_loop`)."""
-    _csr_chunk_loop(block_ptr_ref, msg_hbm, recv_hbm,
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(0)
+    _csr_chunk_loop(block_ptr_ref[i], block_ptr_ref[i + 1], msg_hbm, recv_hbm,
                     msg_vmem, recv_vmem, sems, sum_ref, sumsq_ref)
 
 
@@ -161,20 +164,38 @@ def _sum_kernel(block_ptr_ref, msg_hbm, recv_hbm, sum_ref,
     — serves the VJP hot paths (gather backwards, extremum tie counts)
     where only a plain segment sum is needed. Shares the DMA/one-hot
     structure via :func:`_csr_chunk_loop`."""
-    _csr_chunk_loop(block_ptr_ref, msg_hbm, recv_hbm,
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(0)
+    _csr_chunk_loop(block_ptr_ref[i], block_ptr_ref[i + 1], msg_hbm, recv_hbm,
                     msg_vmem, recv_vmem, sems, sum_ref, None)
 
 
-def _csr_chunk_loop(block_ptr_ref, msg_hbm, recv_hbm,
+def _sum_local_kernel(win_ref, msg_hbm, recv_hbm, sum_ref,
+                      msg_vmem, recv_vmem, sems):
+    """Segment sum for UNSORTED-BUT-LOCAL ids: block i's edges are not
+    contiguous, but the caller guarantees every edge whose id falls in
+    rows [i*BN, (i+1)*BN) lies inside the edge-position window
+    [win[0, i], win[1, i]) (host-precomputed — ``graph/batch.py`` emits
+    it from the batch's block structure). The window may contain stray
+    edges of neighbouring blocks; the one-hot id match excludes them,
+    exactly like the CE-aligned DMA overhang in the sorted kernel."""
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(0)
+    _csr_chunk_loop(win_ref[0, i], win_ref[1, i], msg_hbm, recv_hbm,
+                    msg_vmem, recv_vmem, sems, sum_ref, None)
+
+
+def _csr_chunk_loop(lo, hi, msg_hbm, recv_hbm,
                     msg_vmem, recv_vmem, sems, sum_ref, sumsq_ref):
     """Shared double-buffered CSR chunk loop: accumulate the one-hot
-    matmul into ``sum_ref`` (and ``sumsq_ref`` when not None)."""
+    matmul over edge positions [lo, hi) into ``sum_ref`` (and
+    ``sumsq_ref`` when not None)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     i = pl.program_id(0)
-    lo = block_ptr_ref[i]
-    hi = block_ptr_ref[i + 1]
     sum_ref[:] = jnp.zeros_like(sum_ref)
     if sumsq_ref is not None:
         sumsq_ref[:] = jnp.zeros_like(sumsq_ref)
@@ -457,6 +478,100 @@ def segment_sum_pallas(
     return _SUM_OP(data, segment_ids, num_segments, interpret)
 
 
+@functools.partial(jax.jit, static_argnames=("num_segments", "interpret"))
+def segment_sum_local_pallas(
+    data: jnp.ndarray,
+    segment_ids: jnp.ndarray,
+    win: jnp.ndarray,
+    num_segments: int,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Segment sum for UNSORTED ids with host-provided per-node-block
+    edge windows — the scatter-add of a batched-graph sender axis
+    without the [E, H] permute a sorted reduction needs (the permute
+    row-gather is serial on TPU: ~7.4 ms at E=699k, r03 trace).
+
+    ``win`` is int32 [2, ceil(num_segments_padded / BN)]: every edge e
+    with ``segment_ids[e] // BN == i`` must satisfy
+    ``win[0, i] <= e < win[1, i]``. Windows of different blocks may
+    overlap (stray ids are excluded by the kernel's one-hot match);
+    empty blocks use lo == hi. ``graph/batch.py:_block_windows`` emits
+    it from the batch structure, where locality is guaranteed because
+    each graph's nodes and edges are contiguous."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    e, h = data.shape
+    n_pad = ((num_segments + BN - 1) // BN) * BN
+    n_blocks = n_pad // BN
+    if win.shape != (2, n_blocks):
+        raise ValueError(
+            f"win shape {win.shape} != (2, {n_blocks}) for "
+            f"num_segments={num_segments} (BN={BN})"
+        )
+    if data.dtype != jnp.bfloat16:
+        data = data.astype(jnp.float32)
+    e_pad = ((e + CE - 1) // CE) * CE
+    data = jnp.concatenate([data, jnp.zeros((e_pad - e, h), data.dtype)], axis=0)
+    ids = jnp.concatenate(
+        [segment_ids.astype(jnp.int32), jnp.full((e_pad - e,), n_pad, jnp.int32)]
+    )
+    vma = _vma_of(data, ids)
+    data = _match_vma(data, vma)
+    ids = _match_vma(ids, vma)
+    win = _match_vma(win.astype(jnp.int32), vma)
+    out_sds = jax.ShapeDtypeStruct((n_pad, h), jnp.float32, vma=vma)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_blocks,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=[pl.BlockSpec((BN, h), lambda i, ptr: (i, 0))],
+        scratch_shapes=[
+            pltpu.VMEM((2, CE, h), data.dtype),
+            pltpu.VMEM((2, 1, CE), jnp.int32),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+    )
+    (out,) = pl.pallas_call(
+        _sum_local_kernel,
+        out_shape=[out_sds],
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(win, data, ids[None, :])
+    return out[:num_segments]
+
+
+def segment_sum_local_fast(
+    data: jnp.ndarray,
+    segment_ids: jnp.ndarray,
+    win: Optional[jnp.ndarray],
+    num_segments: int,
+) -> jnp.ndarray:
+    """Dispatcher for the local-window segment sum: Pallas kernel when
+    the window plan is present and the knob/backend allow it (window
+    locality substitutes for the sorted contract), XLA's unsorted
+    scatter-add otherwise. Accumulates f32; returns f32 like
+    :func:`segment_sum_fast`."""
+    if win is not None and data.ndim == 2:
+        h = _narrow_kernel_width(data, indices_are_sorted=True)
+        if h is not None:
+            return segment_sum_local_pallas(
+                _lane_pad(data), segment_ids, win, num_segments,
+                interpret=_interpret_mode(),
+            )[:, :h]
+        if _use_pallas(data, indices_are_sorted=True):
+            return segment_sum_local_pallas(
+                data, segment_ids, win, num_segments,
+                interpret=_interpret_mode(),
+            )
+    return jax.ops.segment_sum(
+        data.astype(jnp.float32), segment_ids, num_segments
+    )
+
+
 # ---------------------------------------------------------------------------
 # CSR broadcast (sorted-ids row gather): out[e] = table[ids[e]]
 # ---------------------------------------------------------------------------
@@ -555,7 +670,7 @@ def _window_gather_acc(scal_ref, table_hbm, recv_ref, win_vmem, acc_ref, sems):
 
 
 def _window_plan(recv, e, n_pad_t, n_chunks):
-    """Host-side per-chunk window plan (scalar-prefetch operand for
+    """Per-chunk window plan (scalar-prefetch operand for
     :func:`_window_gather_acc`): [astart; wcnt; n_clamp] as int32
     [3, n_chunks]. ``recv`` is the CE-padded sorted id vector whose
     sentinels are >= ``n_pad_t`` (outside every logical window)."""
@@ -563,6 +678,25 @@ def _window_plan(recv, e, n_pad_t, n_chunks):
     astart = first & ~jnp.int32(ALIGN - 1)
     last_real = jnp.minimum(recv[CE - 1 :: CE][:n_chunks], recv[e - 1])
     wcnt = jnp.maximum(1, (last_real + 1 - astart + BW - 1) // BW)
+    return jnp.stack(
+        [astart, wcnt, jnp.full((n_chunks,), n_pad_t - BW, jnp.int32)]
+    ).astype(jnp.int32)
+
+
+def _window_plan_local(recv, n_pad_t, n_chunks):
+    """Window plan for UNSORTED ids: per-chunk min/max via a fused
+    [n_chunks, CE] reshape reduction (the sorted plan's strided-slice
+    shortcut assumes monotonicity). Correct for arbitrary ids; FAST
+    only when each chunk's ids span a narrow row range — true for
+    batched graphs, whose senders are confined to their graph's
+    contiguous node block. Sentinel ids (>= n_pad_t) never match a
+    window row (windows are clamped to n_pad_t - BW), so only the min
+    needs guarding against them."""
+    chunks = recv[: n_chunks * CE].reshape(n_chunks, CE)
+    lo = jnp.min(chunks, axis=1)
+    hi = jnp.minimum(jnp.max(chunks, axis=1), n_pad_t - 1)
+    astart = lo & ~jnp.int32(ALIGN - 1)
+    wcnt = jnp.maximum(1, (hi + 1 - astart + BW - 1) // BW)
     return jnp.stack(
         [astart, wcnt, jnp.full((n_chunks,), n_pad_t - BW, jnp.int32)]
     ).astype(jnp.int32)
@@ -579,8 +713,11 @@ def _bcast_kernel(scal_ref, table_hbm, recv_ref, out_ref,
     out_ref[:] = acc_ref[:].astype(out_ref.dtype)
 
 
-def _bcast_kernel_call(table, ids, interpret):
-    """Shard-local sorted-row-gather kernel invocation."""
+def _bcast_kernel_call(table, ids, interpret, sorted_ids=True):
+    """Shard-local windowed-row-gather kernel invocation. ``sorted_ids``
+    picks the window-plan flavour: strided-slice shortcut for sorted
+    ids, chunk min/max (:func:`_window_plan_local`) for unsorted-but-
+    local ids — the kernel itself is id-order agnostic."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -599,7 +736,10 @@ def _bcast_kernel_call(table, ids, interpret):
         [ids.astype(jnp.int32), jnp.full((e_pad - e,), n_pad, jnp.int32)]
     )
     n_chunks = e_pad // CE
-    scal = _window_plan(recv, e, n_pad, n_chunks)
+    if sorted_ids:
+        scal = _window_plan(recv, e, n_pad, n_chunks)
+    else:
+        scal = _window_plan_local(recv, n_pad, n_chunks)
     vma = _vma_of(recv, table)
     table = _match_vma(table, vma)
     recv = _match_vma(recv, vma)
@@ -634,22 +774,22 @@ def _make_partitioned_bcast():
     path); the table is replicated and each device gathers its local
     rows. Output follows the ids' edge sharding; no collective."""
 
-    def base(table, ids, interpret):
-        return _bcast_kernel_call(table, ids, interpret)
+    def base(table, ids, interpret, sorted_ids=True):
+        return _bcast_kernel_call(table, ids, interpret, sorted_ids)
 
-    op = custom_partitioning(base, static_argnums=(2,))
+    op = custom_partitioning(base, static_argnums=(2, 3))
 
-    def infer(interpret, mesh, arg_shapes, result_shape):
+    def infer(interpret, sorted_ids, mesh, arg_shapes, result_shape):
         ids_spec = arg_shapes[1].sharding.spec
         edge_axis = ids_spec[0] if len(ids_spec) >= 1 else None
         return NamedSharding(mesh, P(edge_axis, None))
 
-    def partition(interpret, mesh, arg_shapes, result_shape):
+    def partition(interpret, sorted_ids, mesh, arg_shapes, result_shape):
         ids_spec = arg_shapes[1].sharding.spec
         edge_axis = ids_spec[0] if len(ids_spec) >= 1 else None
 
         def lower_fn(table, ids):
-            return _bcast_kernel_call(table, ids, interpret)
+            return _bcast_kernel_call(table, ids, interpret, sorted_ids)
 
         arg_sh = (
             NamedSharding(mesh, P(None, None)),
@@ -681,9 +821,25 @@ def gather_rows_sorted_fast(table: jnp.ndarray, ids: jnp.ndarray) -> jnp.ndarray
         return table[ids]
     h = _narrow_kernel_width(table, indices_are_sorted=True)
     if h is not None:
-        return _BCAST_OP(_lane_pad(table), ids, _interpret_mode())[:, :h]
+        return _BCAST_OP(_lane_pad(table), ids, _interpret_mode(), True)[:, :h]
     if _use_pallas(table, indices_are_sorted=True):
-        return _BCAST_OP(table, ids, _interpret_mode())
+        return _BCAST_OP(table, ids, _interpret_mode(), True)
+    return table[ids]
+
+
+def gather_rows_local_fast(table: jnp.ndarray, ids: jnp.ndarray) -> jnp.ndarray:
+    """``table[ids]`` for UNSORTED-BUT-LOCAL ids (each CE-chunk of ids
+    spans a narrow row range — batched-graph senders): the windowed
+    bcast kernel with the chunk-min/max plan. Plain indexing off-TPU.
+    NOT differentiated, like :func:`gather_rows_sorted_fast` — callers
+    pair it with the local-window segment sum backward."""
+    if ids.shape[0] == 0 or table.ndim != 2:
+        return table[ids]
+    h = _narrow_kernel_width(table, indices_are_sorted=True)
+    if h is not None:
+        return _BCAST_OP(_lane_pad(table), ids, _interpret_mode(), False)[:, :h]
+    if _use_pallas(table, indices_are_sorted=True):
+        return _BCAST_OP(table, ids, _interpret_mode(), False)
     return table[ids]
 
 
@@ -701,6 +857,16 @@ def _kernel_eligible(indices_are_sorted: bool) -> bool:
     if knob == "1":
         return jax.default_backend() == "tpu"
     return indices_are_sorted and jax.default_backend() == "tpu"
+
+
+def local_kernel_active() -> bool:
+    """Trace-time: would the local-window kernel pair actually lower to
+    Pallas here? Callers holding BOTH a window plan and a sorted perm
+    (the model chassis) use this to pick the local path only when it
+    wins — on forced-XLA paths (vmap'd dp_edge step, non-TPU backends)
+    the sorted-permute fallback beats the unsorted scatter-add the
+    local fallback would pay."""
+    return _kernel_eligible(indices_are_sorted=True)
 
 
 def _use_pallas(data: jnp.ndarray, indices_are_sorted: bool) -> bool:

@@ -134,7 +134,13 @@ def place_giant_batch(mesh: Mesh, batch):
     chip: O(E/D) edge buffers + O(N) node buffers.
 
     The edge pad is rounded up to a mesh multiple first (a ``P(data)``
-    placement requires divisibility); the extra slots are masked padding."""
+    placement requires divisibility); the extra slots are masked padding.
+
+    The loader's local-window plans (``sender_win``/``dense_sender_win``)
+    are stripped: they index GLOBAL edge positions, and the local-window
+    kernel has no partitioning rule — the model then falls back to the
+    sorted-permute path, whose ops all partition."""
+    batch = batch.replace(sender_win=None, dense_sender_win=None)
     d = int(mesh.shape[DATA_AXIS])
     e = batch.senders.shape[0]
     if e % d:
@@ -176,6 +182,11 @@ def place_dp_edge_batch(mesh: Mesh, batch):
     shardings = {}
     for f in _dc.fields(batch):
         v = getattr(batch, f.name)
+        if f.metadata.get("static"):
+            # static pytree meta (run_align): pass the value through —
+            # it is part of the treedef, not a shardable leaf
+            shardings[f.name] = v
+            continue
         sh = dp_edge if f.name in edge_fields else dp
         shardings[f.name] = jax.tree_util.tree_map(lambda _: sh, v)
     return jax.device_put(batch, type(batch)(**shardings))

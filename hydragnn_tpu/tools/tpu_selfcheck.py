@@ -111,6 +111,44 @@ def check_kernels() -> bool:
             good = bool(np.array_equal(np.asarray(out), np.asarray(table[ids])))
             (_ok if good else _fail)(f"bcast_{tag}_{dtype.__name__}")
             ok &= good
+    # SUBNORMAL table rows (r03 advisor): the extremum backward's tie
+    # detection (data == gather(out)) relies on the f32 HIGHEST
+    # 3x-bf16-split matmul being bit-exact — an MXU generation that
+    # flushes subnormals in the split would silently drop extremum
+    # gradients for affected segments. Gate it at startup: a table mixing
+    # subnormals, smallest-normal multiples, and zeros must roundtrip.
+    sub = np.zeros((256, 128), dtype=np.float32)
+    tiny = np.float32(1e-45)  # smallest subnormal
+    sub[::3] = tiny * rng.integers(1, 100, (86, 128)).astype(np.float32)
+    sub[1::3] = np.float32(1.1754944e-38) * rng.normal(size=(85, 128)).astype(
+        np.float32
+    )
+    ids = jnp.asarray(np.sort(rng.integers(0, 256, 2048)).astype(np.int32))
+    table = jnp.asarray(sub)
+    out = _bcast_kernel_call(table, ids, interpret=False)
+    good = bool(np.array_equal(np.asarray(out), np.asarray(table[ids])))
+    (_ok if good else _fail)("bcast_subnormal_f32")
+    ok &= good
+    # local-window variant (r04: unsorted-but-local ids — the sender
+    # gather/scatter path): bit-exact gather + exact-sum scatter
+    from hydragnn_tpu.ops.segment_pallas import segment_sum_local_pallas
+    from hydragnn_tpu.graph.batch import _block_windows
+
+    g_of = np.sort(rng.integers(0, 64, 20_000))
+    lsend = (g_of * 80 + rng.integers(0, 80, 20_000)).astype(np.int32)
+    lperm = np.argsort(lsend, kind="stable").astype(np.int32)
+    win = jnp.asarray(_block_windows(lsend, lperm, 5136))
+    ltab = jnp.asarray(rng.normal(size=(5136, 128)).astype(np.float32))
+    lout = _bcast_kernel_call(ltab, jnp.asarray(lsend), False, False)
+    good = bool(np.array_equal(np.asarray(lout), np.asarray(ltab[lsend])))
+    (_ok if good else _fail)("bcast_local_unsorted_f32")
+    ok &= good
+    data = jnp.asarray(rng.normal(size=(20_000, 128)).astype(np.float32))
+    ssum = segment_sum_local_pallas(data, jnp.asarray(lsend), win, 5136)
+    sref = jax.ops.segment_sum(data, jnp.asarray(lsend), 5136)
+    good = _allclose(ssum, sref, 1e-5, 1e-4)
+    (_ok if good else _fail)("segment_sum_local_f32")
+    ok &= good
     return ok
 
 

@@ -1,0 +1,175 @@
+"""Round-trip test for the reference sharded-pickle importer.
+
+Builds a fixture in the EXACT layout SimplePickleWriter emits
+(reference: hydragnn/utils/pickledataset.py:74-146): <label>-meta.pkl
+with 5 sequential pickles + one pickle per sample — each sample a
+torch_geometric-style ``Data`` whose pickle bytes carry the real
+``torch_geometric.data.data`` module path (faked via sys.modules, since
+torch_geometric is deliberately not a dependency here), tensors packed
+with the reference's y/y_loc head table
+(serialized_dataset_loader.py:262-303)."""
+
+import os
+import pickle
+import sys
+import types
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from hydragnn_tpu.data.container import ContainerDataset
+from hydragnn_tpu.data.import_reference import (
+    ReferencePickleReader,
+    import_pickle_dataset,
+)
+
+
+def _install_fake_pyg():
+    """Register minimal torch_geometric.data.data / .storage modules so
+    pickles carry the genuine PyG class paths."""
+    if "torch_geometric" in sys.modules:
+        return
+    tg = types.ModuleType("torch_geometric")
+    tg_data = types.ModuleType("torch_geometric.data")
+    tg_data_data = types.ModuleType("torch_geometric.data.data")
+    tg_storage = types.ModuleType("torch_geometric.data.storage")
+
+    class GlobalStorage:
+        def __init__(self, mapping):
+            self._mapping = dict(mapping)
+
+        # mirror BaseStorage pickling: plain __dict__ state
+        def __getstate__(self):
+            return {"_mapping": self._mapping}
+
+        def __setstate__(self, state):
+            self.__dict__.update(state)
+
+    class Data:
+        def __init__(self, **kwargs):
+            self._store = GlobalStorage(kwargs)
+
+        def __getstate__(self):
+            return {"_store": self._store}
+
+        def __setstate__(self, state):
+            self.__dict__.update(state)
+
+    GlobalStorage.__module__ = "torch_geometric.data.storage"
+    GlobalStorage.__qualname__ = "GlobalStorage"
+    Data.__module__ = "torch_geometric.data.data"
+    Data.__qualname__ = "Data"
+    tg_data_data.Data = Data
+    tg_storage.GlobalStorage = GlobalStorage
+    tg.data = tg_data
+    tg_data.data = tg_data_data
+    tg_data.storage = tg_storage
+    sys.modules["torch_geometric"] = tg
+    sys.modules["torch_geometric.data"] = tg_data
+    sys.modules["torch_geometric.data.data"] = tg_data_data
+    sys.modules["torch_geometric.data.storage"] = tg_storage
+    return Data
+
+
+def _write_fixture(basedir, label, n_samples, use_subdir=False, nmax_persubdir=2):
+    Data = _install_fake_pyg() or sys.modules["torch_geometric.data.data"].Data
+    rng = np.random.default_rng(7)
+    os.makedirs(basedir, exist_ok=True)
+    truth = []
+    for k in range(n_samples):
+        n = int(rng.integers(3, 7))
+        x = rng.standard_normal((n, 3)).astype(np.float32)
+        pos = rng.standard_normal((n, 3)).astype(np.float32)
+        # ring graph, receiver-major enough for determinism
+        send = np.arange(n, dtype=np.int64)
+        recv = (send + 1) % n
+        ei = np.stack([send, recv])
+        # reference packed y: one graph head (dim 1) + one node head (dim 1)
+        g_y = rng.standard_normal(1).astype(np.float32)
+        n_y = rng.standard_normal((n, 1)).astype(np.float32)
+        y = np.concatenate([g_y, n_y.reshape(-1)])[:, None]
+        y_loc = np.array([[0, 1, 1 + n]], dtype=np.int64)
+        d = Data(
+            x=torch.from_numpy(x),
+            pos=torch.from_numpy(pos),
+            edge_index=torch.from_numpy(ei),
+            y=torch.from_numpy(y),
+            y_loc=torch.from_numpy(y_loc),
+        )
+        fname = f"{label}-{k}.pkl"
+        if use_subdir:
+            sub = os.path.join(basedir, str(k // nmax_persubdir))
+            os.makedirs(sub, exist_ok=True)
+            path = os.path.join(sub, fname)
+        else:
+            path = os.path.join(basedir, fname)
+        with open(path, "wb") as f:
+            pickle.dump(d, f)
+        truth.append((x, pos, ei, g_y, n_y))
+    minmax_node = torch.from_numpy(rng.standard_normal((2, 3)).astype(np.float32))
+    with open(os.path.join(basedir, f"{label}-meta.pkl"), "wb") as f:
+        pickle.dump(minmax_node, f)
+        pickle.dump(None, f)
+        pickle.dump(n_samples, f)
+        pickle.dump(use_subdir, f)
+        pickle.dump(nmax_persubdir, f)
+    return truth
+
+
+@pytest.mark.parametrize("use_subdir", [False, True])
+def test_reader_matches_fixture(tmp_path, use_subdir):
+    basedir = str(tmp_path / "pkl")
+    truth = _write_fixture(basedir, "trainset", 5, use_subdir=use_subdir)
+    # drop the fake modules: the reader must not need them
+    for m in list(sys.modules):
+        if m.startswith("torch_geometric"):
+            del sys.modules[m]
+    reader = ReferencePickleReader(basedir, "trainset")
+    assert len(reader) == 5
+    samples = reader.samples(head_types=["graph", "node"], head_names=["energy", "charge"])
+    for s, (x, pos, ei, g_y, n_y) in zip(samples, truth):
+        np.testing.assert_allclose(s.x, x, rtol=1e-6)
+        np.testing.assert_allclose(s.pos, pos, rtol=1e-6)
+        np.testing.assert_array_equal(s.edge_index, ei)
+        np.testing.assert_allclose(s.graph_targets["energy"], g_y, rtol=1e-6)
+        np.testing.assert_allclose(s.node_targets["charge"], n_y, rtol=1e-6)
+
+
+def test_import_roundtrip_to_container(tmp_path):
+    basedir = str(tmp_path / "pkl")
+    out = str(tmp_path / "imported.hgc")
+    truth = _write_fixture(basedir, "total", 4)
+    for m in list(sys.modules):
+        if m.startswith("torch_geometric"):
+            del sys.modules[m]
+    n = import_pickle_dataset(
+        basedir, "total", out, head_types=["graph", "node"],
+        head_names=["energy", "charge"],
+    )
+    assert n == 4
+    ds = ContainerDataset(out)
+    assert len(ds) == 4
+    for i, (x, pos, ei, g_y, n_y) in enumerate(truth):
+        s = ds.get(i)
+        np.testing.assert_allclose(s.x, x, rtol=1e-6)
+        np.testing.assert_array_equal(s.edge_index, ei)
+        np.testing.assert_allclose(s.graph_targets["energy"], g_y, rtol=1e-6)
+        np.testing.assert_allclose(s.node_targets["charge"], n_y, rtol=1e-6)
+    ds.close()
+
+
+def test_head_type_inference(tmp_path):
+    """Without explicit head types the node head is recognized by row
+    count divisibility."""
+    basedir = str(tmp_path / "pkl")
+    _write_fixture(basedir, "t", 2)
+    for m in list(sys.modules):
+        if m.startswith("torch_geometric"):
+            del sys.modules[m]
+    reader = ReferencePickleReader(basedir, "t")
+    s = reader.read(0)
+    assert len(s.graph_targets) + len(s.node_targets) == 2
+    node_heads = [v for v in s.node_targets.values()]
+    assert node_heads and node_heads[0].shape[0] == s.num_nodes
