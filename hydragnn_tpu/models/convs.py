@@ -73,10 +73,19 @@ class EdgeContext:
     run_align: int = 0
 
 
-def _local_kernels() -> bool:
+def _local_kernels(n_rows: int) -> bool:
+    """Trace-time gate for the local-window gather/scatter pair: the
+    kernels carry a fixed per-call cost (window plan + grid setup) that
+    only pays off when the serial alternative is large — measured on
+    v5e: 811k-row flagship wins big, 61k-row qm9 dense LOSES 8.2 vs
+    3.4 ms scan-step (tools/ab_qm9.py). Below the threshold the
+    permuted-sorted path is faster."""
+    import os
+
     from hydragnn_tpu.ops.segment_pallas import local_kernel_active
 
-    return local_kernel_active()
+    min_rows = int(os.environ.get("HYDRAGNN_LOCAL_MIN_ROWS", 200_000))
+    return n_rows >= min_rows and local_kernel_active()
 
 
 def _run_presum(vals: jnp.ndarray, ctx: EdgeContext) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -129,7 +138,7 @@ def _gather_senders(x: jnp.ndarray, ctx: EdgeContext) -> jnp.ndarray:
     local-window kernel pair when the loader emitted block windows AND
     the kernels lower here (no cotangent permute at all), else the
     permuted sorted segment sum via the chassis ``sender_perm``."""
-    if ctx.sender_win is not None and _local_kernels():
+    if ctx.sender_win is not None and _local_kernels(ctx.senders.shape[0]):
         return S.gather_rows_local(x, ctx.senders, ctx.sender_win, x.shape[0])
     if ctx.sender_perm is not None:
         return S.gather_rows_permuted(x, ctx.senders, ctx.sender_perm, x.shape[0])
@@ -344,7 +353,7 @@ class PNAConv(nn.Module):
         if dense:
             nslots = ctx.dense_senders.shape[1]
             flat = ctx.dense_senders.reshape(-1)
-            if ctx.dense_sender_win is not None and _local_kernels():
+            if ctx.dense_sender_win is not None and _local_kernels(flat.shape[0]):
                 v = S.gather_rows_local(bsend, flat, ctx.dense_sender_win, n)
             else:
                 v = S.gather_rows_permuted(bsend, flat, ctx.dense_sender_perm, n)
@@ -406,9 +415,14 @@ class PNAConv(nn.Module):
                     jnp.concatenate([sum8, sumsq8], axis=-1), recv8, n
                 )
                 vsum, vsumsq = pair[:, :fin], pair[:, fin:]
+                # two group-maxes over v instead of one over a
+                # materialized [E', 2H] concat (the concat fusion was
+                # 1.04 GB/layer in the r04 trace); the E/K-level concat
+                # is bandwidth-trivial
                 neg = jnp.finfo(v.dtype).min
-                both_e = jnp.where(m, jnp.concatenate([v, -v], axis=-1), neg)
-                both8 = both_e.reshape(-1, K, 2 * fin).max(axis=1)
+                vmax8 = jnp.where(m, v, neg).reshape(-1, K, fin).max(axis=1)
+                vneg8 = jnp.where(m, -v, neg).reshape(-1, K, fin).max(axis=1)
+                both8 = jnp.concatenate([vmax8, vneg8], axis=-1)
                 both = S.segment_max(
                     both8, recv8, n, indices_are_sorted=True, empty_value=0.0
                 )

@@ -143,16 +143,44 @@ def unittest_train_model(
     error, error_rmse_task, true_values, predicted_values = run_prediction(
         config2, samples=samples2, log_dir=log_dir
     )
+    heads = []
     for ihead in range(model.cfg.num_heads):
         error_head_rmse = float(error_rmse_task[ihead])
-        assert error_head_rmse < thresholds[0], (
-            f"{model_type} head {ihead} RMSE {error_head_rmse} >= {thresholds[0]}"
-        )
         mae = float(np.mean(np.abs(true_values[ihead] - predicted_values[ihead])))
-        assert mae < thresholds[1], (
-            f"{model_type} head {ihead} sample MAE {mae} >= {thresholds[1]}"
+        heads.append({"rmse": error_head_rmse, "mae": mae})
+    _report_matrix_case(model_type, multihead, mutate, thresholds, heads)
+    for ihead, h in enumerate(heads):
+        assert h["rmse"] < thresholds[0], (
+            f"{model_type} head {ihead} RMSE {h['rmse']} >= {thresholds[0]}"
+        )
+        assert h["mae"] < thresholds[1], (
+            f"{model_type} head {ihead} sample MAE {h['mae']} >= {thresholds[1]}"
         )
     return history
+
+
+def _report_matrix_case(model_type, multihead, mutate, thresholds, heads):
+    """Append one acceptance-matrix case to HYDRAGNN_MATRIX_REPORT
+    (JSONL) — the committed per-round evidence that the full matrix
+    trains to the reference thresholds (VERDICT r03 item 2). Appending
+    BEFORE the asserts records failures too."""
+    path = os.environ.get("HYDRAGNN_MATRIX_REPORT")
+    if not path:
+        return
+    import json
+
+    rec = {
+        "model": model_type,
+        "multihead": bool(multihead),
+        "variant": getattr(mutate, "__name__", None) if mutate else "default",
+        "thresholds_rmse_mae": list(thresholds),
+        "heads": heads,
+        "ok": all(
+            h["rmse"] < thresholds[0] and h["mae"] < thresholds[1] for h in heads
+        ),
+    }
+    with open(path, "a") as f:
+        f.write(json.dumps(rec) + "\n")
 
 
 @pytest.mark.parametrize("model_type", ["GIN", "PNA"])
