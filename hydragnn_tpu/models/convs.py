@@ -407,24 +407,38 @@ class PNAConv(nn.Module):
                 # E/K-scale segment VJPs.
                 K = ctx.run_align
                 m = ctx.edge_mask[:, None]
+                # Narrow widths run at LANE width on TPU: a [E', fin<8]
+                # elementwise chain uses ~fin/128 of each VPU tile
+                # (conv_0's fin=1 backward measured 7 GB/s, r04 trace);
+                # zero columns ride along and are sliced off after the
+                # segment ops.
+                lane_w = fin
+                if fin % 128 and jax.default_backend() == "tpu":
+                    lane_w = (fin + 127) // 128 * 128
+                    v = jnp.concatenate(
+                        [v, jnp.zeros((v.shape[0], lane_w - fin), v.dtype)], axis=1
+                    )
                 vf = jnp.where(m, v, 0).astype(jnp.float32)
-                sum8 = vf.reshape(-1, K, fin).sum(axis=1)
-                sumsq8 = (vf * vf).reshape(-1, K, fin).sum(axis=1)
+                sum8 = vf.reshape(-1, K, lane_w).sum(axis=1)
+                sumsq8 = (vf * vf).reshape(-1, K, lane_w).sum(axis=1)
                 recv8 = ctx.receivers[::K]
                 pair = S.segment_sum_sorted(
                     jnp.concatenate([sum8, sumsq8], axis=-1), recv8, n
                 )
-                vsum, vsumsq = pair[:, :fin], pair[:, fin:]
+                vsum, vsumsq = pair[:, :fin], pair[:, lane_w : lane_w + fin]
                 # two group-maxes over v instead of one over a
                 # materialized [E', 2H] concat (the concat fusion was
                 # 1.04 GB/layer in the r04 trace); the E/K-level concat
                 # is bandwidth-trivial
                 neg = jnp.finfo(v.dtype).min
-                vmax8 = jnp.where(m, v, neg).reshape(-1, K, fin).max(axis=1)
-                vneg8 = jnp.where(m, -v, neg).reshape(-1, K, fin).max(axis=1)
+                vmax8 = jnp.where(m, v, neg).reshape(-1, K, lane_w).max(axis=1)
+                vneg8 = jnp.where(m, -v, neg).reshape(-1, K, lane_w).max(axis=1)
                 both8 = jnp.concatenate([vmax8, vneg8], axis=-1)
                 both = S.segment_max(
                     both8, recv8, n, indices_are_sorted=True, empty_value=0.0
+                )
+                both = jnp.concatenate(
+                    [both[:, :fin], both[:, lane_w : lane_w + fin]], axis=-1
                 )
                 cnt = _edge_count(ctx, n)
             else:

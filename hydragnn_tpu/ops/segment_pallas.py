@@ -68,8 +68,18 @@ import numpy as np
 from jax.experimental.custom_partitioning import custom_partitioning
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-BN = 128  # output rows (nodes) per grid step
-CE = 512  # edges DMA'd per inner chunk
+# Grid tile sizes, env-overridable for on-chip tuning (tools/tune_tiles.py):
+# larger tiles amortize per-grid-step overhead (the r04 flagship trace
+# shows ~1 ms kernel calls moving only ~0.2 GB — overhead-bound), at the
+# cost of VMEM and wasted work on boundary blocks.
+BN = int(os.environ.get("HYDRAGNN_BN", 128))  # output rows (nodes) per grid step
+CE = int(os.environ.get("HYDRAGNN_CE", 512))  # edges DMA'd per inner chunk
+if BN % 16 or CE % 16 or BN <= 0 or CE <= 0:
+    raise ValueError(
+        f"HYDRAGNN_BN={BN} / HYDRAGNN_CE={CE} must be positive multiples of "
+        "16 (Mosaic tiling: HBM slice starts and output blocks must stay "
+        "tile-aligned — a misaligned value fails deep in kernel lowering)"
+    )
 
 _FORCE_XLA = contextvars.ContextVar("hydragnn_force_xla_segment_ops", default=False)
 
@@ -603,7 +613,15 @@ def segment_sum_local_fast(
 # bandwidth instead of looping rows. Exactness: each output row is
 # 1.0 * table_row summed once — exact for bf16 inputs with f32
 # accumulation; f32 inputs use HIGHEST (the f32-as-3xbf16 split times
-# exact 1.0 reconstructs exactly).
+# exact 1.0 reconstructs exactly) — for |x| >= ~1e-30. Below that the
+# split's residual terms progressively fall under bf16's NORMAL floor
+# and flush (measured v5e decay: ~2^-16 rel by 1e-33, ~2^-8 rel by
+# 3e-36); below bf16's min normal (1.18e-38) the hi term itself
+# flushes and the value reads back exactly 0 (gated by
+# tools/tpu_selfcheck.py:bcast_tiny_magnitude_f32). Consequence for
+# the extremum backward's tie detection (data == gather(out)):
+# segments whose extremum magnitude is below ~1e-30 can drop their
+# extremum gradient — numerically-negligible in any real training.
 
 ALIGN = 16  # window starts/sizes are 16-row aligned: Mosaic must prove
 # HBM slice starts divisible by the tiling — 8 rows for f32, 16 for

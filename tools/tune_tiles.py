@@ -1,0 +1,74 @@
+"""Sweep kernel tile sizes (HYDRAGNN_BN x HYDRAGNN_CE) on the flagship
+step, traced device time per setting (subprocess per setting — the
+constants bake at import). Usage: python tools/tune_tiles.py"""
+
+import json
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CHILD = r"""
+import glob, os, shutil, sys, time
+sys.path.insert(0, %(here)r)
+from hydragnn_tpu.utils.platform import pin_platform_from_env
+pin_platform_from_env()
+import jax, jax.numpy as jnp, numpy as np
+from hydragnn_tpu.flagship import build_flagship
+from hydragnn_tpu.train import create_train_state, make_train_step, select_optimizer
+
+config, model, variables, loader = build_flagship(
+    n_samples=1280, hidden_dim=128, num_conv_layers=6, batch_size=1024,
+    unit_cells=(2, 4),
+)
+tx = select_optimizer(config["NeuralNetwork"]["Training"])
+state = create_train_state(variables, tx)
+step = make_train_step(model, tx, compute_dtype=jnp.bfloat16)
+batch = next(iter(loader))
+compiled = step.lower(state, batch).compile()
+state, loss, _ = compiled(state, batch)
+np.asarray(loss)
+tdir = "/tmp/tune_trace"
+shutil.rmtree(tdir, ignore_errors=True)
+with jax.profiler.trace(tdir):
+    for _ in range(3):
+        state, loss, _ = compiled(state, batch)
+    np.asarray(loss)
+planes = glob.glob(f"{tdir}/**/*.xplane.pb", recursive=True)
+from xprof.convert import raw_to_tool_data as rd
+import json as _json
+data, _ = rd.xspace_to_tool_data(planes, "hlo_stats", {"tqx": "out:csv;"})
+tab = _json.loads(data.decode() if isinstance(data, bytes) else data)
+cols = [c["id"] for c in tab["cols"]]
+i_t = cols.index("total_self_time")
+i_c = cols.index("category")
+tot = pall = 0.0
+for r in tab["rows"]:
+    t = float((r["c"][i_t] or {}).get("v") or 0)
+    tot += t
+    if (r["c"][i_c] or {}).get("v") == "custom-call":
+        pall += t
+print(f"RESULT device={tot/3e3:.2f} pallas={pall/3e3:.2f} loss={float(loss):.5f}")
+"""
+
+
+def run(bn, ce):
+    env = dict(os.environ, HYDRAGNN_BN=str(bn), HYDRAGNN_CE=str(ce))
+    out = subprocess.run(
+        [sys.executable, "-c", CHILD % {"here": HERE}],
+        env=env, capture_output=True, text=True, timeout=560,
+    )
+    for line in out.stdout.splitlines():
+        if line.startswith("RESULT"):
+            print(f"BN={bn} CE={ce}: {line[7:]}", flush=True)
+            return
+    print(f"BN={bn} CE={ce}: FAILED\n{out.stderr[-500:]}", flush=True)
+
+
+if __name__ == "__main__":
+    settings = [(128, 512), (256, 512), (256, 1024), (128, 1024), (512, 1024)]
+    if len(sys.argv) > 1:
+        settings = [tuple(map(int, s.split("x"))) for s in sys.argv[1:]]
+    for bn, ce in settings:
+        run(bn, ce)
