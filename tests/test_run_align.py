@@ -1,0 +1,176 @@
+"""Run-aligned edge layout (graph/batch.py run_align) + local-window
+kernel correctness.
+
+The aligned layout changes the EDGE STRUCTURE (masked self-loop padding
+inside receiver runs) while every masked aggregation must stay
+numerically equivalent to the plain layout; these tests pin that
+equivalence at the loader, op, and full-train-step levels (the chip A/B
+measured the speed — tools/ab_align.py; docs/PERF.md r04)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from hydragnn_tpu.data.ingest import prepare_dataset
+from hydragnn_tpu.data.loader import GraphLoader
+from hydragnn_tpu.data.synthetic import deterministic_graph_data
+from hydragnn_tpu.flagship import flagship_config
+from hydragnn_tpu.graph.batch import _block_windows, batch_graphs
+from hydragnn_tpu.models.create import create_model_config
+from hydragnn_tpu.train import create_train_state, make_train_step, select_optimizer
+from hydragnn_tpu.utils.config import update_config
+
+
+def _random_graphs(n_graphs=6, seed=0):
+    rng = np.random.default_rng(seed)
+    gs = []
+    for _ in range(n_graphs):
+        n = int(rng.integers(5, 11))
+        deg = rng.integers(0, 5, n)
+        s, r = [], []
+        for node in range(n):
+            for _ in range(deg[node]):
+                s.append(int(rng.integers(0, n)))
+                r.append(node)
+        if not s:  # keep at least one edge per graph
+            s, r = [0], [1 % n]
+        gs.append(
+            {
+                "x": rng.standard_normal((n, 3)),
+                "senders": np.array(s),
+                "receivers": np.array(r),
+                "edge_attr": rng.standard_normal((len(s), 2)),
+                "graph_targets": {"e": rng.standard_normal(1)},
+            }
+        )
+    return gs
+
+
+def test_aligned_layout_invariants_and_agg_equivalence():
+    gs = _random_graphs()
+    b0 = batch_graphs(gs, dense_slots=None)
+    b8 = batch_graphs(
+        gs,
+        dense_slots=None,
+        run_align=4,
+        n_edge_pad=((b0.num_edges + sum(g["x"].shape[0] for g in gs) * 4) // 4 + 1) * 4,
+    )
+    b8.check_invariants()
+    assert b8.run_align == 4
+
+    # masked aggregation equivalence on real nodes
+    def agg(b):
+        d = jnp.where(b.edge_mask[:, None], b.edge_attr, 0)
+        out = jax.ops.segment_sum(d, b.receivers, b.num_nodes)
+        return np.asarray(out)[np.asarray(b.node_mask)]
+
+    np.testing.assert_allclose(agg(b0), agg(b8), rtol=1e-6)
+    # real in-degree equivalence
+    d0 = np.asarray(b0.in_degree)[np.asarray(b0.node_mask)]
+    d8 = np.asarray(b8.in_degree)[np.asarray(b8.node_mask)]
+    np.testing.assert_array_equal(d0, d8)
+    # every K-group shares one receiver among its REAL slots
+    K = b8.run_align
+    recv = np.asarray(b8.receivers).reshape(-1, K)
+    m = np.asarray(b8.edge_mask).reshape(-1, K)
+    for row, mr in zip(recv, m):
+        if mr.any():
+            assert len(set(row[mr])) == 1
+    # masked-at-real edges are self-loops
+    send = np.asarray(b8.senders)
+    emask = np.asarray(b8.edge_mask)
+    nmask = np.asarray(b8.node_mask)
+    masked_real = ~emask & nmask[np.asarray(b8.receivers)]
+    assert np.array_equal(send[masked_real], np.asarray(b8.receivers)[masked_real])
+
+
+def test_pna_train_step_aligned_matches_plain():
+    """Full PNA train steps on the two layouts stay loss-equivalent
+    (reassociation-level differences only)."""
+    config = flagship_config(32, 3, 16)
+    samples = deterministic_graph_data(number_configurations=40, seed=0)
+    train, val, test, _, _ = prepare_dataset(samples, config)
+    config = update_config(config, train, val, test)
+    tx = select_optimizer(config["NeuralNetwork"]["Training"])
+
+    losses = {}
+    model = state0 = None
+    for tag, ra in (("plain", False), ("aligned", 8)):
+        loader = GraphLoader(
+            train, 16, shuffle=False, drop_last=True, dense_slots=None, run_align=ra
+        )
+        b = next(iter(loader))
+        if model is None:
+            model, variables = create_model_config(config["NeuralNetwork"], b)
+            state0 = create_train_state(variables, tx)
+        step = make_train_step(model, tx)
+        st = jax.tree_util.tree_map(jnp.copy, state0)
+        ls = []
+        for _ in range(3):
+            st, loss, _ = step(st, b)
+            ls.append(float(loss))
+        losses[tag] = ls
+    np.testing.assert_allclose(losses["plain"], losses["aligned"], rtol=2e-4)
+
+
+def test_local_window_kernels_interpret():
+    """gather_rows_local / segment_sum_local vs plain indexing / XLA
+    segment_sum, interpret mode (the real-chip gate lives in
+    tpu_selfcheck)."""
+    os.environ["HYDRAGNN_PALLAS"] = "interpret"
+    os.environ["HYDRAGNN_LOCAL_MIN_ROWS"] = "0"
+    try:
+        from hydragnn_tpu.graph.segment import gather_rows_local
+        from hydragnn_tpu.ops.segment_pallas import segment_sum_local_pallas
+
+        rng = np.random.default_rng(1)
+        N, E, H = 1024, 4000, 128
+        g_of = np.sort(rng.integers(0, 16, E))
+        senders = (g_of * 64 + rng.integers(0, 64, E)).astype(np.int32)
+        perm = np.argsort(senders, kind="stable").astype(np.int32)
+        win = jnp.asarray(_block_windows(senders, perm, N))
+        x = jnp.asarray(rng.standard_normal((N, H)).astype(np.float32))
+        s = jnp.asarray(senders)
+        ct = jnp.asarray(rng.standard_normal((E, H)).astype(np.float32))
+
+        out = gather_rows_local(x, s, win, N)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(x)[senders])
+
+        grad = jax.grad(lambda x: (gather_rows_local(x, s, win, N) * ct).sum())(x)
+        ref = jax.grad(lambda x: (x[s] * ct).sum())(x)
+        np.testing.assert_allclose(np.asarray(grad), np.asarray(ref), atol=2e-5)
+
+        ssum = segment_sum_local_pallas(ct, s, win, N, interpret=True)
+        sref = jax.ops.segment_sum(ct, s, N)
+        np.testing.assert_allclose(np.asarray(ssum), np.asarray(sref), atol=2e-5)
+    finally:
+        os.environ.pop("HYDRAGNN_PALLAS", None)
+        os.environ.pop("HYDRAGNN_LOCAL_MIN_ROWS", None)
+
+
+def test_loader_auto_run_align_and_pad_plan():
+    """AUTO: run_align engages when the dense map is off, pad plan is a
+    K multiple covering aligned worst case; explicit conflict raises."""
+    gs = _random_graphs(12, seed=3)
+    from hydragnn_tpu.data.dataset import GraphSample
+
+    samples = [
+        GraphSample(
+            x=g["x"].astype(np.float32),
+            edge_index=np.stack([g["senders"], g["receivers"]]).astype(np.int32),
+            graph_targets={"e": g["graph_targets"]["e"].astype(np.float32)},
+        )
+        for g in gs
+    ]
+    loader = GraphLoader(samples, 4, dense_slots=None, run_align=True)
+    assert loader.run_align == 8
+    assert loader.pad_edges % 8 == 0
+    b = next(iter(loader))
+    assert b.run_align == 8
+    b.check_invariants()
+    with pytest.raises(ValueError):
+        GraphLoader(samples, 4, dense_slots=4, run_align=8)

@@ -18,10 +18,17 @@ import jax, jax.numpy as jnp, numpy as np
 from hydragnn_tpu.flagship import build_flagship
 from hydragnn_tpu.train import create_train_state, make_train_step, select_optimizer
 
-config, model, variables, loader = build_flagship(
-    n_samples=1280, hidden_dim=128, num_conv_layers=6, batch_size=1024,
-    unit_cells=(2, 4),
-)
+import os as _os
+if _os.environ.get("TUNE_CONFIG") == "large":
+    config, model, variables, loader = build_flagship(
+        n_samples=48, hidden_dim=128, num_conv_layers=6, batch_size=32,
+        unit_cells=(6, 8),
+    )
+else:
+    config, model, variables, loader = build_flagship(
+        n_samples=1280, hidden_dim=128, num_conv_layers=6, batch_size=1024,
+        unit_cells=(2, 4),
+    )
 tx = select_optimizer(config["NeuralNetwork"]["Training"])
 state = create_train_state(variables, tx)
 step = make_train_step(model, tx, compute_dtype=jnp.bfloat16)
