@@ -17,6 +17,10 @@ from hydragnn_tpu.data.smiles import (
     parse_smiles,
 )
 from hydragnn_tpu.data.atomic_descriptors import atomicdescriptors
+from hydragnn_tpu.data.import_reference import (
+    ReferencePickleReader,
+    import_pickle_dataset,
+)
 
 __all__ = [
     "radius_graph",
@@ -37,4 +41,6 @@ __all__ = [
     "mol_from_smiles",
     "parse_smiles",
     "atomicdescriptors",
+    "ReferencePickleReader",
+    "import_pickle_dataset",
 ]
