@@ -187,6 +187,16 @@ class GraphLoader:
                 self.pad_edges = _round_up(
                     max(sum(worst) + 1, self.pad_edges), mult
                 )
+        # Local-window block target: sized to the DATASET's mean graph
+        # (capped by the [B, H] VMEM accumulator), so one kernel block
+        # covers whole graphs and large graphs don't re-scan their edge
+        # window per 128-row block (docs/PERF.md r04). Derived from
+        # all_samples — like the pad plan — so every batch (and every
+        # host) emits identically-shaped windows.
+        mean_nodes = int(
+            sum(s.num_nodes for s in self.all_samples) / max(len(self.all_samples), 1)
+        )
+        self.win_block_rows = min(512, _round_up(max(mean_nodes, 128), 128))
         self._dicts = samples_to_graph_dicts(self.samples)
 
     def set_epoch(self, epoch: int) -> None:
@@ -237,6 +247,7 @@ class GraphLoader:
             n_graph_pad=self.pad_graphs,
             dense_slots=self.dense_slots,
             run_align=self.run_align,
+            win_block_rows=self.win_block_rows,
         )
 
     def _make_batch(self, chunk: Sequence[int]) -> GraphBatch:
@@ -409,10 +420,10 @@ def max_in_degree(samples) -> int:
     return worst
 
 
-def _bn() -> int:
-    from hydragnn_tpu.ops.segment_pallas import BN
+def _block_rows(batch: GraphBatch, win) -> int:
+    from hydragnn_tpu.ops.segment_pallas import local_block_rows
 
-    return BN
+    return local_block_rows(batch.num_nodes, win.shape[1])
 
 
 def _mask_out(batch: GraphBatch) -> GraphBatch:
@@ -439,7 +450,7 @@ def _mask_out(batch: GraphBatch) -> GraphBatch:
             )
         if batch.dense_sender_win is not None:
             w = _np.zeros_like(_np.asarray(batch.dense_sender_win))
-            w[1, pad_slot // _bn()] = batch.dense_senders.size
+            w[1, pad_slot // _block_rows(batch, w)] = batch.dense_senders.size
             dense["dense_sender_win"] = w
     derived = {}
     if batch.sender_perm is not None:
@@ -449,7 +460,7 @@ def _mask_out(batch: GraphBatch) -> GraphBatch:
         derived["in_degree"] = _np.zeros(batch.num_nodes, dtype=_np.float32)
     if batch.sender_win is not None:
         w = _np.zeros_like(_np.asarray(batch.sender_win))
-        w[1, pad_slot // _bn()] = batch.num_edges
+        w[1, pad_slot // _block_rows(batch, w)] = batch.num_edges
         derived["sender_win"] = w
     return batch.replace(
         senders=_np.full_like(_np.asarray(batch.senders), pad_slot),

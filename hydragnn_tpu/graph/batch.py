@@ -30,11 +30,21 @@ def _round_up(x: int, multiple: int) -> int:
     return ((x + multiple - 1) // multiple) * multiple
 
 
-def _block_windows(ids: np.ndarray, perm: np.ndarray, num_rows: int) -> np.ndarray:
+def _block_windows(
+    ids: np.ndarray,
+    perm: np.ndarray,
+    num_rows: int,
+    target_rows: Optional[int] = None,
+) -> np.ndarray:
     """Host-side per-node-block position windows [2, n_blocks] for the
-    local-window kernels: every position p with ``ids[p] // BN == i``
-    satisfies ``win[0, i] <= p < win[1, i]``. ``perm`` must be a stable
-    argsort of ``ids`` (already on the batch).
+    local-window kernels: every position p with ``ids[p] // B == i``
+    satisfies ``win[0, i] <= p < win[1, i]``, where B is derived from
+    (num_rows, n_blocks) by the SAME formula the kernel uses
+    (ops/segment_pallas.py:local_block_rows) — the block size rides
+    the window shape. ``perm`` must be a stable argsort of ``ids``.
+    ``target_rows`` sizes blocks to the batch's typical graph so large
+    graphs don't re-scan their edge window once per 128-row block
+    (docs/PERF.md r04).
 
     Windows are ALWAYS emitted (a data-dependent None would make the
     pytree structure vary per batch — breaking device_stack stacking
@@ -45,13 +55,15 @@ def _block_windows(ids: np.ndarray, perm: np.ndarray, num_rows: int) -> np.ndarr
     wide windows — slower, never wrong (the one-hot match filters
     strays). The giant-graph path strips windows before GSPMD sharding
     (parallel/edge_sharded.py:place_giant_batch)."""
-    from hydragnn_tpu.ops.segment_pallas import BN
+    from hydragnn_tpu.ops.segment_pallas import BN, local_block_rows
 
-    n_blocks = _round_up(max(num_rows, 1), BN) // BN
+    t = target_rows or BN
+    n_blocks = max(1, (max(num_rows, 1) + t - 1) // t)
+    b_eff = local_block_rows(num_rows, n_blocks)
     lo = np.zeros(n_blocks, dtype=np.int64)
     hi = np.zeros(n_blocks, dtype=np.int64)
     if ids.size:
-        sblk = ids[perm] // BN  # sorted ids -> sorted block ids
+        sblk = ids[perm] // b_eff  # sorted ids -> sorted block ids
         starts = np.searchsorted(sblk, np.arange(n_blocks), side="left")
         ends = np.searchsorted(sblk, np.arange(n_blocks), side="right")
         ne = ends > starts
@@ -119,15 +131,19 @@ class GraphBatch:
     dense_sender_perm: Optional[jnp.ndarray] = None  # [N*D] int32
     # Per-node-block edge-position windows for the local-window Pallas
     # kernels (ops/segment_pallas.py:segment_sum_local_pallas): every
-    # edge e with senders[e] // BN == i lies in [win[0,i], win[1,i]).
-    # Tight for batched graphs (graph g's senders live in g's
-    # contiguous node block); lets the sender-gather backward scatter
-    # WITHOUT the [E, H] cotangent permute. batch_graphs ALWAYS emits
-    # them (pathological id layouts just get wide, slow-but-correct
-    # windows); None only for externally-built batches and the
-    # GSPMD-sharded giant-graph path, which strips them.
-    sender_win: Optional[jnp.ndarray] = None  # [2, ceil(N/BN)] int32
-    dense_sender_win: Optional[jnp.ndarray] = None  # [2, ceil(N/BN)] int32
+    # edge e with senders[e] // B == i lies in [win[0,i], win[1,i]),
+    # where B = local_block_rows(num_nodes, win.shape[1]) — the block
+    # size is DERIVED from the window shape, identically by the emitter
+    # (_block_windows) and the kernel; external producers must use the
+    # same derivation. Tight for batched graphs (graph g's senders
+    # live in g's contiguous node block); lets the sender-gather
+    # backward scatter WITHOUT the [E, H] cotangent permute.
+    # batch_graphs ALWAYS emits them (pathological id layouts just get
+    # wide, slow-but-correct windows); None only for externally-built
+    # batches and the GSPMD-sharded giant-graph path, which strips
+    # them.
+    sender_win: Optional[jnp.ndarray] = None  # [2, n_blocks] int32
+    dense_sender_win: Optional[jnp.ndarray] = None  # [2, n_blocks] int32
     # STATIC (pytree meta): run-aligned edge layout factor. When K > 0,
     # every node's receiver-run is padded to a multiple of K with MASKED
     # self-loop edges (sender = receiver = the node), so every K-group
@@ -220,10 +236,11 @@ class GraphBatch:
         ):
             if win is None or ids is None:
                 continue
-            from hydragnn_tpu.ops.segment_pallas import BN
+            from hydragnn_tpu.ops.segment_pallas import local_block_rows
 
             w = np_.asarray(win)
-            blk = ids // BN
+            b_eff = local_block_rows(self.num_nodes, w.shape[1])
+            blk = ids // b_eff
             pos = np_.arange(ids.shape[0])
             lo, hi = w[0][blk], w[1][blk]
             assert np_.all((pos >= lo) & (pos < hi)), (
@@ -240,6 +257,7 @@ def batch_graphs(
     edge_multiple: int = 8,
     dense_slots: Optional[int] = None,
     run_align: int = 0,
+    win_block_rows: Optional[int] = None,
 ) -> GraphBatch:
     """Concatenate a list of single graphs and pad to static shapes.
 
@@ -453,9 +471,16 @@ def batch_graphs(
     in_degree = np.bincount(
         receivers[edge_mask], minlength=n_node_pad
     ).astype(np.float32)
-    sender_win = _block_windows(senders, sender_perm, n_node_pad)
+    # ``win_block_rows`` must be BATCH-INDEPENDENT for a fixed pad plan
+    # (the loader derives it once from dataset-wide stats): window
+    # shapes are part of the pytree structure, and a per-batch
+    # data-dependent target would break device_stack stacking and flap
+    # the jit cache. Default BN keeps standalone callers stable.
+    sender_win = _block_windows(senders, sender_perm, n_node_pad, win_block_rows)
     dense_sender_win = (
-        _block_windows(dense_senders.reshape(-1), dense_sender_perm, n_node_pad)
+        _block_windows(
+            dense_senders.reshape(-1), dense_sender_perm, n_node_pad, win_block_rows
+        )
         if dense_sender_perm is not None
         else None
     )
@@ -555,37 +580,82 @@ def pad_batch(batch: GraphBatch, n_node: int, n_edge: int, n_graph: int) -> Grap
         )
 
     def _extend_win(win, n_appended, old_len, new_len):
-        """Appended tail positions all carry id pad_node_id: widen that
-        block's window to cover [old_len, new_len) (lo stays — it is
-        <= old_len unless the block was empty)."""
+        """dn == 0: block boundaries are unchanged (the kernel derives
+        the block size from (num_segments, n_blocks), both fixed), so
+        only the pad-node block's window widens to cover the appended
+        tail positions. dn > 0 changes the derived block size —
+        callers rebuild windows on host instead (below)."""
         if win is None:
             return None
-        from hydragnn_tpu.ops.segment_pallas import BN
+        from hydragnn_tpu.ops.segment_pallas import local_block_rows
 
-        n_blocks = (n_node + BN - 1) // BN
-        if win.shape[1] < n_blocks:
-            win = jnp.concatenate(
-                [win, jnp.zeros((2, n_blocks - win.shape[1]), win.dtype)], axis=1
-            )
         if n_appended <= 0:
             return win
-        b = pad_node_id // BN
+        b_eff = local_block_rows(batch.num_nodes, win.shape[1])
+        b = pad_node_id // b_eff
         empty = win[0, b] == win[1, b]
         lo = jnp.where(empty, old_len, jnp.minimum(win[0, b], old_len))
         win = win.at[0, b].set(lo.astype(win.dtype))
         return win.at[1, b].set(new_len)
 
-    sender_win = _extend_win(
-        batch.sender_win, de, batch.num_edges, n_edge
-    )
-    dense_sender_win = batch.dense_sender_win
-    if dense_sender_win is not None and batch.dense_senders is not None:
-        dense_sender_win = _extend_win(
-            dense_sender_win,
-            dn * batch.dense_senders.shape[1],
-            batch.dense_senders.size,
-            batch.dense_senders.size + dn * batch.dense_senders.shape[1],
-        )
+    if dn > 0 and (batch.sender_win is not None or batch.dense_sender_win is not None):
+        # growing the node axis changes the derived block size; rebuild
+        # the plans on host, PRESERVING the original block granularity
+        # (derived back from the old window shape). pad_batch with
+        # node growth therefore requires concrete (host) arrays —
+        # strip the windows first to pad under a trace (the GSPMD
+        # giant path already does).
+        import numpy as _np
+
+        from hydragnn_tpu.ops.segment_pallas import local_block_rows
+
+        if isinstance(batch.senders, jax.core.Tracer):
+            raise ValueError(
+                "pad_batch cannot grow the node axis of a TRACED batch "
+                "carrying window plans (the block size must be re-derived "
+                "on host); replace(sender_win=None, dense_sender_win=None) "
+                "before padding under jit/vmap"
+            )
+        if batch.sender_win is not None and sender_perm is not None:
+            target = local_block_rows(batch.num_nodes, batch.sender_win.shape[1])
+            sender_win = jnp.asarray(
+                _block_windows(
+                    _np.asarray(pad0(batch.senders, de, pad_node_id)),
+                    _np.asarray(sender_perm),
+                    n_node,
+                    target,
+                )
+            )
+        else:
+            # a window without its perm (exotic external batch): the
+            # consumers' fallback chain handles a None window correctly
+            sender_win = None
+        if (
+            batch.dense_sender_win is not None
+            and batch.dense_senders is not None
+            and dense_sender_perm is not None
+        ):
+            target = local_block_rows(
+                batch.num_nodes, batch.dense_sender_win.shape[1]
+            )
+            new_dense = _np.asarray(
+                pad0(batch.dense_senders, dn, pad_node_id)
+            ).reshape(-1)
+            dense_sender_win = jnp.asarray(
+                _block_windows(new_dense, _np.asarray(dense_sender_perm), n_node, target)
+            )
+        else:
+            dense_sender_win = None
+    else:
+        sender_win = _extend_win(batch.sender_win, de, batch.num_edges, n_edge)
+        dense_sender_win = batch.dense_sender_win
+        if dense_sender_win is not None and batch.dense_senders is not None:
+            dense_sender_win = _extend_win(
+                dense_sender_win,
+                dn * batch.dense_senders.shape[1],
+                batch.dense_senders.size,
+                batch.dense_senders.size + dn * batch.dense_senders.shape[1],
+            )
     return batch.replace(
         nodes=pad0(batch.nodes, dn),
         senders=pad0(batch.senders, de, pad_node_id),

@@ -62,10 +62,12 @@ class EdgeContext:
     dense_edge_attr: Optional[jnp.ndarray] = None  # [N*D, De]
     dense_sender_perm: Optional[jnp.ndarray] = None  # [N*D] int32
     # loader-emitted per-node-block position windows (graph/batch.py:
-    # _block_windows): when present, sender gathers ride the windowed
-    # kernels in BOTH directions — no cotangent permute in the backward
-    sender_win: Optional[jnp.ndarray] = None  # [2, ceil(N/BN)] int32
-    dense_sender_win: Optional[jnp.ndarray] = None  # [2, ceil(N/BN)] int32
+    # _block_windows; block size derived from the window shape — see
+    # GraphBatch.sender_win): when present, sender gathers ride the
+    # windowed kernels in BOTH directions — no cotangent permute in
+    # the backward
+    sender_win: Optional[jnp.ndarray] = None  # [2, n_blocks] int32
+    dense_sender_win: Optional[jnp.ndarray] = None  # [2, n_blocks] int32
     # static: run-aligned edge layout factor (GraphBatch.run_align).
     # K > 0 guarantees every K-group of edge slots shares one receiver
     # (or is batch tail), so segment reductions pre-reduce K-fold with

@@ -185,11 +185,13 @@ def _sum_local_kernel(win_ref, msg_hbm, recv_hbm, sum_ref,
                       msg_vmem, recv_vmem, sems):
     """Segment sum for UNSORTED-BUT-LOCAL ids: block i's edges are not
     contiguous, but the caller guarantees every edge whose id falls in
-    rows [i*BN, (i+1)*BN) lies inside the edge-position window
-    [win[0, i], win[1, i]) (host-precomputed — ``graph/batch.py`` emits
-    it from the batch's block structure). The window may contain stray
-    edges of neighbouring blocks; the one-hot id match excludes them,
-    exactly like the CE-aligned DMA overhang in the sorted kernel."""
+    rows [i*B, (i+1)*B) — B = the out-ref block size, derived from the
+    window shape by :func:`local_block_rows` — lies inside the
+    edge-position window [win[0, i], win[1, i]) (host-precomputed —
+    ``graph/batch.py`` emits it from the batch's block structure). The
+    window may contain stray edges of neighbouring blocks; the one-hot
+    id match excludes them, exactly like the CE-aligned DMA overhang
+    in the sorted kernel."""
     from jax.experimental import pallas as pl
 
     i = pl.program_id(0)
@@ -239,7 +241,10 @@ def _csr_chunk_loop(lo, hi, msg_hbm, recv_hbm,
         for cp in dmas(slot, k):
             cp.wait()
         raw = msg_vmem[slot]
-        rows = jax.lax.broadcasted_iota(jnp.int32, (BN, CE), 0) + i * BN
+        # block size from the output ref itself: BN for the sorted
+        # kernels, the window plan's derived size for the local kernel
+        bn = sum_ref.shape[0]
+        rows = jax.lax.broadcasted_iota(jnp.int32, (bn, CE), 0) + i * bn
         onehot = recv_vmem[slot] == rows
         if raw.dtype == jnp.bfloat16:
             # native-MXU bf16 path: onehot x value products are exact
@@ -517,23 +522,36 @@ def segment_sum_local_pallas(
     without the [E, H] permute a sorted reduction needs (the permute
     row-gather is serial on TPU: ~7.4 ms at E=699k, r03 trace).
 
-    ``win`` is int32 [2, ceil(num_segments_padded / BN)]: every edge e
-    with ``segment_ids[e] // BN == i`` must satisfy
-    ``win[0, i] <= e < win[1, i]``. Windows of different blocks may
+    ``win`` is int32 [2, n_blocks]: every edge e with
+    ``segment_ids[e] // B == i`` must satisfy
+    ``win[0, i] <= e < win[1, i]``, where the block size B =
+    :func:`local_block_rows`(num_segments, n_blocks) — derived
+    identically by the window EMITTER (``graph/batch.py:
+    _block_windows``) and this kernel, so B rides the win SHAPE and
+    needs no extra plumbing. Blocks sized to the batch's typical graph
+    keep large graphs from re-scanning their edge window once per
+    128-row block (docs/PERF.md r04). Windows of different blocks may
     overlap (stray ids are excluded by the kernel's one-hot match);
-    empty blocks use lo == hi. ``graph/batch.py:_block_windows`` emits
-    it from the batch structure, where locality is guaranteed because
-    each graph's nodes and edges are contiguous."""
+    empty blocks use lo == hi. Locality is guaranteed for batched
+    graphs because each graph's nodes and edges are contiguous."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     e, h = data.shape
-    n_pad = ((num_segments + BN - 1) // BN) * BN
-    n_blocks = n_pad // BN
-    if win.shape != (2, n_blocks):
+    n_blocks = int(win.shape[1])
+    BNL = local_block_rows(num_segments, n_blocks)
+    n_pad = n_blocks * BNL
+    # a window plan emitted for a DIFFERENT num_segments derives a
+    # different block size here and would silently drop edges whose
+    # id // BNL disagrees with the emitter's id // B; the minimality
+    # check catches that mismatch class (the emitter always produces
+    # the minimal block count for its derived size)
+    if n_blocks > 1 and (n_blocks - 1) * BNL >= num_segments:
         raise ValueError(
-            f"win shape {win.shape} != (2, {n_blocks}) for "
-            f"num_segments={num_segments} (BN={BN})"
+            f"win has {n_blocks} blocks but num_segments={num_segments} "
+            f"needs at most {(num_segments + BNL - 1) // BNL} at the "
+            f"derived block size {BNL} — the plan was emitted for a "
+            "different num_segments (graph/batch.py:_block_windows)"
         )
     if data.dtype != jnp.bfloat16:
         data = data.astype(jnp.float32)
@@ -554,7 +572,7 @@ def segment_sum_local_pallas(
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
         ],
-        out_specs=[pl.BlockSpec((BN, h), lambda i, ptr: (i, 0))],
+        out_specs=[pl.BlockSpec((BNL, h), lambda i, ptr: (i, 0))],
         scratch_shapes=[
             pltpu.VMEM((2, CE, h), data.dtype),
             pltpu.VMEM((2, 1, CE), jnp.int32),
@@ -568,6 +586,17 @@ def segment_sum_local_pallas(
         interpret=interpret,
     )(win, data, ids[None, :])
     return out[:num_segments]
+
+
+def local_block_rows(num_segments: int, n_blocks: int) -> int:
+    """The local-window kernels' block size, derived from the window
+    plan's SHAPE: the B (multiple of 16 — the same bf16 sublane-tiling
+    envelope the HYDRAGNN_BN guard enforces) with n_blocks * B >=
+    num_segments that both the emitter and the kernel compute from
+    (num_segments, n_blocks) — the contract that lets the host pick
+    graph-sized blocks without extra static plumbing."""
+    b = (num_segments + n_blocks - 1) // n_blocks
+    return ((b + 15) // 16) * 16
 
 
 def segment_sum_local_fast(

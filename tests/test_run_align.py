@@ -174,3 +174,34 @@ def test_loader_auto_run_align_and_pad_plan():
     b.check_invariants()
     with pytest.raises(ValueError):
         GraphLoader(samples, 4, dense_slots=4, run_align=8)
+
+
+def test_device_stack_stacking_with_windows_and_partial_batch():
+    """Window shapes must be identical across sub-batches of one loader
+    (loader-static block target) — including the all-padding filler of
+    a partial final batch — or tree_map(np.stack) would raise
+    (r04 review finding)."""
+    from hydragnn_tpu.data.dataset import GraphSample
+
+    rng = np.random.default_rng(5)
+    samples = []
+    for i in range(10):  # heterogeneous sizes: 4..40 nodes
+        n = int(rng.integers(4, 41))
+        s = np.arange(n)
+        r = (s + 1) % n
+        samples.append(
+            GraphSample(
+                x=rng.standard_normal((n, 3)).astype(np.float32),
+                edge_index=np.stack([s, r]).astype(np.int32),
+                graph_targets={"e": rng.standard_normal(1).astype(np.float32)},
+            )
+        )
+    # batch_size 8 over 10 samples with device_stack 2 -> the last
+    # batch is partial and exercises the _mask_out filler path
+    loader = GraphLoader(samples, 8, device_stack=2, dense_slots=None)
+    batches = list(loader)
+    assert len(batches) == 2
+    for b in batches:
+        # stacked windows: [D=2 devices, 2 (lo/hi), n_blocks]
+        assert b.sender_win.ndim == 3
+        assert b.sender_win.shape[:2] == (2, 2)
