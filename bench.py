@@ -39,6 +39,7 @@ import json
 import os
 import re
 import statistics
+import sys
 import time
 
 
@@ -560,7 +561,56 @@ def main() -> None:
         "peak_bf16_tflops": peak / 1e12 if peak else None,
         "configs": configs,
     }
-    print(json.dumps(record))
+    # The driver captures only a ~2000-char stdout TAIL; the full
+    # per-config blob (several KB) once truncated an entire round's
+    # record mid-object (BENCH_r04 "parsed": null). The full record goes
+    # to a file in-tree; stdout gets a compact single line that always
+    # fits, carrying the headline plus the per-config numbers the
+    # round-over-round tables are built from.
+    here = os.path.dirname(os.path.abspath(__file__))
+    local_path = os.path.join(here, "BENCH_LOCAL.json")
+    try:
+        with open(local_path, "w") as f:
+            json.dump(record, f, indent=1)
+            f.write("\n")
+    except OSError as e:
+        print(f"warning: could not write {local_path}: {e}", file=sys.stderr)
+
+    def _brief(c: dict) -> dict:
+        out = {}
+        for src, dst in (
+            ("graphs_per_sec", "gps"),
+            ("graphs_per_sec_honest", "gps_honest"),
+            ("step_ms", "step_ms"),
+            ("scan_step_ms", "scan_ms"),
+            ("traced_device_ms", "dev_ms"),
+            ("hbm_gbps_measured", "gbps"),
+        ):
+            v = c.get(src)
+            if isinstance(v, (int, float)):
+                out[dst] = round(v, 2)
+        return out
+
+    compact = {
+        "metric": metric,
+        "value": graphs_per_sec,
+        "unit": "graphs/sec",
+        "vs_baseline": round(vs_baseline, 3),
+        "timing": "d2h-sync",
+        "device": record["device"],
+        "dispatch_ms": dispatch_ms,
+        "full_record": "BENCH_LOCAL.json",
+        "summary": {name: _brief(c) for name, c in configs.items()},
+    }
+    line = json.dumps(compact)
+    # belt-and-braces: shed per-config summaries one at a time (last
+    # config first — the flagship headline survives longest) until the
+    # line fits the driver's ~2000-char stdout tail
+    while len(line) > 1800 and compact["summary"]:
+        compact["summary"].pop(next(reversed(compact["summary"])))
+        compact["summary_truncated"] = True
+        line = json.dumps(compact)
+    print(line)
 
 
 if __name__ == "__main__":

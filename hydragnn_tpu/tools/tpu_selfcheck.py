@@ -151,6 +151,42 @@ def check_kernels() -> bool:
     )
     (_ok if good else _fail)("bcast_tiny_magnitude_f32")
     ok &= good
+    # Same decay-band contract for the f32 SUM kernel's 3-term bf16
+    # split (r04 advisor: only the gather was gated). All elements of a
+    # segment share sign and magnitude band here, so the segment sum's
+    # relative error is bounded by the per-element band.
+    seg_ids = jnp.asarray(np.sort(rng.integers(0, 256, 2048)).astype(np.int32))
+    vals = np.zeros((2048, 128), dtype=np.float32)
+    band_of = np.asarray(seg_ids) % 4
+    mags = (1e-28, 1e-34, 1e-36, 1e-39)
+    for j, mag in enumerate(mags):
+        sel = band_of == j
+        vals[sel] = np.float32(mag) * (
+            1 + rng.random((int(sel.sum()), 128)).astype(np.float32)
+        )
+    ssum_tiny = np.asarray(
+        segment_sum_pallas(
+            jnp.asarray(vals), seg_ids, 256, None, indices_are_sorted=True
+        )
+    )
+    sref_tiny = np.asarray(
+        jax.ops.segment_sum(jnp.asarray(vals), seg_ids, 256, indices_are_sorted=True)
+    )
+    seg_band = np.arange(256) % 4
+    amag = np.abs(sref_tiny)
+    err = np.abs(ssum_tiny - sref_tiny)
+    with np.errstate(invalid="ignore", divide="ignore"):
+        rel = np.where(amag > 0, err / np.maximum(amag, 1e-45), 0.0)
+    good = bool(
+        np.all(rel[seg_band == 0] <= 2.0 ** -12)  # all terms normal
+        and np.all(rel[seg_band == 1] <= 2.0 ** -7)  # lo term flushed
+        and np.all(rel[seg_band == 2] <= 2.0 ** -5)  # mid term flushed
+        and np.all(
+            (ssum_tiny[seg_band == 3] == 0) | (rel[seg_band == 3] <= 1.0)
+        )  # below bf16 min normal: clean flush or hi-term remnant
+    )
+    (_ok if good else _fail)("sum_tiny_magnitude_f32")
+    ok &= good
     # local-window variant (r04: unsorted-but-local ids — the sender
     # gather/scatter path): bit-exact gather + exact-sum scatter
     from hydragnn_tpu.ops.segment_pallas import segment_sum_local_pallas

@@ -153,3 +153,46 @@ def test_check_invariants_all_construction_paths():
     bad = b.replace(receivers=jnp.asarray(np.sort(bad_recv)), in_degree=None)
     with pytest.raises(AssertionError):
         bad.check_invariants()
+
+
+def test_loader_debug_mode_catches_corrupt_producer(monkeypatch):
+    """HYDRAGNN_DEBUG_BATCH=1 makes the loader validate every host batch,
+    so a corrupt external sample producer fails loudly instead of
+    silently corrupting aggregations (r03 advisor)."""
+    from hydragnn_tpu.data import loader as loader_mod
+    from hydragnn_tpu.data.dataset import GraphSample
+
+    rng = np.random.default_rng(5)
+    samples = []
+    for _ in range(4):
+        n = 5
+        s = np.arange(n)
+        r = (s + 1) % n
+        samples.append(
+            GraphSample(
+                x=rng.standard_normal((n, 2)).astype(np.float32),
+                edge_index=np.stack([s, r]).astype(np.int32),
+                graph_targets={"e": rng.standard_normal(1).astype(np.float32)},
+            )
+        )
+
+    real_batch_graphs = loader_mod.batch_graphs
+
+    def corrupting_batch_graphs(*args, **kwargs):
+        b = real_batch_graphs(*args, **kwargs)
+        bad_recv = np.asarray(b.receivers).copy()
+        bad_recv[-1] = 0  # tail padding edge retargeted at a real node
+        return b.replace(receivers=jnp.asarray(np.sort(bad_recv)), in_degree=None)
+
+    monkeypatch.setattr(loader_mod, "batch_graphs", corrupting_batch_graphs)
+
+    # default (debug off): the corruption passes through silently
+    monkeypatch.delenv("HYDRAGNN_DEBUG_BATCH", raising=False)
+    ldr = loader_mod.GraphLoader(samples, batch_size=4, prefetch=0)
+    assert len(list(ldr)) == 1
+
+    # debug on: the same producer fails loudly at batch build time
+    monkeypatch.setenv("HYDRAGNN_DEBUG_BATCH", "1")
+    ldr = loader_mod.GraphLoader(samples, batch_size=4, prefetch=0)
+    with pytest.raises(AssertionError):
+        list(ldr)

@@ -9,6 +9,7 @@ torch_geometric is deliberately not a dependency here), tensors packed
 with the reference's y/y_loc head table
 (serialized_dataset_loader.py:262-303)."""
 
+import io
 import os
 import pickle
 import sys
@@ -158,6 +159,33 @@ def test_import_roundtrip_to_container(tmp_path):
         np.testing.assert_allclose(s.graph_targets["energy"], g_y, rtol=1e-6)
         np.testing.assert_allclose(s.node_targets["charge"], n_y, rtol=1e-6)
     ds.close()
+
+
+def test_malicious_globals_are_stubbed(tmp_path):
+    """A pickle that REDUCEs through builtins.eval (or any global off
+    the exact allowlist) must resolve to a harmless stub, never
+    execute."""
+    from hydragnn_tpu.data.import_reference import _Stub, _TolerantUnpickler
+
+    canary = str(tmp_path / "pwned")
+
+    class Evil:
+        def __reduce__(self):
+            return (eval, (f"open({canary!r}, 'w').close()",))
+
+    obj = _TolerantUnpickler(io.BytesIO(pickle.dumps(Evil()))).load()
+    assert isinstance(obj, _Stub)
+    assert not os.path.exists(canary)
+
+    # a whole-module torch path off the exact allowlist is stubbed too
+    class EvilTorch:
+        def __reduce__(self):
+            import torch.serialization
+
+            return (torch.serialization.load, (canary,))
+
+    obj2 = _TolerantUnpickler(io.BytesIO(pickle.dumps(EvilTorch()))).load()
+    assert isinstance(obj2, _Stub)
 
 
 def test_head_type_inference(tmp_path):

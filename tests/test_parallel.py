@@ -179,4 +179,6 @@ def pytest_scaling_harness_loss_parity(monkeypatch):
         size = rec["sizes"][d]
         assert size["loss_matches_serial"], (d, size)
         assert size["graphs_per_sec"] > 0
-        assert size["parallel_efficiency"] > 0
+        # efficiency figures are only published on real hardware — a
+        # virtual CPU mesh's would be meaningless (shared host cores)
+        assert "parallel_efficiency" not in size
