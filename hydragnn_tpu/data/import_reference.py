@@ -19,10 +19,14 @@ Layout read (pickledataset.py):
                                (under <k // nmax_persubdir>/ subdirs when
                                use_subdir)
 
-The ADIOS2 schema (group arrays + per-variable concatenated payloads
-with ragged offsets) needs the adios2 reader library, which is not in
-this image; its schema is documented in PARITY.md and the converter
-raises a clear error pointing at the pickle path for it.
+The ADIOS2 format (group arrays + per-variable concatenated payloads
+with ragged offsets) is handled by the sibling module
+:mod:`hydragnn_tpu.data.adios_reference` — the CLI below dispatches to
+it automatically for ``.bp`` inputs. Reading the BP container needs the
+``adios2`` library (present in reference environments; this package is
+pure Python, so running the importer THERE is a checkout away); without
+it, ``tools/export_adios_to_pickle.py`` is a standalone adios2+numpy
+script that emits the sharded-pickle layout this module consumes.
 
 The reference's ragged ``data.y`` + ``y_loc`` offset table (written by
 serialized_dataset_loader.py:262-303) is unpacked into the dict-of-heads
@@ -351,10 +355,14 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
     import argparse
 
     p = argparse.ArgumentParser(
-        description="Convert a reference HydraGNN sharded-pickle dataset "
-        "into an HGC container."
+        description="Convert a reference HydraGNN dataset (sharded-pickle "
+        "directory or ADIOS2 .bp file) into an HGC container."
     )
-    p.add_argument("basedir", help="directory holding <label>-meta.pkl")
+    p.add_argument(
+        "source",
+        help="sharded-pickle directory holding <label>-meta.pkl, or an "
+        "ADIOS2 .bp file/dir (needs the adios2 library)",
+    )
     p.add_argument("label", help="dataset label (e.g. 'trainset', 'total')")
     p.add_argument("out", help="output .hgc container path")
     p.add_argument(
@@ -367,9 +375,19 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         "--head-name", action="append", help="per-head name, in y_loc order"
     )
     args = p.parse_args(argv)
-    n = import_pickle_dataset(
-        args.basedir, args.label, args.out, args.head_type, args.head_name
+    from hydragnn_tpu.data.adios_reference import (
+        import_adios_dataset,
+        looks_like_adios,
     )
+
+    if looks_like_adios(args.source):
+        n = import_adios_dataset(
+            args.source, args.label, args.out, args.head_type, args.head_name
+        )
+    else:
+        n = import_pickle_dataset(
+            args.source, args.label, args.out, args.head_type, args.head_name
+        )
     print(f"imported {n} samples -> {args.out}")
 
 

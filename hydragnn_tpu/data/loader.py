@@ -183,7 +183,23 @@ class GraphLoader:
             else:
                 sub = batch_size // device_stack
                 worst = sorted(aligned, reverse=True)[:sub]
+                # Align the edge pad so the Pallas kernel grids divide it
+                # evenly at BOTH scales they run on — E rows (gathers /
+                # local sums) and E/K rows (pre-reduced segment ops).
+                # Otherwise every pallas_call input pays a whole-array
+                # pad copy per layer (r05 trace: 6 x 0.63 ms + 2.5 GB of
+                # re-written bf16 [E,H] arrays on the flagship, just to
+                # add 120 rows). Only at scale: for small batches the
+                # in-kernel pad costs microseconds while grid alignment
+                # would multiply E_pad (a 176-edge CI batch would pad to
+                # 4096), bloating memory and perturbing every
+                # accumulation-order-sensitive equivalence test.
+                from hydragnn_tpu.ops.segment_pallas import CE as _kernel_ce
+
+                grid_mult = self.run_align * _kernel_ce
                 mult = math.lcm(edge_multiple, self.run_align)
+                if max(sum(worst) + 1, self.pad_edges) >= 8 * grid_mult:
+                    mult = math.lcm(edge_multiple, grid_mult)
                 self.pad_edges = _round_up(
                     max(sum(worst) + 1, self.pad_edges), mult
                 )

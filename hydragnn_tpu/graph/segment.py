@@ -226,11 +226,12 @@ def segment_softmax(
     return exp / jnp.maximum(denom[segment_ids], 1e-16)
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(2,))
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3))
 def segment_sum_sorted(
     data: jnp.ndarray,
     segment_ids: jnp.ndarray,
     num_segments: int,
+    grad_dtype=None,
 ) -> jnp.ndarray:
     """Differentiable segment sum for SORTED ids on the fast kernel
     path: forward = the Pallas CSR sum kernel (XLA fallback off-TPU),
@@ -238,7 +239,16 @@ def segment_sum_sorted(
     accumulation contract of :func:`segment_sum_fast` applies. Built
     for the run-aligned pre-reduced aggregations
     (models/convs.py:_run_presum), whose forward use needs AD — the
-    raw kernel dispatchers are VJP-internal and not differentiated."""
+    raw kernel dispatchers are VJP-internal and not differentiated.
+
+    ``grad_dtype``: dtype the backward's widening gather travels in
+    (same bandwidth contract as the unaligned family VJP, whose
+    cotangent gathers ride the compute dtype — docs/PERF.md r03). The
+    run-aligned callers pre-reduce in f32 for exact accumulation but
+    consume the gradient in the compute dtype anyway; without this the
+    cotangent gather runs the f32 3-term-split kernel at 6x the cost
+    (r05 trace: 1.50 vs 0.26 ms per layer at E/8 x 2H). None keeps the
+    cotangent dtype."""
     from hydragnn_tpu.ops.segment_pallas import segment_sum_fast
 
     return segment_sum_fast(
@@ -246,12 +256,16 @@ def segment_sum_sorted(
     ).astype(data.dtype)
 
 
-def _segment_sum_sorted_fwd(data, segment_ids, num_segments):
-    return segment_sum_sorted(data, segment_ids, num_segments), segment_ids
+def _segment_sum_sorted_fwd(data, segment_ids, num_segments, grad_dtype):
+    return (
+        segment_sum_sorted(data, segment_ids, num_segments, grad_dtype),
+        segment_ids,
+    )
 
 
-def _segment_sum_sorted_bwd(num_segments, ids, g):
-    grad = _gather_fwd_impl(g, ids, indices_are_sorted=True)
+def _segment_sum_sorted_bwd(num_segments, grad_dtype, ids, g):
+    gd = g if grad_dtype is None else g.astype(grad_dtype)
+    grad = _gather_fwd_impl(gd, ids, indices_are_sorted=True).astype(g.dtype)
     return grad, jnp.zeros(ids.shape, dtype=jax.dtypes.float0)
 
 

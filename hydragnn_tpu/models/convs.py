@@ -111,7 +111,9 @@ def _segment_sum_edges(vals: jnp.ndarray, ctx: EdgeContext, n: int) -> jnp.ndarr
     otherwise. Returns the values' dtype."""
     if ctx.run_align:
         v8, recv8 = _run_presum(vals, ctx)
-        return S.segment_sum_sorted(v8, recv8, n).astype(vals.dtype)
+        return S.segment_sum_sorted(
+            v8, recv8, n, grad_dtype=vals.dtype
+        ).astype(vals.dtype)
     return S.segment_sum(
         vals, ctx.receivers, n, mask=ctx.edge_mask, indices_are_sorted=True
     )
@@ -420,18 +422,25 @@ class PNAConv(nn.Module):
                     v = jnp.concatenate(
                         [v, jnp.zeros((v.shape[0], lane_w - fin), v.dtype)], axis=1
                     )
+                # One pass over the [E', W] edge array per STATISTIC
+                # (not per pair): an r05 experiment packed (vf | vf^2)
+                # and (max | -min) into lane-concats hoping XLA would
+                # fuse the concat into the reshape-reduce and read v
+                # once per pair — it materialized the f32 [E', 2W]
+                # concats instead (110 ms/step vs 77.8, +27 GB/step),
+                # same failure mode as r04's [msg,-msg] concat. Separate
+                # sibling reduces stand.
                 vf = jnp.where(m, v, 0).astype(jnp.float32)
                 sum8 = vf.reshape(-1, K, lane_w).sum(axis=1)
                 sumsq8 = (vf * vf).reshape(-1, K, lane_w).sum(axis=1)
                 recv8 = ctx.receivers[::K]
                 pair = S.segment_sum_sorted(
-                    jnp.concatenate([sum8, sumsq8], axis=-1), recv8, n
+                    jnp.concatenate([sum8, sumsq8], axis=-1),
+                    recv8,
+                    n,
+                    grad_dtype=v.dtype,
                 )
                 vsum, vsumsq = pair[:, :fin], pair[:, lane_w : lane_w + fin]
-                # two group-maxes over v instead of one over a
-                # materialized [E', 2H] concat (the concat fusion was
-                # 1.04 GB/layer in the r04 trace); the E/K-level concat
-                # is bandwidth-trivial
                 neg = jnp.finfo(v.dtype).min
                 vmax8 = jnp.where(m, v, neg).reshape(-1, K, lane_w).max(axis=1)
                 vneg8 = jnp.where(m, -v, neg).reshape(-1, K, lane_w).max(axis=1)

@@ -76,8 +76,16 @@ def make_config(model_type: str, multihead: bool, tmp_dir: str, num_epoch: int =
     if model_type == "CGCNN":
         arch["hidden_dim"] = 1  # CGCNN preserves input width
     if model_type == "SchNet":
-        arch["num_gaussians"] = 10
-        arch["num_filters"] = 8
+        # reference-parity capacity (tests/inputs/ci.json + ci_multihead
+        # .json: num_gaussians 50, num_filters 126). This is load-bearing
+        # for the multihead cell: the "x" node head asks for the raw node
+        # type, which a self-loop-free CFConv stack recovers only through
+        # 2-hop backscatter (i->j->i) — at 8 filters that pathway is too
+        # narrow and the cell plateaus near 0.21 MAE; at the reference's
+        # 126 it trains to ~0.03 RMSE / 0.12 MAE (r05 experiment,
+        # docs/PERF.md "SchNet multihead cell").
+        arch["num_gaussians"] = 50
+        arch["num_filters"] = 126
     return {
         "Verbosity": {"level": 0},
         "Dataset": {

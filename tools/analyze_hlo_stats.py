@@ -11,8 +11,36 @@ Usage: python tools/analyze_hlo_stats.py [/tmp/hlo_stats.csv] [n_steps] [n_top]
 
 import csv
 import json
+import re
 import sys
 from collections import defaultdict
+
+_ITEMSIZE = {
+    "f64": 8, "f32": 4, "bf16": 2, "f16": 2, "s64": 8, "u64": 8,
+    "s32": 4, "u32": 4, "s16": 2, "u16": 2, "s8": 1, "u8": 1, "pred": 1,
+}
+
+_SHAPE_RE = re.compile(
+    r"\b(f64|f32|bf16|f16|s64|u64|s32|u32|s16|u16|s8|u8|pred)\[([\d,]*)\]"
+)
+
+
+def _customcall_bytes(expr: str) -> float:
+    """Operand+result sizes of a custom-call (Pallas kernel). xprof
+    reports no memory BW for custom-calls, so their DMA traffic is
+    invisible to the measured total; the CSR kernels stream each
+    operand exactly once by construction, so the static shape sum is
+    a sound per-op estimate (window-looping chunks can re-read table
+    rows, making this a slight UNDER-estimate on jumpy ids)."""
+    head = expr.split("custom_call_target", 1)[0]
+    total = 0.0
+    for dt, dims in _SHAPE_RE.findall(head):
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * _ITEMSIZE[dt]
+    return total
 
 
 def main():
@@ -39,16 +67,22 @@ def main():
         if t_us <= 0:
             continue
         bw = float(r.get("measured_memory_bw", 0) or 0)  # GiB/s
+        full_expr = str(r.get("hlo_op_expression", "") or "")
+        cat = str(r.get("category", ""))
         rows.append(
             {
                 "op": str(r.get("hlo_op_name", "")),
-                "cat": str(r.get("category", "")),
+                "cat": cat,
                 "tf": str(r.get("tf_op_name", "")),
                 "n": int(float(r.get("occurrences", 1) or 1)),
                 "us": t_us,
                 "bytes": bw * (2**30) * (t_us / 1e6),
+                "kbytes": _customcall_bytes(full_expr)
+                * int(float(r.get("occurrences", 1) or 1))
+                if cat == "custom-call"
+                else 0.0,
                 "bound": str(r.get("bound_by", "")),
-                "expr": str(r.get("hlo_op_expression", "") or "")[:160],
+                "expr": full_expr[:160],
             }
         )
 
@@ -56,11 +90,21 @@ def main():
         raise SystemExit(f"no rows with positive self time parsed from {path}")
     tot_ms = sum(r["us"] for r in rows) / 1e3
     tot_bytes = sum(r["bytes"] for r in rows)
+    kernel_bytes = sum(r["kbytes"] for r in rows)
     print(f"total device self time: {tot_ms:.1f} ms over {n_steps} steps "
           f"-> {tot_ms / n_steps:.1f} ms/step")
     print(f"trace-measured HBM traffic: {tot_bytes / 1e9:.2f} GB "
           f"-> {tot_bytes / n_steps / 1e9:.2f} GB/step "
           f"-> {tot_bytes / (tot_ms / 1e3) / 1e9:.1f} GB/s average")
+    if kernel_bytes:
+        comb = tot_bytes + kernel_bytes
+        print(
+            f"custom-call (Pallas) traffic, est. from operand+result "
+            f"shapes (invisible to xprof BW counters): "
+            f"{kernel_bytes / n_steps / 1e9:.2f} GB/step -> combined "
+            f"{comb / n_steps / 1e9:.2f} GB/step = "
+            f"{comb / (tot_ms / 1e3) / 1e9:.1f} GB/s average"
+        )
     print()
 
     print(f"== top {n_top} ops by self time (ms/step) ==")
@@ -86,6 +130,10 @@ def main():
         "ms_per_step": tot_ms / n_steps,
         "measured_bytes_per_step": tot_bytes / n_steps,
         "measured_hbm_gbps": tot_bytes / (tot_ms / 1e3) / 1e9,
+        "kernel_bytes_est_per_step": kernel_bytes / n_steps,
+        "combined_hbm_gbps_est": (tot_bytes + kernel_bytes)
+        / (tot_ms / 1e3)
+        / 1e9,
         "n_steps": n_steps,
     }
     with open("/tmp/hlo_summary.json", "w") as f:

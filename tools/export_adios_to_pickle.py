@@ -1,0 +1,131 @@
+#!/usr/bin/env python
+"""Export a reference HydraGNN ADIOS2 dataset to the sharded-pickle
+layout (the format hydragnn_tpu.data.import_reference consumes).
+
+STANDALONE by design: depends only on ``adios2`` + ``numpy`` + stdlib,
+so it runs unmodified inside a reference HydraGNN environment (where
+adios2 lives) with no hydragnn_tpu checkout needed. Two-step migration:
+
+    # in the reference environment
+    python export_adios_to_pickle.py gfm_data.bp trainset /tmp/export
+    # in the hydragnn_tpu environment
+    python -m hydragnn_tpu.data.import_reference /tmp/export trainset out.hgc
+
+Schema read (reference hydragnn/utils/adiosdataset.py AdiosWriter.save
+:79-179): per split ``label``, attribute ``{label}/ndata`` + string
+attribute ``{label}/keys``; per key ``k`` a global array ``{label}/{k}``
+concatenated along attribute ``{label}/{k}/variable_dim`` with ragged
+per-sample ``variable_count`` / ``variable_offset`` index arrays.
+
+Layout written (reference hydragnn/utils/pickledataset.py
+SimplePickleWriter :74-146): ``<out>/<label>-meta.pkl`` holding 5
+sequential pickles (minmax_node_feature, minmax_graph_feature, ntotal,
+use_subdir, nmax_persubdir) and one ``<out>/<label>-<k>.pkl`` per
+sample. Samples are written as plain ``{field: ndarray}`` dicts — the
+tolerant importer walks dict state exactly as it walks pickled PyG Data
+state, and plain numpy pickles need no torch at load time.
+"""
+
+import argparse
+import os
+import pickle
+import sys
+
+import numpy as np
+
+
+def _open_adios(filename):
+    try:
+        import adios2
+    except ImportError:
+        raise SystemExit(
+            "this script needs the adios2 python library — run it inside "
+            "the reference HydraGNN environment"
+        )
+    if hasattr(adios2, "FileReader"):  # adios2 >= 2.9
+        return adios2.FileReader(filename)
+    return adios2.open(filename, "r")
+
+
+def export(filename: str, label: str, out_dir: str) -> int:
+    os.makedirs(out_dir, exist_ok=True)
+    f = _open_adios(filename)
+    try:
+        attrs = set(f.available_attributes())
+        if f"{label}/ndata" not in attrs:
+            labels = sorted(
+                a[: -len("/ndata")]
+                for a in attrs
+                if a.endswith("/ndata") and a != "total_ndata"
+            )
+            raise SystemExit(
+                f"label {label!r} not in {filename!r}; available: {labels}"
+            )
+        ndata = int(np.asarray(f.read_attribute(f"{label}/ndata")).reshape(-1)[0])
+        keys = f.read_attribute_string(f"{label}/keys")
+        if isinstance(keys, str):
+            keys = [keys]
+
+        data, count, offset, vdim = {}, {}, {}, {}
+        for k in keys:
+            data[k] = np.asarray(f.read(f"{label}/{k}"))
+            count[k] = (
+                np.asarray(f.read(f"{label}/{k}/variable_count"))
+                .reshape(-1)
+                .astype(np.int64)
+            )
+            offset[k] = (
+                np.asarray(f.read(f"{label}/{k}/variable_offset"))
+                .reshape(-1)
+                .astype(np.int64)
+            )
+            vdim[k] = int(
+                np.asarray(f.read_attribute(f"{label}/{k}/variable_dim")).reshape(-1)[0]
+            )
+
+        minmax_node = (
+            np.asarray(f.read_attribute("minmax_node_feature")).reshape(2, -1)
+            if "minmax_node_feature" in attrs
+            else None
+        )
+        minmax_graph = (
+            np.asarray(f.read_attribute("minmax_graph_feature")).reshape(2, -1)
+            if "minmax_graph_feature" in attrs
+            else None
+        )
+    finally:
+        f.close()
+
+    for idx in range(ndata):
+        sample = {}
+        for k in keys:
+            arr = data[k]
+            sl = [slice(None)] * arr.ndim
+            sl[vdim[k]] = slice(
+                int(offset[k][idx]), int(offset[k][idx] + count[k][idx])
+            )
+            sample[k] = np.ascontiguousarray(arr[tuple(sl)])
+        with open(os.path.join(out_dir, f"{label}-{idx}.pkl"), "wb") as fh:
+            pickle.dump(sample, fh)
+
+    with open(os.path.join(out_dir, f"{label}-meta.pkl"), "wb") as fh:
+        pickle.dump(minmax_node, fh)
+        pickle.dump(minmax_graph, fh)
+        pickle.dump(ndata, fh)
+        pickle.dump(False, fh)  # use_subdir
+        pickle.dump(ndata + 1, fh)  # nmax_persubdir (unused when flat)
+    return ndata
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("bpfile", help="ADIOS2 .bp file/dir written by AdiosWriter")
+    p.add_argument("label", help="split label (trainset / valset / testset)")
+    p.add_argument("out", help="output directory for the pickle layout")
+    args = p.parse_args(argv)
+    n = export(args.bpfile, args.label, args.out)
+    print(f"exported {n} samples -> {args.out}/{args.label}-*.pkl")
+
+
+if __name__ == "__main__":
+    main()
