@@ -141,11 +141,45 @@ def _measured_traffic(compiled, state, batches) -> dict:
                 tot_bytes += bw * (2**30) * (t_us / 1e6)
             if tot_us <= 0:
                 return {}
-            return {
+            out = {
                 "device_step_ms_traced": round(tot_us / 1e3 / 2, 3),
                 "bytes_per_step_measured": round(tot_bytes / 2),
                 "hbm_gbps_measured": round(tot_bytes / (tot_us / 1e6) / 1e9, 1),
             }
+            # xprof reports no memory BW for custom-calls (Pallas
+            # kernels), so their DMA traffic is invisible to the
+            # measured sum; the CSR kernels stream each operand once by
+            # construction, so operand+result shape bytes are a sound
+            # per-op estimate (tools/analyze_hlo_stats.py, r05).
+            # Guarded separately: a converter without these columns must
+            # only cost the NEW fields, not the measurement above.
+            try:
+                try:
+                    from tools.analyze_hlo_stats import _customcall_bytes
+                except ImportError:  # invoked from outside the repo root
+                    sys.path.insert(
+                        0, os.path.dirname(os.path.abspath(__file__))
+                    )
+                    from tools.analyze_hlo_stats import _customcall_bytes
+
+                i_cat = cols.index("category")
+                i_expr = cols.index("hlo_op_expression")
+                i_n = cols.index("occurrences")
+                kernel_bytes = 0.0
+                for row in tab["rows"]:
+                    cells = row["c"]
+                    if ((cells[i_cat] or {}).get("v") or "") == "custom-call":
+                        occ = float((cells[i_n] or {}).get("v") or 1.0)
+                        kernel_bytes += occ * _customcall_bytes(
+                            str((cells[i_expr] or {}).get("v") or "")
+                        )
+                out["kernel_bytes_per_step_est"] = round(kernel_bytes / 2)
+                out["hbm_gbps_combined_est"] = round(
+                    (tot_bytes + kernel_bytes) / (tot_us / 1e6) / 1e9, 1
+                )
+            except Exception:
+                pass
+            return out
         except Exception:
             return {}
     finally:
@@ -166,6 +200,7 @@ def _bench_one(
     bf16: bool = True,
     peak: float | None = None,
     scan: bool = False,
+    scan_also: bool = False,
     measure_bytes: bool = False,
     dispatch_ms: float | None = None,
 ) -> dict:
@@ -299,6 +334,35 @@ def _bench_one(
             # a non-positive slope is noise — don't record garbage
             scan_step_ms = None
 
+    # scan_epoch wall measurement (VERDICT r04 item 5): the whole-epoch
+    # lax.scan dispatch over DEVICE-RESIDENT stacked batches, with the
+    # order tiled across epochs so one dispatch covers >= 64 steps —
+    # this amortizes the tunnel's per-dispatch floor (~60-70 ms) into
+    # noise and yields a WALL number commensurate with traced device
+    # time (r05 qm9: 7.06 ms/step wall at 128 steps/dispatch vs 6.28 ms
+    # traced = 1.12x; a 1-step dispatch reads 71 ms). This is also the
+    # honest production mode for datasets that fit in HBM.
+    scan_epoch_ms = None
+    if scan_also:
+        import jax.numpy as jnp
+
+        from hydragnn_tpu.train import make_scan_epoch
+
+        scan_fn = make_scan_epoch(model, tx, compute_dtype=compute_dtype)
+        nb = len(loader)
+        stacked = loader.stacked_device_batches()
+        reps = max(1, -(-max(measure_steps, 64) // nb))
+        order = jnp.tile(jnp.arange(nb, dtype=jnp.int32), reps)
+        # scan_fn DONATES its state argument (train/state.py); hand it a
+        # copy so `state` stays alive for _measured_traffic below
+        s_state = jax.tree_util.tree_map(jnp.array, state)
+        s_state, losses, _, _ = scan_fn(s_state, stacked, order)  # compile+warm
+        np.asarray(losses)
+        t0 = time.perf_counter()
+        s_state, losses, _, _ = scan_fn(s_state, stacked, order)
+        np.asarray(losses)
+        scan_epoch_ms = (time.perf_counter() - t0) * 1e3 / (nb * reps)
+
     real_nodes = float(
         sum(s.num_nodes for s in loader.samples) / max(len(loader.samples), 1)
     )
@@ -323,6 +387,12 @@ def _bench_one(
     if scan_step_ms is not None:
         out["scan_step_ms"] = round(scan_step_ms, 3)
         out["graphs_per_sec_scan"] = round(batch_size / max(scan_step_ms, 1e-9) * 1e3, 2)
+    if scan_epoch_ms is not None:
+        out["scan_epoch_step_ms"] = round(scan_epoch_ms, 3)
+        out["scan_epoch_steps_per_dispatch"] = nb * reps
+        out["graphs_per_sec_scan_epoch"] = round(
+            batch_size / max(scan_epoch_ms, 1e-9) * 1e3, 2
+        )
     # Dispatch-dominated configs (step < ~2x the tunnel's per-dispatch
     # floor) understate DEVICE throughput by up to 3x; the scan-slope
     # number (same step body, K chained per dispatch) is the honest
@@ -333,6 +403,19 @@ def _bench_one(
     # what the device physically spends is noise, not throughput.
     traced = out.get("device_step_ms_traced")
     if (
+        scan_epoch_ms is not None
+        and dispatch_ms is not None
+        and step_s * 1e3 < 2.0 * dispatch_ms
+    ):
+        # the scan_epoch number is a genuine WALL measurement (>= 64
+        # steps per D2H-fenced dispatch) — it cannot under-run device
+        # time, so no clamp is needed; it supersedes the noisier
+        # scan-slope estimate as the dispatch-dominated headline
+        out["headline_graphs_per_sec"] = round(
+            batch_size / scan_epoch_ms * 1e3, 2
+        )
+        out["headline_protocol"] = "scan_epoch wall (per-step d2h is dispatch-dominated)"
+    elif (
         scan_step_ms is not None
         and dispatch_ms is not None
         and step_s * 1e3 < 2.0 * dispatch_ms
@@ -507,6 +590,10 @@ def main() -> None:
             cache=cache,
             bf16=bf16,
             peak=peak,
+            # qm9's per-step wall is dispatch-floor-dominated (43.5 ms
+            # recorded at r04 against 6.28 ms device); the scan_epoch
+            # wall is the figure that amortizes it
+            scan_also=not smoke,
             measure_bytes=measure_bytes,
             dispatch_ms=dispatch_ms,
         )
@@ -580,10 +667,11 @@ def main() -> None:
         out = {}
         for src, dst in (
             ("graphs_per_sec", "gps"),
-            ("graphs_per_sec_honest", "gps_honest"),
+            ("headline_graphs_per_sec", "gps_headline"),
             ("step_ms", "step_ms"),
             ("scan_step_ms", "scan_ms"),
-            ("traced_device_ms", "dev_ms"),
+            ("scan_epoch_step_ms", "scan_ep_ms"),
+            ("device_step_ms_traced", "dev_ms"),
             ("hbm_gbps_measured", "gbps"),
         ):
             v = c.get(src)

@@ -1,25 +1,39 @@
-"""HLO-derived data-parallel scaling estimate (VERDICT r03 item 4).
+"""HLO-derived distributed scaling estimate (VERDICT r03 item 4 /
+r04 item 6).
 
 Real multi-chip hardware is unavailable here, so instead of ASSUMING a
-DP efficiency factor (BASELINE.md previously used 0.9 with no support),
-this derives one from first principles + the compiled program:
+DP efficiency factor (BASELINE.md previously used 0.9 with no support,
+then a single 8-way-derived 0.997), this derives the scaling model from
+the compiled programs themselves:
 
-  1. jit the FULL flagship train step over an 8-device mesh (virtual
-     CPU devices — the SPMD partitioner emits the same collective
-     structure it would on a TPU pod slice);
-  2. read the per-step all-reduce bytes straight from the compiled
+  1. jit the FULL flagship train step over 8-, 16-, and 32-way data
+     meshes (virtual CPU devices — the SPMD partitioner emits the same
+     collective structure it would on a TPU pod slice);
+  2. read the per-step collective bytes straight from each compiled
      HLO (the gradient all-reduce over the data axis; ring all-reduce
      moves 2(n-1)/n x bytes over ICI per chip);
-  3. convert to expected ICI time on the v5e's public link budget and
-     compare against the measured single-chip step time.
+  3. add the OFF-STEP collectives a training run actually pays — the
+     eval path's padded variable-length all-gather
+     (train/loop.py:_allgather_varlen) and the checkpoint write
+     (utils/checkpoint.py; ZeRO-1 shards write 1/n each) — amortized
+     per step at a stated cadence;
+  4. convert to expected wire time on the v5e/v4 public link budgets,
+     with an optional DCN hop term for data axes spanning multiple ICI
+     slices, and derive per-width DP efficiency;
+  5. project the v4-32 (16-chip) north-star aggregate from the
+     MEASURED single-chip traced step time, bandwidth-scaled to v4's
+     HBM, times the DERIVED 16-way efficiency — replacing BASELINE.md's
+     hand arithmetic.
 
-Writes SCALING_est_r04.json and prints a summary.
+Writes SCALING_est_r05.json and prints it.
 
-ICI budget: the v5e exposes 4 ICI links per chip in a 2D torus
-(public spec: 1,600 Gbps aggregate per chip = 200 GB/s). A ring
-all-reduce uses one axis, and achievable efficiency on real pods is
-~80-90% of nominal; ICI_GBPS (default 45 = one link direction x 90%)
-keeps the estimate conservative and overridable.
+Link budgets: v5e exposes 4 ICI links/chip in a 2D torus (1,600 Gbps
+aggregate = 200 GB/s); a ring all-reduce uses one axis, and achievable
+efficiency on real pods is ~80-90% of nominal. ICI_GBPS (default 45 =
+one link direction x 90%) keeps the estimate conservative. v4's ICI is
+faster per link; reusing the v5e number is again conservative. DCN
+(multi-slice) planning number: DCN_GBPS per host, default 12.5
+(100 Gbps NICs x ~=1 direction), 4 chips/host on v4.
 """
 
 import json
@@ -29,9 +43,12 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+MESH_SIZES = [int(s) for s in os.environ.get("MESH_SIZES", "8,16,32").split(",")]
+
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    os.environ.get("XLA_FLAGS", "")
+    + f" --xla_force_host_platform_device_count={max(MESH_SIZES)}"
 )
 
 import jax
@@ -40,12 +57,20 @@ jax.config.update("jax_platforms", "cpu")
 
 import jax.numpy as jnp
 import numpy as np
-
-N_DEV = 8
 ICI_GBPS = float(os.environ.get("ICI_GBPS", 45.0))
-# measured single-chip flagship step (r04 trace: device self time; the
+DCN_GBPS = float(os.environ.get("DCN_GBPS", 12.5))
+# measured single-chip flagship step (r05 trace: device self time; the
 # wall step adds tunnel RTT a pod would not pay)
-STEP_MS_DEVICE = float(os.environ.get("STEP_MS_DEVICE", 98.7))
+STEP_MS_DEVICE = float(os.environ.get("STEP_MS_DEVICE", 77.8))
+# v4 vs v5e HBM bandwidth ratio: the workload is bandwidth-bound
+# (docs/PERF.md "Honest throughput"), so per-chip step time scales with
+# HBM bandwidth to first order
+V4_BW_SCALE = 1228.0 / 820.0
+V4_32_CHIPS = 16  # a v4-32 slice = 16 chips (32 TensorCores)
+BATCH_PER_CHIP = 1024
+# off-step cadences for the amortized terms
+STEPS_PER_EPOCH = int(os.environ.get("STEPS_PER_EPOCH", 50))
+EPOCHS_PER_CHECKPOINT = int(os.environ.get("EPOCHS_PER_CHECKPOINT", 1))
 
 
 def _dtype_bytes(tag: str) -> int:
@@ -58,8 +83,6 @@ def collective_bytes(hlo: str) -> dict:
     Handles tuple-typed results (one all-reduce over many gradient
     leaves) and async start/done pairs (counting the start only)."""
     shape_pat = re.compile(r"([a-z0-9]+)\[([0-9,]*)\]")
-    # "%name = TYPE kind(...)": TYPE may be a tuple of many gradient
-    # leaves; async pairs count the -start only (the -done repeats it)
     line_pat = re.compile(
         r"=\s*(.*?)\s*"
         r"(all-reduce|reduce-scatter|all-gather|all-to-all|collective-permute)"
@@ -81,62 +104,138 @@ def collective_bytes(hlo: str) -> dict:
     return out
 
 
-def main():
+def compile_width(n_dev: int) -> dict:
+    """Compile the sharded flagship step over an n_dev data mesh and
+    return its collective-bytes table + parameter size."""
     from hydragnn_tpu.flagship import build_flagship
     from hydragnn_tpu.parallel import make_mesh, make_sharded_train_step, place_state
     from hydragnn_tpu.train import create_train_state, select_optimizer
 
     config, model, variables, loader = build_flagship(
-        n_samples=4 * N_DEV * 4, batch_size=4 * N_DEV, device_stack=N_DEV,
+        n_samples=4 * n_dev * 2, batch_size=4 * n_dev, device_stack=n_dev,
         hidden_dim=128, num_conv_layers=6,
     )
-    mesh = make_mesh(N_DEV)
+    mesh = make_mesh(n_dev)
     tx = select_optimizer(config["NeuralNetwork"]["Training"])
     state = place_state(mesh, create_train_state(variables, tx))
     step = make_sharded_train_step(model, tx, mesh, compute_dtype=jnp.bfloat16)
     batch = next(iter(loader))
-    lowered = step.lower(state, batch)
-    compiled = lowered.compile()
-    hlo = compiled.as_text()
-
-    byts = collective_bytes(hlo)
+    hlo = step.lower(state, batch).compile().as_text()
     param_bytes = sum(
-        np.prod(p.shape) * 4 for p in jax.tree_util.tree_leaves(variables["params"])
+        int(np.prod(p.shape)) * 4
+        for p in jax.tree_util.tree_leaves(variables["params"])
     )
-    ar = byts.get("all-reduce", 0)
-    # ring all-reduce: each chip moves 2(n-1)/n x payload over ICI
-    wire = 2 * (N_DEV - 1) / N_DEV * ar
-    t_ici_ms = wire / (ICI_GBPS * 1e9) * 1e3
-    eff_no_overlap = STEP_MS_DEVICE / (STEP_MS_DEVICE + t_ici_ms)
-    # XLA overlaps the gradient all-reduce with the tail of the backward
-    # pass; treating HALF the wire time as exposed is the usual planning
-    # number when no measured overlap exists
-    eff_half_overlap = STEP_MS_DEVICE / (STEP_MS_DEVICE + 0.5 * t_ici_ms)
+    return {"collectives": collective_bytes(hlo), "param_bytes": param_bytes}
 
-    rec = {
-        "n_devices": N_DEV,
-        "mesh": "1-D data-parallel (DP) over ICI",
-        "collective_bytes_per_step": byts,
-        "param_bytes_f32": int(param_bytes),
+
+def width_record(n_dev: int, comp: dict, dcn_slices: int = 1) -> dict:
+    """Efficiency model for one mesh width.
+
+    In-step: ring all-reduce wire bytes over ICI; when the data axis
+    spans ``dcn_slices`` ICI slices, the inter-slice fraction of the
+    ring rides DCN instead (2(s-1)/s of the payload crosses a slice
+    boundary once per direction, shared by the slice's hosts)."""
+    ar = comp["collectives"].get("all-reduce", 0)
+    n = n_dev
+    wire = 2 * (n - 1) / n * ar
+    t_ici_ms = wire / (ICI_GBPS * 1e9) * 1e3
+    t_dcn_ms = 0.0
+    if dcn_slices > 1:
+        # ring over slices: each slice boundary carries the full reduced
+        # payload once per direction; per-host DCN bandwidth shared by
+        # the 4 chips of a v4 host
+        dcn_wire = 2 * (dcn_slices - 1) / dcn_slices * ar
+        t_dcn_ms = dcn_wire / (DCN_GBPS * 1e9) * 1e3
+    # off-step terms, amortized per step:
+    #  - eval all-gather: every process contributes its padded
+    #    predictions once per epoch (head dims ~4 f32 per graph at
+    #    flagship scale; n_max rows ~ batch_per_chip * steps_per_epoch)
+    eval_rows = BATCH_PER_CHIP * STEPS_PER_EPOCH
+    eval_bytes = eval_rows * 4 * 4 * n  # rows x heads x f32 x processes
+    t_eval_ms = eval_bytes / (DCN_GBPS * 1e9) * 1e3 / STEPS_PER_EPOCH
+    #  - checkpoint: ZeRO-1 shards write param+opt (3x params f32) / n
+    #    per chip to storage once per EPOCHS_PER_CHECKPOINT epochs
+    ckpt_bytes = 3 * comp["param_bytes"] / n
+    t_ckpt_ms = (
+        ckpt_bytes / (DCN_GBPS * 1e9) * 1e3
+        / (STEPS_PER_EPOCH * EPOCHS_PER_CHECKPOINT)
+    )
+    exposed = t_ici_ms + t_dcn_ms + t_eval_ms + t_ckpt_ms
+    eff_no_overlap = STEP_MS_DEVICE / (STEP_MS_DEVICE + exposed)
+    eff_half_overlap = STEP_MS_DEVICE / (STEP_MS_DEVICE + 0.5 * exposed)
+    return {
+        "n_devices": n,
+        "dcn_slices": dcn_slices,
+        "collective_bytes_per_step": comp["collectives"],
         "allreduce_bytes_per_step": int(ar),
-        "allreduce_vs_2x_params": round(ar / max(2 * param_bytes, 1), 3),
-        "ici_gbps_assumed": ICI_GBPS,
         "wire_bytes_per_chip_ring": int(wire),
         "t_ici_ms": round(t_ici_ms, 3),
-        "step_ms_device_single_chip": STEP_MS_DEVICE,
+        "t_dcn_ms": round(t_dcn_ms, 3),
+        "t_eval_allgather_ms_amortized": round(t_eval_ms, 4),
+        "t_checkpoint_ms_amortized": round(t_ckpt_ms, 4),
         "dp_efficiency_no_overlap": round(eff_no_overlap, 4),
         "dp_efficiency_half_overlap": round(eff_half_overlap, 4),
-        "note": (
-            "Collective bytes read from the compiled 8-way SPMD HLO "
-            "(virtual CPU mesh; same partitioner as TPU). Efficiency = "
-            "compute / (compute + exposed ICI time); no-overlap is the "
-            "floor, half-overlap the planning number. SCALING_cpu8.json "
-            "remains correctness-only evidence (shared-core timings are "
-            "not a scaling measurement)."
+    }
+
+
+def main():
+    widths = {}
+    comp_by_n = {}
+    for n in MESH_SIZES:
+        print(f"compiling {n}-way sharded step ...", file=sys.stderr)
+        comp_by_n[n] = compile_width(n)
+        widths[str(n)] = width_record(n, comp_by_n[n])
+    # multi-slice variants at 32-way: the data axis spanning 2 and 4
+    # ICI slices (DCN between slices)
+    if 32 in comp_by_n:
+        for s in (2, 4):
+            widths[f"32_dcn{s}slices"] = width_record(32, comp_by_n[32], dcn_slices=s)
+
+    # v4-32 north-star projection from measured device time + derived
+    # 16-way efficiency (replaces BASELINE.md's hand arithmetic)
+    eff16 = widths.get("16", {}).get("dp_efficiency_no_overlap", None)
+    step_ms_v4 = STEP_MS_DEVICE / V4_BW_SCALE
+    gps_chip_v4 = BATCH_PER_CHIP / step_ms_v4 * 1e3
+    projection = {
+        "platform": "v4-32 (16 chips, one ICI slice)",
+        "assumption": (
+            "bandwidth-bound workload: per-chip step time scales with "
+            "HBM bandwidth (v4 1228 / v5e 820); efficiency from the "
+            "16-way compiled-HLO model (no-overlap floor)"
+        ),
+        "step_ms_device_v4_chip": round(step_ms_v4, 2),
+        "graphs_per_sec_per_chip_v4": round(gps_chip_v4, 1),
+        "dp_efficiency_16way": eff16,
+        "aggregate_graphs_per_sec": (
+            round(V4_32_CHIPS * gps_chip_v4 * eff16, 1) if eff16 else None
         ),
     }
-    out = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                       "SCALING_est_r04.json")
+
+    rec = {
+        "mesh": "1-D data-parallel (DP) over ICI (+DCN variants)",
+        "step_ms_device_single_chip": STEP_MS_DEVICE,
+        "batch_per_chip": BATCH_PER_CHIP,
+        "ici_gbps_assumed": ICI_GBPS,
+        "dcn_gbps_assumed": DCN_GBPS,
+        "steps_per_epoch_assumed": STEPS_PER_EPOCH,
+        "param_bytes_f32": comp_by_n[MESH_SIZES[0]]["param_bytes"],
+        "widths": widths,
+        "v4_32_projection": projection,
+        "note": (
+            "Collective bytes read from compiled SPMD HLO at each width "
+            "(virtual CPU mesh; same partitioner as TPU). Efficiency = "
+            "compute / (compute + exposed wire time); no-overlap is the "
+            "floor, half-overlap the planning number. Off-step terms "
+            "(eval padded all-gather, ZeRO-1 sharded checkpoint write) "
+            "amortized at the stated cadence. SCALING_cpu8.json remains "
+            "correctness-only evidence (shared-core timings are not a "
+            "scaling measurement)."
+        ),
+    }
+    out = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "SCALING_est_r05.json",
+    )
     with open(out, "w") as f:
         json.dump(rec, f, indent=1)
     print(json.dumps(rec, indent=1))
