@@ -18,8 +18,14 @@ from hydragnn_tpu.data.smiles import (
 )
 from hydragnn_tpu.data.atomic_descriptors import atomicdescriptors
 from hydragnn_tpu.data.import_reference import (
+    ReferenceMonolithicReader,
     ReferencePickleReader,
+    import_monolithic_dataset,
     import_pickle_dataset,
+)
+from hydragnn_tpu.data.adios_reference import (
+    ReferenceAdiosReader,
+    import_adios_dataset,
 )
 
 __all__ = [
@@ -43,4 +49,8 @@ __all__ = [
     "atomicdescriptors",
     "ReferencePickleReader",
     "import_pickle_dataset",
+    "ReferenceMonolithicReader",
+    "import_monolithic_dataset",
+    "ReferenceAdiosReader",
+    "import_adios_dataset",
 ]

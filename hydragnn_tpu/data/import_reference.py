@@ -323,6 +323,93 @@ class ReferencePickleReader:
         return [self.read(k, head_types, head_names) for k in range(len(self))]
 
 
+class ReferenceMonolithicReader:
+    """Reader for the reference's MONOLITHIC pickle layouts — one file
+    holding 3 sequential pickles (minmax_node_feature,
+    minmax_graph_feature, list-of-Data):
+
+    - ``SerializedDataset`` (hydragnn/utils/serializeddataset.py:10-87):
+      ``<basedir>/<datasetname>-<label>.pkl``, or per-rank
+      ``<datasetname>-<label>-<rank>.pkl`` when written distributed;
+    - the legacy ``run_training`` path's
+      ``serialized_dataset/<name>[_split].pkl`` files
+      (hydragnn/preprocess/raw_dataset_loader.py) — same 3-object body.
+
+    Given one ``.pkl`` path, rank-sharded siblings
+    (``<stem>-<rank>.pkl``) are discovered and concatenated in rank
+    order automatically."""
+
+    def __init__(self, path: str):
+        stem = path[: -len(".pkl")] if path.endswith(".pkl") else path
+        if os.path.isfile(path):
+            self.paths = [path]
+        else:
+            # a dist write leaves only <stem>-0.pkl, <stem>-1.pkl, ...;
+            # accept the base name and concatenate the rank set
+            shards: List[str] = []
+            r = 0
+            while os.path.exists(f"{stem}-{r}.pkl"):
+                shards.append(f"{stem}-{r}.pkl")
+                r += 1
+            if not shards:
+                raise FileNotFoundError(path)
+            self.paths = shards
+        self.minmax_node_feature = None
+        self.minmax_graph_feature = None
+        self._objects: List[Any] = []
+        for p in self.paths:
+            mm_node, mm_graph, objs = _load_pickle_stream(p, 3)
+            if self.minmax_node_feature is None:
+                self.minmax_node_feature = mm_node
+                self.minmax_graph_feature = mm_graph
+            if isinstance(objs, _Stub):
+                # list subclasses pickle their items through append/extend
+                objs = list(objs._args)
+            if not isinstance(objs, (list, tuple)):
+                raise ValueError(
+                    f"{p}: third pickle object is {type(objs).__name__}, "
+                    "expected the list of Data samples"
+                )
+            self._objects.extend(objs)
+
+    def __len__(self) -> int:
+        return len(self._objects)
+
+    def samples(
+        self,
+        head_types: Optional[Sequence[str]] = None,
+        head_names: Optional[Sequence[str]] = None,
+    ) -> List[GraphSample]:
+        return [
+            data_object_to_sample(o, head_types, head_names)
+            for o in self._objects
+        ]
+
+
+def import_monolithic_dataset(
+    path: str,
+    out_path: str,
+    head_types: Optional[Sequence[str]] = None,
+    head_names: Optional[Sequence[str]] = None,
+) -> int:
+    """Convert one reference monolithic-pickle dataset (single file or
+    rank-sharded set) into an HGC container. Returns the sample count."""
+    from hydragnn_tpu.data.container import ContainerWriter
+
+    reader = ReferenceMonolithicReader(path)
+    writer = ContainerWriter(out_path)
+    writer.add(reader.samples(head_types, head_names))
+    for name, val in (
+        ("minmax_node_feature", reader.minmax_node_feature),
+        ("minmax_graph_feature", reader.minmax_graph_feature),
+    ):
+        arr = _to_numpy(val)
+        if arr is not None:
+            writer.add_global(name, arr)
+    writer.save()
+    return len(reader)
+
+
 def import_pickle_dataset(
     basedir: str,
     label: str,
@@ -360,10 +447,18 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
     )
     p.add_argument(
         "source",
-        help="sharded-pickle directory holding <label>-meta.pkl, or an "
-        "ADIOS2 .bp file/dir (needs the adios2 library)",
+        help="sharded-pickle directory holding <label>-meta.pkl, a "
+        "monolithic SerializedDataset .pkl file (rank-sharded sets: "
+        "pass the base name), or an ADIOS2 .bp file/dir (needs the "
+        "adios2 library)",
     )
-    p.add_argument("label", help="dataset label (e.g. 'trainset', 'total')")
+    p.add_argument(
+        "label",
+        nargs="?",
+        default="total",
+        help="dataset label (e.g. 'trainset', 'total'); unused for "
+        "monolithic .pkl inputs (the file IS the split)",
+    )
     p.add_argument("out", help="output .hgc container path")
     p.add_argument(
         "--head-type",
@@ -383,6 +478,10 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
     if looks_like_adios(args.source):
         n = import_adios_dataset(
             args.source, args.label, args.out, args.head_type, args.head_name
+        )
+    elif args.source.endswith(".pkl") or os.path.isfile(args.source):
+        n = import_monolithic_dataset(
+            args.source, args.out, args.head_type, args.head_name
         )
     else:
         n = import_pickle_dataset(

@@ -212,6 +212,10 @@ class GraphLoader:
         mean_nodes = int(
             sum(s.num_nodes for s in self.all_samples) / max(len(self.all_samples), 1)
         )
+        # cap 512: an r05 A/B at 640 (one block per 572-node large
+        # graph, no window re-scan at all) traced 87.0 vs 86.9 ms —
+        # the residual re-scan is noise once the r05 pad/dtype fixes
+        # landed, and larger blocks cost VMEM for nothing
         self.win_block_rows = min(512, _round_up(max(mean_nodes, 128), 128))
         self._dicts = samples_to_graph_dicts(self.samples)
 

@@ -161,6 +161,71 @@ def test_import_roundtrip_to_container(tmp_path):
     ds.close()
 
 
+def _write_monolithic(path, n_samples, rng_seed=9):
+    """Mirror SerializedWriter (reference serializeddataset.py:49-87):
+    3 sequential pickles — minmax_node, minmax_graph, list of Data."""
+    Data = _install_fake_pyg() or sys.modules["torch_geometric.data.data"].Data
+    rng = np.random.default_rng(rng_seed)
+    objs, truth = [], []
+    for _ in range(n_samples):
+        n = int(rng.integers(3, 6))
+        x = rng.standard_normal((n, 2)).astype(np.float32)
+        send = np.arange(n, dtype=np.int64)
+        ei = np.stack([send, (send + 1) % n])
+        g_y = rng.standard_normal(1).astype(np.float32)
+        y = g_y[:, None]
+        objs.append(
+            Data(
+                x=torch.from_numpy(x),
+                edge_index=torch.from_numpy(ei),
+                y=torch.from_numpy(y),
+            )
+        )
+        truth.append((x, ei, g_y))
+    with open(path, "wb") as f:
+        pickle.dump(torch.zeros(2, 2), f)
+        pickle.dump(None, f)
+        pickle.dump(objs, f)
+    return truth
+
+
+def test_monolithic_serialized_roundtrip(tmp_path):
+    """SerializedDataset single-file and rank-sharded layouts convert
+    through the CLI (reference: serializeddataset.py:30-36 naming)."""
+    from hydragnn_tpu.data.import_reference import (
+        ReferenceMonolithicReader,
+        main,
+    )
+
+    single = str(tmp_path / "unit-total.pkl")
+    truth = _write_monolithic(single, 4)
+    # rank-sharded variant: base name has no file, only -0/-1 shards
+    t0 = _write_monolithic(str(tmp_path / "dist-total-0.pkl"), 2, rng_seed=1)
+    t1 = _write_monolithic(str(tmp_path / "dist-total-1.pkl"), 3, rng_seed=2)
+    for m in list(sys.modules):
+        if m.startswith("torch_geometric"):
+            del sys.modules[m]
+
+    out = str(tmp_path / "mono.hgc")
+    main([single, out])
+    ds = ContainerDataset(out)
+    assert len(ds) == 4
+    for i, (x, ei, g_y) in enumerate(truth):
+        s = ds.get(i)
+        np.testing.assert_allclose(s.x, x, rtol=1e-6)
+        np.testing.assert_array_equal(s.edge_index, ei)
+        # no y_loc in the legacy layout: y rides as the graph target
+        np.testing.assert_allclose(np.ravel(s.graph_y), g_y, rtol=1e-6)
+    ds.close()
+
+    sharded = ReferenceMonolithicReader(str(tmp_path / "dist-total.pkl"))
+    assert len(sharded) == 5
+    got = sharded.samples()
+    for s, (x, ei, g_y) in zip(got, t0 + t1):
+        np.testing.assert_allclose(s.x, x, rtol=1e-6)
+        np.testing.assert_allclose(np.ravel(s.graph_y), g_y, rtol=1e-6)
+
+
 def test_malicious_globals_are_stubbed(tmp_path):
     """A pickle that REDUCEs through builtins.eval (or any global off
     the exact allowlist) must resolve to a harmless stub, never
