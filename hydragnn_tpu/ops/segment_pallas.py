@@ -74,11 +74,18 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 # cost of VMEM and wasted work on boundary blocks.
 BN = int(os.environ.get("HYDRAGNN_BN", 128))  # output rows (nodes) per grid step
 CE = int(os.environ.get("HYDRAGNN_CE", 512))  # edges DMA'd per inner chunk
-if BN % 16 or CE % 16 or BN <= 0 or CE <= 0:
+# Gather-kernel chunk: the bcast kernel has no cross-chunk accumulator,
+# so it tolerates bigger chunks than the family/sum kernels' CE —
+# measured on v5e (r05 flagship trace): 512 -> 77.8 ms/step, 1024 ->
+# 75.9, 2048 -> 79.7 (wider chunks span more BW-windows and the stray
+# re-reads win back the overhead). Default 1024.
+_BCAST_CE = int(os.environ.get("HYDRAGNN_BCAST_CE", 1024))
+if BN % 16 or CE % 16 or BN <= 0 or CE <= 0 or _BCAST_CE % 16 or _BCAST_CE <= 0:
     raise ValueError(
-        f"HYDRAGNN_BN={BN} / HYDRAGNN_CE={CE} must be positive multiples of "
-        "16 (Mosaic tiling: HBM slice starts and output blocks must stay "
-        "tile-aligned — a misaligned value fails deep in kernel lowering)"
+        f"HYDRAGNN_BN={BN} / HYDRAGNN_CE={CE} / HYDRAGNN_BCAST_CE={_BCAST_CE} "
+        "must be positive multiples of 16 (Mosaic tiling: HBM slice starts "
+        "and output blocks must stay tile-aligned — a misaligned value "
+        "fails deep in kernel lowering)"
     )
 
 _FORCE_XLA = contextvars.ContextVar("hydragnn_force_xla_segment_ops", default=False)
@@ -636,10 +643,12 @@ def segment_sum_local_fast(
 # and the PNA backward pays ~36 of them per step (g_sum[recv],
 # g_sumsq[recv], extremum out[recv]/share[recv] per layer): 280 of the
 # 471 ms step (r03 trace, docs/PERF.md). For SORTED ids the gather is a
-# CSR broadcast with perfect locality: edge chunk k reads only table
-# rows [recv[k*CE], recv[k*CE] + CE], so a one-hot MXU matmul
-# (out_chunk = onehot[CE, W] @ window[W, H]) streams the output at
-# bandwidth instead of looping rows. Exactness: each output row is
+# CSR broadcast with perfect locality: an edge chunk of C ids
+# (C = _BCAST_CE for the gather kernel, CE for the fused backward)
+# reads only the <= C distinct table rows it references, so a one-hot
+# MXU matmul (out_chunk = onehot[C, W] @ window[W, H]) streams the
+# output at bandwidth instead of looping rows; chunks spanning more
+# than one BW-row window loop over as many windows as needed. Exactness: each output row is
 # 1.0 * table_row summed once — exact for bf16 inputs with f32
 # accumulation; f32 inputs use HIGHEST (the f32-as-3xbf16 split times
 # exact 1.0 reconstructs exactly) — for |x| >= ~1e-30. Below that the
@@ -655,8 +664,10 @@ def segment_sum_local_fast(
 ALIGN = 16  # window starts/sizes are 16-row aligned: Mosaic must prove
 # HBM slice starts divisible by the tiling — 8 rows for f32, 16 for
 # packed bf16 (8-sublane tile x 2-row packing)
-BW = CE + ALIGN  # table-window rows per chunk: CE sorted edges span
-# <= CE distinct rows; +ALIGN covers the aligned window start
+BW = CE + ALIGN  # table-window rows per DMA: CE sorted edges span
+# <= CE distinct rows; +ALIGN covers the aligned window start. Chunks
+# wider than BW (the gather kernel's _BCAST_CE=1024 default) loop over
+# ceil(span / BW) windows inside _window_gather_acc.
 
 
 def _window_gather_acc(scal_ref, table_hbm, recv_ref, win_vmem, acc_ref, sems):
@@ -713,7 +724,8 @@ def _window_gather_acc(scal_ref, table_hbm, recv_ref, win_vmem, acc_ref, sems):
         in_range = (recv >= wstart) & (recv < wstart + BW)
         local = jnp.where(in_range, local, -1)
         onehot = (
-            local[:, None] == jax.lax.broadcasted_iota(jnp.int32, (CE, BW), 1)
+            local[:, None]
+            == jax.lax.broadcasted_iota(jnp.int32, (recv.shape[0], BW), 1)
         )
         win = win_vmem[slot]
         if win.dtype == jnp.float32:
@@ -732,21 +744,22 @@ def _window_gather_acc(scal_ref, table_hbm, recv_ref, win_vmem, acc_ref, sems):
     jax.lax.fori_loop(0, wcnt, window_body, 0)
 
 
-def _window_plan(recv, e, n_pad_t, n_chunks):
+def _window_plan(recv, e, n_pad_t, n_chunks, ce=None):
     """Per-chunk window plan (scalar-prefetch operand for
     :func:`_window_gather_acc`): [astart; wcnt; n_clamp] as int32
-    [3, n_chunks]. ``recv`` is the CE-padded sorted id vector whose
+    [3, n_chunks]. ``recv`` is the chunk-padded sorted id vector whose
     sentinels are >= ``n_pad_t`` (outside every logical window)."""
-    first = recv[::CE][:n_chunks]
+    ce = CE if ce is None else ce
+    first = recv[::ce][:n_chunks]
     astart = first & ~jnp.int32(ALIGN - 1)
-    last_real = jnp.minimum(recv[CE - 1 :: CE][:n_chunks], recv[e - 1])
+    last_real = jnp.minimum(recv[ce - 1 :: ce][:n_chunks], recv[e - 1])
     wcnt = jnp.maximum(1, (last_real + 1 - astart + BW - 1) // BW)
     return jnp.stack(
         [astart, wcnt, jnp.full((n_chunks,), n_pad_t - BW, jnp.int32)]
     ).astype(jnp.int32)
 
 
-def _window_plan_local(recv, n_pad_t, n_chunks):
+def _window_plan_local(recv, n_pad_t, n_chunks, ce=None):
     """Window plan for UNSORTED ids: per-chunk min/max via a fused
     [n_chunks, CE] reshape reduction (the sorted plan's strided-slice
     shortcut assumes monotonicity). Correct for arbitrary ids; FAST
@@ -755,7 +768,8 @@ def _window_plan_local(recv, n_pad_t, n_chunks):
     contiguous node block. Sentinel ids (>= n_pad_t) never match a
     window row (windows are clamped to n_pad_t - BW), so only the min
     needs guarding against them."""
-    chunks = recv[: n_chunks * CE].reshape(n_chunks, CE)
+    ce = CE if ce is None else ce
+    chunks = recv[: n_chunks * ce].reshape(n_chunks, ce)
     lo = jnp.min(chunks, axis=1)
     hi = jnp.minimum(jnp.max(chunks, axis=1), n_pad_t - 1)
     astart = lo & ~jnp.int32(ALIGN - 1)
@@ -767,7 +781,9 @@ def _window_plan_local(recv, n_pad_t, n_chunks):
 
 def _bcast_kernel(scal_ref, table_hbm, recv_ref, out_ref,
                   win_vmem, acc_ref, sems):
-    """Grid step k: out rows [k*CE, (k+1)*CE) = table[recv rows].
+    """Grid step k: out rows [k*C, (k+1)*C) = table[recv rows], C =
+    the call's chunk size (_BCAST_CE; chunks wider than BW loop over
+    multiple table windows — the dense common case at the 1024 default).
     recv chunk and out chunk are Pallas-pipelined BlockSpec windows; the
     data-dependent table windows are manual DMAs (BlockSpec index maps
     cannot express data-dependent starts) — see
@@ -788,21 +804,25 @@ def _bcast_kernel_call(table, ids, interpret, sorted_ids=True):
     n, h = table.shape
     if e == 0:
         return table[:0]
+    # The gather kernel has no cross-chunk accumulator, so its chunk
+    # size can exceed the family/sum kernels' CE without VMEM pressure;
+    # HYDRAGNN_BCAST_CE overrides (per-call measurement knob).
+    bce = _BCAST_CE
     n_pad = max(((n + ALIGN - 1) // ALIGN) * ALIGN, BW)
     if n_pad != n:
         table = jnp.concatenate(
             [table, jnp.zeros((n_pad - n, h), table.dtype)], axis=0
         )
-    e_pad = ((e + CE - 1) // CE) * CE
+    e_pad = ((e + bce - 1) // bce) * bce
     # sentinel rows land outside every logical window -> zero rows
     recv = jnp.concatenate(
         [ids.astype(jnp.int32), jnp.full((e_pad - e,), n_pad, jnp.int32)]
     )
-    n_chunks = e_pad // CE
+    n_chunks = e_pad // bce
     if sorted_ids:
-        scal = _window_plan(recv, e, n_pad, n_chunks)
+        scal = _window_plan(recv, e, n_pad, n_chunks, ce=bce)
     else:
-        scal = _window_plan_local(recv, n_pad, n_chunks)
+        scal = _window_plan_local(recv, n_pad, n_chunks, ce=bce)
     vma = _vma_of(recv, table)
     table = _match_vma(table, vma)
     recv = _match_vma(recv, vma)
@@ -813,12 +833,12 @@ def _bcast_kernel_call(table, ids, interpret, sorted_ids=True):
         grid=(n_chunks,),
         in_specs=[
             pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec((1, CE), lambda k, ptr: (0, k)),
+            pl.BlockSpec((1, bce), lambda k, ptr: (0, k)),
         ],
-        out_specs=pl.BlockSpec((CE, h), lambda k, ptr: (k, 0)),
+        out_specs=pl.BlockSpec((bce, h), lambda k, ptr: (k, 0)),
         scratch_shapes=[
             pltpu.VMEM((2, BW, h), table.dtype),
-            pltpu.VMEM((CE, h), jnp.float32),
+            pltpu.VMEM((bce, h), jnp.float32),
             pltpu.SemaphoreType.DMA((2,)),
         ],
     )
@@ -891,7 +911,7 @@ def gather_rows_sorted_fast(table: jnp.ndarray, ids: jnp.ndarray) -> jnp.ndarray
 
 
 def gather_rows_local_fast(table: jnp.ndarray, ids: jnp.ndarray) -> jnp.ndarray:
-    """``table[ids]`` for UNSORTED-BUT-LOCAL ids (each CE-chunk of ids
+    """``table[ids]`` for UNSORTED-BUT-LOCAL ids (each id chunk
     spans a narrow row range — batched-graph senders): the windowed
     bcast kernel with the chunk-min/max plan. Plain indexing off-TPU.
     NOT differentiated, like :func:`gather_rows_sorted_fast` — callers

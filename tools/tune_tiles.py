@@ -1,6 +1,10 @@
-"""Sweep kernel tile sizes (HYDRAGNN_BN x HYDRAGNN_CE) on the flagship
-step, traced device time per setting (subprocess per setting — the
-constants bake at import). Usage: python tools/tune_tiles.py"""
+"""Sweep kernel tile sizes (HYDRAGNN_BN x HYDRAGNN_CE x
+HYDRAGNN_BCAST_CE — the gather kernel's chunk reads only the latter)
+on the flagship step, traced device time per setting (subprocess per
+setting — the constants bake at import).
+
+Usage: python tools/tune_tiles.py [BNxCE[xBCE] ...]
+(BCE defaults to the package default when omitted)"""
 
 import json
 import os
@@ -60,22 +64,36 @@ print(f"RESULT device={tot/3e3:.2f} pallas={pall/3e3:.2f} loss={float(loss):.5f}
 """
 
 
-def run(bn, ce):
+def run(bn, ce, bce=None):
     env = dict(os.environ, HYDRAGNN_BN=str(bn), HYDRAGNN_CE=str(ce))
+    if bce is not None:
+        env["HYDRAGNN_BCAST_CE"] = str(bce)
+    tag = f"BN={bn} CE={ce}" + (f" BCE={bce}" if bce is not None else "")
     out = subprocess.run(
         [sys.executable, "-c", CHILD % {"here": HERE}],
         env=env, capture_output=True, text=True, timeout=560,
     )
     for line in out.stdout.splitlines():
         if line.startswith("RESULT"):
-            print(f"BN={bn} CE={ce}: {line[7:]}", flush=True)
+            print(f"{tag}: {line[7:]}", flush=True)
             return
-    print(f"BN={bn} CE={ce}: FAILED\n{out.stderr[-500:]}", flush=True)
+    print(f"{tag}: FAILED\n{out.stderr[-500:]}", flush=True)
 
 
 if __name__ == "__main__":
-    settings = [(128, 512), (256, 512), (256, 1024), (128, 1024), (512, 1024)]
+    # r05-measured gather-chunk sweep included: 512/1024/2048 traced
+    # 77.8 / 75.9 / 79.7 ms on the flagship (docs/PERF.md)
+    settings = [
+        (128, 512, None),
+        (256, 512, None),
+        (128, 512, 512),
+        (128, 512, 2048),
+        (128, 1024, None),
+    ]
     if len(sys.argv) > 1:
-        settings = [tuple(map(int, s.split("x"))) for s in sys.argv[1:]]
-    for bn, ce in settings:
-        run(bn, ce)
+        settings = []
+        for s in sys.argv[1:]:
+            parts = list(map(int, s.split("x")))
+            settings.append(tuple(parts) if len(parts) == 3 else (*parts, None))
+    for bn, ce, bce in settings:
+        run(bn, ce, bce)
