@@ -82,12 +82,12 @@ def _local_kernels(n_rows: int) -> bool:
     v5e: 811k-row flagship wins big, 61k-row qm9 dense LOSES 8.2 vs
     3.4 ms scan-step (tools/ab_qm9.py). Below the threshold the
     permuted-sorted path is faster."""
-    import os
+    from hydragnn_tpu.ops.segment_pallas import (
+        local_kernel_active,
+        local_min_rows,
+    )
 
-    from hydragnn_tpu.ops.segment_pallas import local_kernel_active
-
-    min_rows = int(os.environ.get("HYDRAGNN_LOCAL_MIN_ROWS", 200_000))
-    return n_rows >= min_rows and local_kernel_active()
+    return n_rows >= local_min_rows() and local_kernel_active()
 
 
 def _run_presum(vals: jnp.ndarray, ctx: EdgeContext) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -396,71 +396,106 @@ class PNAConv(nn.Module):
             # batch_graphs canonicalizes), which also enables the Pallas
             # CSR kernels on TPU.
             from hydragnn_tpu.ops import pna_aggregate
+            from hydragnn_tpu.ops.segment_pallas import (
+                gather_presum_eligible,
+                gather_presum_stats,
+            )
 
-            v = _gather_senders(bsend, ctx)
-            if use_edge:
-                v = v + nn.Dense(fin)(ctx.edge_attr) @ w[2 * fin :]
-            if ctx.run_align:
-                # Run-aligned pre-reduction (graph/batch.py run_align):
-                # every aggregation statistic first collapses K-fold
-                # with fused elementwise passes, then the segment ops
-                # run on E/K rows — the serial scatter-max that
-                # dominated the r04 trace (6 x ~9 ms at E=699k) costs
-                # 1/K, and the fused K1/K2 backward kernels are
-                # replaced by plain AD through broadcasts + the
-                # E/K-scale segment VJPs.
+            if (
+                ctx.run_align
+                and not use_edge
+                and gather_presum_eligible(
+                    bsend, ctx.senders, ctx.sender_win, ctx.run_align
+                )
+            ):
+                # Fused gather + K-group pre-reduction (r05): the kernel
+                # keeps v = bsend[senders] in VMEM and emits the four
+                # statistics at E/K rows directly — the [E, H] v array
+                # and its 4-6 full re-reads (the "fwd reduce_sum" block
+                # of the r05 trace) never touch HBM. Backward regathers
+                # v once and differentiates the identical composition
+                # (ops/segment_pallas.py:_presum_stats_ref). use_edge
+                # keeps the unfused path: the edge term breaks the
+                # pure-gather structure. fin % 128 == 0 by eligibility,
+                # so no lane split is needed in the slicing below.
                 K = ctx.run_align
-                m = ctx.edge_mask[:, None]
-                # Narrow widths run at LANE width on TPU: a [E', fin<8]
-                # elementwise chain uses ~fin/128 of each VPU tile
-                # (conv_0's fin=1 backward measured 7 GB/s, r04 trace);
-                # zero columns ride along and are sliced off after the
-                # segment ops.
-                lane_w = fin
-                if fin % 128 and jax.default_backend() == "tpu":
-                    lane_w = (fin + 127) // 128 * 128
-                    v = jnp.concatenate(
-                        [v, jnp.zeros((v.shape[0], lane_w - fin), v.dtype)], axis=1
-                    )
-                # One pass over the [E', W] edge array per STATISTIC
-                # (not per pair): an r05 experiment packed (vf | vf^2)
-                # and (max | -min) into lane-concats hoping XLA would
-                # fuse the concat into the reshape-reduce and read v
-                # once per pair — it materialized the f32 [E', 2W]
-                # concats instead (110 ms/step vs 77.8, +27 GB/step),
-                # same failure mode as r04's [msg,-msg] concat. Separate
-                # sibling reduces stand.
-                vf = jnp.where(m, v, 0).astype(jnp.float32)
-                sum8 = vf.reshape(-1, K, lane_w).sum(axis=1)
-                sumsq8 = (vf * vf).reshape(-1, K, lane_w).sum(axis=1)
+                v = bsend  # dtype source for the shared tail
+                stats8, both8 = gather_presum_stats(
+                    bsend, ctx.senders, ctx.edge_mask, ctx.sender_win, n, K
+                )
                 recv8 = ctx.receivers[::K]
                 pair = S.segment_sum_sorted(
-                    jnp.concatenate([sum8, sumsq8], axis=-1),
-                    recv8,
-                    n,
-                    grad_dtype=v.dtype,
+                    stats8, recv8, n, grad_dtype=bsend.dtype
                 )
-                vsum, vsumsq = pair[:, :fin], pair[:, lane_w : lane_w + fin]
-                neg = jnp.finfo(v.dtype).min
-                vmax8 = jnp.where(m, v, neg).reshape(-1, K, lane_w).max(axis=1)
-                vneg8 = jnp.where(m, -v, neg).reshape(-1, K, lane_w).max(axis=1)
-                both8 = jnp.concatenate([vmax8, vneg8], axis=-1)
+                vsum, vsumsq = pair[:, :fin], pair[:, fin : 2 * fin]
                 both = S.segment_max(
                     both8, recv8, n, indices_are_sorted=True, empty_value=0.0
                 )
-                both = jnp.concatenate(
-                    [both[:, :fin], both[:, lane_w : lane_w + fin]], axis=-1
-                )
                 cnt = _edge_count(ctx, n)
             else:
-                vsum, vsumsq, cnt, both = pna_aggregate(
-                    v, ctx.receivers, n, mask=ctx.edge_mask, indices_are_sorted=True
-                )
-                if ctx.in_degree is not None:
-                    # chassis-precomputed degree (searchsorted over the
-                    # sorted receivers): the aggregate's own count scatter
-                    # then has no consumer and XLA dead-code-eliminates it
-                    cnt = ctx.in_degree
+                v = _gather_senders(bsend, ctx)
+                if use_edge:
+                    v = v + nn.Dense(fin)(ctx.edge_attr) @ w[2 * fin :]
+                if ctx.run_align:
+                    # Run-aligned pre-reduction (graph/batch.py run_align):
+                    # every aggregation statistic first collapses K-fold
+                    # with fused elementwise passes, then the segment ops
+                    # run on E/K rows — the serial scatter-max that
+                    # dominated the r04 trace (6 x ~9 ms at E=699k) costs
+                    # 1/K, and the fused K1/K2 backward kernels are
+                    # replaced by plain AD through broadcasts + the
+                    # E/K-scale segment VJPs.
+                    K = ctx.run_align
+                    m = ctx.edge_mask[:, None]
+                    # Narrow widths run at LANE width on TPU: a [E', fin<8]
+                    # elementwise chain uses ~fin/128 of each VPU tile
+                    # (conv_0's fin=1 backward measured 7 GB/s, r04 trace);
+                    # zero columns ride along and are sliced off after the
+                    # segment ops.
+                    lane_w = fin
+                    if fin % 128 and jax.default_backend() == "tpu":
+                        lane_w = (fin + 127) // 128 * 128
+                        v = jnp.concatenate(
+                            [v, jnp.zeros((v.shape[0], lane_w - fin), v.dtype)], axis=1
+                        )
+                    # The statistics composition is SHARED with the
+                    # fused kernel's contract (_presum_stats_ref is
+                    # also what its custom VJP recompute targets), so
+                    # fused and fallback configs cannot silently
+                    # diverge. It runs one pass per statistic — an r05
+                    # experiment packed (vf | vf^2) and (max | -min)
+                    # into E-level lane-concats hoping XLA would fuse
+                    # them into the reshape-reduce; it materialized the
+                    # f32 [E', 2W] concats instead (110 ms/step vs
+                    # 77.8, +27 GB/step), same failure mode as r04's
+                    # [msg,-msg] concat. The E/K-level concats inside
+                    # _presum_stats_ref are bandwidth-trivial.
+                    from hydragnn_tpu.ops.segment_pallas import (
+                        _presum_stats_ref,
+                    )
+
+                    stats8, both8 = _presum_stats_ref(v, ctx.edge_mask, K)
+                    recv8 = ctx.receivers[::K]
+                    pair = S.segment_sum_sorted(
+                        stats8, recv8, n, grad_dtype=v.dtype
+                    )
+                    vsum, vsumsq = pair[:, :fin], pair[:, lane_w : lane_w + fin]
+                    both = S.segment_max(
+                        both8, recv8, n, indices_are_sorted=True, empty_value=0.0
+                    )
+                    both = jnp.concatenate(
+                        [both[:, :fin], both[:, lane_w : lane_w + fin]], axis=-1
+                    )
+                    cnt = _edge_count(ctx, n)
+                else:
+                    vsum, vsumsq, cnt, both = pna_aggregate(
+                        v, ctx.receivers, n, mask=ctx.edge_mask, indices_are_sorted=True
+                    )
+                    if ctx.in_degree is not None:
+                        # chassis-precomputed degree (searchsorted over the
+                        # sorted receivers): the aggregate's own count scatter
+                        # then has no consumer and XLA dead-code-eliminates it
+                        cnt = ctx.in_degree
             max_v = both[:, :fin]
             min_v = -both[:, fin:]
         # mean/var formed in f32 (both paths accumulate f32); cast back

@@ -851,6 +851,239 @@ def _bcast_kernel_call(table, ids, interpret, sorted_ids=True):
     return out[:e]
 
 
+# ---------------------------------------------------------------------------
+# Fused gather + K-group pre-reduction (r05): the PNA aligned path's four
+# statistics without materializing v = table[senders] in HBM
+# ---------------------------------------------------------------------------
+#
+# The run-aligned PNA branch (models/convs.py) computed v via the bcast
+# gather ([E, H] HBM write), then read it back 4-6x in separate fused
+# passes (sum8, sumsq8, vmax8, vneg8 — the r05 trace's "fwd reduce_sum
+# n=4" block at ~3.5 ms/layer). This kernel keeps the gathered chunk in
+# VMEM and emits the K-group statistics directly:
+#
+#   stats [E/K, 2H] f32   = [group-sum(masked v) | group-sum(masked v^2)]
+#   both  [E/K, 2H] dtype = [group-max(masked v) | group-max(masked -v)]
+#
+# exactly the layouts the downstream E/K segment ops consume. The
+# backward (jax.custom_vjp in :func:`gather_presum_stats`) REGATHERS v
+# once and differentiates the identical jnp composition, so gradient
+# semantics (incl. reshape-max tie handling) match the unfused path by
+# construction; grad_table is the windowed local scatter.
+
+
+def _gather_stats_kernel(scal_ref, table_hbm, recv_ref, mask_ref,
+                         stats_ref, both_ref, win_vmem, acc_ref, sems):
+    """Grid step k: gather chunk k's rows into VMEM (shared windowed
+    loop), then reduce the K-groups in registers. K is static:
+    chunk_rows // stats_rows."""
+    _window_gather_acc(scal_ref, table_hbm, recv_ref, win_vmem, acc_ref, sems)
+    acc = acc_ref[:]  # [bce, h] f32 (exact for bf16 tables)
+    bce, h = acc.shape
+    k_stat = bce // stats_ref.shape[0]
+    # arithmetic masking: Mosaic cannot broadcast a 1-bit vector into a
+    # minor dim (same constraint as _window_gather_acc's range check),
+    # so the mask rides as f32 0/1 — exact, and select-free
+    mf = mask_ref[0, :].astype(jnp.float32)[:, None]
+    vf = acc * mf
+    stats_ref[:, :h] = vf.reshape(-1, k_stat, h).sum(axis=1)
+    stats_ref[:, h:] = (vf * vf).reshape(-1, k_stat, h).sum(axis=1)
+    # fill with the OUTPUT dtype's min so all-masked groups read back
+    # exactly like the unfused where(m, v, finfo(dtype).min) path
+    neg = jnp.float32(jnp.finfo(both_ref.dtype).min)
+    fill = (1.0 - mf) * neg
+    vx = (acc * mf + fill).reshape(-1, k_stat, h).max(axis=1)
+    vn = (-acc * mf + fill).reshape(-1, k_stat, h).max(axis=1)
+    both_ref[:, :h] = vx.astype(both_ref.dtype)
+    both_ref[:, h:] = vn.astype(both_ref.dtype)
+
+
+def _gather_stats_call(table, ids, mask, k_group, interpret):
+    """Invoke the fused gather+stats kernel. ``ids`` are unsorted-but-
+    local (batched-graph senders); requires k_group | len(ids) and the
+    chunk size divisible by k_group (loader-aligned batches guarantee
+    both). Returns (stats [E/k, 2H] f32, both [E/k, 2H] table.dtype)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    e = ids.shape[0]
+    n, h = table.shape
+    bce = _BCAST_CE
+    assert e % bce == 0 and bce % k_group == 0, (e, bce, k_group)
+    n_pad = max(((n + ALIGN - 1) // ALIGN) * ALIGN, BW)
+    if n_pad != n:
+        table = jnp.concatenate(
+            [table, jnp.zeros((n_pad - n, h), table.dtype)], axis=0
+        )
+    recv = ids.astype(jnp.int32)
+    n_chunks = e // bce
+    scal = _window_plan_local(recv, n_pad, n_chunks, ce=bce)
+    mask_i = mask.astype(jnp.int32)
+    vma = _vma_of(recv, table, mask_i)
+    table = _match_vma(table, vma)
+    recv = _match_vma(recv, vma)
+    mask_i = _match_vma(mask_i, vma)
+    scal = _match_vma(scal, vma)
+    rows = e // k_group
+    stats_sds = jax.ShapeDtypeStruct((rows, 2 * h), jnp.float32, vma=vma)
+    both_sds = jax.ShapeDtypeStruct((rows, 2 * h), table.dtype, vma=vma)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_chunks,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec((1, bce), lambda k, ptr: (0, k)),
+            pl.BlockSpec((1, bce), lambda k, ptr: (0, k)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bce // k_group, 2 * h), lambda k, ptr: (k, 0)),
+            pl.BlockSpec((bce // k_group, 2 * h), lambda k, ptr: (k, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((2, BW, h), table.dtype),
+            pltpu.VMEM((bce, h), jnp.float32),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    stats, both = pl.pallas_call(
+        _gather_stats_kernel,
+        out_shape=[stats_sds, both_sds],
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(scal, table, recv[None, :], mask_i[None, :])
+    return stats, both
+
+
+def _presum_stats_ref(v, mask, k_group):
+    """The unfused composition the kernel replaces — also the VJP's
+    recompute target, so gradient semantics (reshape-sum broadcast,
+    reshape-max even tie split) match the pre-r05 path exactly."""
+    m = mask[:, None]
+    h = v.shape[1]
+    vf = jnp.where(m, v, 0).astype(jnp.float32)
+    stats = jnp.concatenate(
+        [
+            vf.reshape(-1, k_group, h).sum(axis=1),
+            (vf * vf).reshape(-1, k_group, h).sum(axis=1),
+        ],
+        axis=-1,
+    )
+    neg = jnp.finfo(v.dtype).min
+    both = jnp.concatenate(
+        [
+            jnp.where(m, v, neg).reshape(-1, k_group, h).max(axis=1),
+            jnp.where(m, -v, neg).reshape(-1, k_group, h).max(axis=1),
+        ],
+        axis=-1,
+    )
+    return stats, both
+
+
+def local_min_rows() -> int:
+    """Shared row threshold for the local-window kernel family: the
+    fixed per-call cost (window plan + grid setup) only pays off on
+    large operands (qm9's 61k-row config measured 7.5 vs 6.3 ms device
+    on the local pair — docs/PERF.md r04)."""
+    return int(os.environ.get("HYDRAGNN_LOCAL_MIN_ROWS", 200_000))
+
+
+def gather_presum_eligible(table, ids, win, k_group) -> bool:
+    """Kernel-path gate for :func:`gather_presum_stats`: TPU with the
+    local kernels active, host-emitted scatter windows present, lane-
+    aligned width, and chunk divisibility at BOTH granularities (the
+    call hard-asserts them; an ineligible shape must fall back, not
+    crash — e.g. run_align=3 with an accidentally 1024-divisible
+    E_pad, or a hand-tuned HYDRAGNN_BCAST_CE K doesn't divide)."""
+    return (
+        win is not None
+        and table.ndim == 2
+        and table.shape[1] % 128 == 0
+        and ids.shape[0] % _BCAST_CE == 0
+        and _BCAST_CE % k_group == 0
+        and ids.shape[0] >= local_min_rows()
+        and local_kernel_active()
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def gather_presum_stats(table, ids, mask, win, num_rows, k_group):
+    """Fused ``v = table[ids]`` + masked K-group (sum, sumsq, max, -min)
+    — the PNA aligned pre-reduction without materializing v in HBM.
+    Callers must pass :func:`gather_presum_eligible` first; the fallback
+    composition lives in the caller (models/convs.py), not here."""
+    stats, both = _gather_stats_call(
+        table, ids, mask, k_group, interpret=_interpret_mode()
+    )
+    return stats, both
+
+
+def _gather_presum_fwd(table, ids, mask, win, num_rows, k_group):
+    stats, both = gather_presum_stats(table, ids, mask, win, num_rows, k_group)
+    return (stats, both), (table, ids, mask, win, both)
+
+
+def _gather_presum_bwd(num_rows, k_group, res, cots):
+    """Analytic backward: regather v once and assemble grad_v in closed
+    form from the SAVED forward outputs — an earlier jax.vjp-based
+    variant re-ran the whole forward composition inside the pullback
+    (the primal is evaluated by jax.vjp), costing ~2.3 ms/layer of
+    redundant E-level passes on the flagship trace.
+
+    Semantics match plain AD of :func:`_presum_stats_ref`: the sum
+    terms are linear (+ 2 v g for the square), the max terms follow
+    jax's reduce-max convention — even split among tied group slots,
+    tie counts taken on the FILLED values (masked slots tie only in
+    all-masked groups, where the mask factor zeroes them anyway).
+    Share math runs f32 (the extremum-VJP contract, segment.py)."""
+    table, ids, mask, win, both_fwd = res
+    g_stats, g_both = cots
+    h = table.shape[1]
+    m = mask[:, None]
+    v = gather_rows_local_fast(table, ids)
+
+    def rep(a):
+        return jnp.broadcast_to(
+            a[:, None, :], (a.shape[0], k_group, a.shape[1])
+        ).reshape(a.shape[0] * k_group, a.shape[1])
+
+    # tie masks stay in the COMPUTE dtype (0/1 exact in bf16; group
+    # counts <= k_group are exact too) — an f32 formulation materialized
+    # ~2 GB/layer of converts on the flagship trace. Shares divide in
+    # f32 at the E/K level (bandwidth-trivial), then broadcast.
+    neg = jnp.finfo(v.dtype).min
+    tie_x = (jnp.where(m, v, neg) == rep(both_fwd[:, :h])).astype(v.dtype)
+    tie_n = (jnp.where(m, -v, neg) == rep(both_fwd[:, h:])).astype(v.dtype)
+    cnt_x = tie_x.reshape(-1, k_group, h).sum(axis=1).astype(jnp.float32)
+    cnt_n = tie_n.reshape(-1, k_group, h).sum(axis=1).astype(jnp.float32)
+    share_x = (
+        g_both[:, :h].astype(jnp.float32) / jnp.maximum(cnt_x, 1.0)
+    ).astype(v.dtype)
+    share_n = (
+        g_both[:, h:].astype(jnp.float32) / jnp.maximum(cnt_n, 1.0)
+    ).astype(v.dtype)
+    vf = jnp.where(m, v, 0).astype(jnp.float32)
+    grad = (
+        rep(g_stats[:, :h])
+        + 2.0 * vf * rep(g_stats[:, h:])
+        + (tie_x * rep(share_x)).astype(jnp.float32)
+        - (tie_n * rep(share_n)).astype(jnp.float32)
+    )
+    grad_v = jnp.where(m, grad, 0.0).astype(table.dtype)
+    grad_table = segment_sum_local_fast(
+        grad_v, ids, win, num_rows
+    ).astype(table.dtype)
+    f0 = jax.dtypes.float0
+    return (
+        grad_table,
+        jnp.zeros(ids.shape, dtype=f0),
+        jnp.zeros(mask.shape, dtype=f0),
+        jnp.zeros(win.shape, dtype=f0),
+    )
+
+
+gather_presum_stats.defvjp(_gather_presum_fwd, _gather_presum_bwd)
+
+
 def _make_partitioned_bcast():
     """custom_partitioning wrapper: ids may be GSPMD-sharded on the edge
     axis (each shard's slice is contiguous and sorted — the giant-graph

@@ -207,6 +207,35 @@ def check_kernels() -> bool:
     good = _allclose(ssum, sref, 1e-5, 1e-4)
     (_ok if good else _fail)("segment_sum_local_f32")
     ok &= good
+    # fused gather + K-group pre-reduction (r05): stats and extremum
+    # outputs vs the unfused composition over a materialized gather —
+    # f32 and bf16, with partial and whole-group masking
+    from hydragnn_tpu.ops.segment_pallas import (
+        _gather_stats_call,
+        _presum_stats_ref,
+    )
+
+    e_f, n_f, h_f, kk = 8192, 2048, 128, 8
+    gtab32 = np.round(rng.normal(size=(n_f, h_f)) * 4).astype(np.float32) / 4
+    ggrp = np.sort(rng.integers(0, 64, e_f))
+    gsend = (ggrp * 32 + rng.integers(0, 32, e_f)).astype(np.int32)
+    gmask = rng.random(e_f) > 0.25
+    gmask[128:136] = False  # one whole K-group masked
+    for dtype in (jnp.float32, jnp.bfloat16):
+        gt = jnp.asarray(gtab32).astype(dtype)
+        s_k, b_k = _gather_stats_call(
+            gt, jnp.asarray(gsend), jnp.asarray(gmask), kk, interpret=False
+        )
+        s_r, b_r = _presum_stats_ref(
+            gt[jnp.asarray(gsend)], jnp.asarray(gmask), kk
+        )
+        good = _allclose(s_k, s_r, 1e-5, 1e-4) and bool(
+            np.array_equal(
+                np.asarray(b_k, np.float32), np.asarray(b_r, np.float32)
+            )
+        )
+        (_ok if good else _fail)(f"gather_presum_{dtype.__name__}")
+        ok &= good
     return ok
 
 

@@ -627,3 +627,70 @@ def pytest_pna_aggregate_grad_inside_shard_map(monkeypatch):
     np.testing.assert_allclose(
         np.asarray(g), np.asarray(g_ref), rtol=1e-5, atol=1e-5
     )
+
+
+def pytest_gather_presum_stats_matches_reference(monkeypatch):
+    """Fused gather + K-group pre-reduction (r05): forward equals the
+    unfused composition over a materialized gather, and the custom VJP
+    (regather + differentiate the composition) matches plain AD of that
+    composition — values AND grads, with deliberate mask structure."""
+    from hydragnn_tpu.graph.batch import _block_windows
+    from hydragnn_tpu.ops.segment_pallas import (
+        _presum_stats_ref,
+        gather_presum_eligible,
+        gather_presum_stats,
+    )
+
+    monkeypatch.setenv("HYDRAGNN_PALLAS", "interpret")
+    monkeypatch.setenv("HYDRAGNN_LOCAL_MIN_ROWS", "0")
+
+    rng = np.random.default_rng(17)
+    e, n_rows, h, K = 2048, 512, 128, 8
+    # unsorted-but-local senders: confined to 64-node blocks like
+    # batched-graph senders; round values so f32/bf16 compares tie
+    table = np.round(rng.normal(size=(n_rows, h)) * 4).astype(np.float32) / 4
+    grp = np.sort(rng.integers(0, 32, e))
+    send = (grp * 16 + rng.integers(0, 16, e)).astype(np.int32)
+    mask = rng.random(e) > 0.25
+    # whole K-groups masked too (empty-group fill path)
+    mask[64:72] = False
+    perm = np.argsort(send, kind="stable").astype(np.int32)
+    win = jnp.asarray(_block_windows(send, perm, n_rows))
+
+    assert gather_presum_eligible(jnp.asarray(table), jnp.asarray(send), win, K)
+    # indivisible chunk/K combos must FALL BACK, not crash at trace time
+    assert not gather_presum_eligible(jnp.asarray(table), jnp.asarray(send), win, 3)
+
+    def fused_loss(t):
+        stats, both = gather_presum_stats(
+            t, jnp.asarray(send), jnp.asarray(mask), win, n_rows, K
+        )
+        return (stats * stats).sum() + both.astype(jnp.float32).sum()
+
+    def ref_loss(t):
+        v = t[jnp.asarray(send)]
+        stats, both = _presum_stats_ref(v, jnp.asarray(mask), K)
+        return (stats * stats).sum() + both.astype(jnp.float32).sum()
+
+    t = jnp.asarray(table)
+    np.testing.assert_allclose(
+        float(fused_loss(t)), float(ref_loss(t)), rtol=1e-5
+    )
+    g_fused = jax.jit(jax.grad(fused_loss))(t)
+    g_ref = jax.jit(jax.grad(ref_loss))(t)
+    np.testing.assert_allclose(
+        np.asarray(g_fused), np.asarray(g_ref), rtol=1e-5, atol=1e-5
+    )
+
+    # bf16 table: forward values must agree with the bf16 composition
+    tb = t.astype(jnp.bfloat16)
+    s_f, b_f = gather_presum_stats(
+        tb, jnp.asarray(send), jnp.asarray(mask), win, n_rows, K
+    )
+    s_r, b_r = _presum_stats_ref(tb[jnp.asarray(send)], jnp.asarray(mask), K)
+    np.testing.assert_allclose(
+        np.asarray(s_f), np.asarray(s_r), rtol=1e-6, atol=1e-6
+    )
+    np.testing.assert_array_equal(
+        np.asarray(b_f.astype(jnp.float32)), np.asarray(b_r.astype(jnp.float32))
+    )
