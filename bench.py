@@ -494,17 +494,41 @@ def _load_baseline(here: str) -> float | None:
     return None
 
 
+def emit_backend_failure(metric: str, exc) -> "SystemExit":
+    """Print ONE structured JSON failure line (the record a
+    ``hydragnn_tpu.utils.platform.BackendInitError`` carries, or a
+    synthesized one) and return a clean SystemExit — drivers capture a
+    parseable record instead of a raw traceback (ISSUE r05 Weak #1)."""
+    record = getattr(
+        exc,
+        "record",
+        {
+            "failure": "backend_init",
+            "stage": "device_query",
+            "jax_platforms": os.environ.get("JAX_PLATFORMS"),
+            "error": str(exc).strip()[-400:],
+            "error_type": type(exc).__name__,
+        },
+    )
+    print(json.dumps({"metric": metric, "value": None, "unit": None, **record}))
+    return SystemExit(1)
+
+
 def main() -> None:
     # honor an explicit JAX_PLATFORMS (e.g. cpu for CI smoke) — the axon
     # plugin image overrides the env unless pinned through jax.config
     # BEFORE backend init (hydragnn_tpu/utils/platform.py); without a
     # pin the bench stays on the real device the driver provides
-    from hydragnn_tpu.utils.platform import pin_platform_from_env
+    from hydragnn_tpu.utils.platform import BackendInitError, pin_platform_from_env
 
-    pin_platform_from_env()
-    import jax
+    _metric = "flagship_pna_multihead_train_throughput"
+    try:
+        pin_platform_from_env()
+        import jax
 
-    device = jax.devices()[0]
+        device = jax.devices()[0]
+    except (BackendInitError, RuntimeError, AssertionError) as exc:
+        raise emit_backend_failure(_metric, exc) from exc
     peak = _peak_flops(device)
     bf16 = os.environ.get("BENCH_BF16", "1") == "1"
     cache = os.environ.get("BENCH_CACHE", "0") == "1"

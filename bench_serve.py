@@ -1,0 +1,159 @@
+"""Serving benchmark: synthetic online traffic through the ModelServer.
+
+Prints ONE JSON line. Headline: steady-state serving throughput
+(graphs/sec) through the bucketed micro-batching path, plus the serving
+metrics the subsystem exists to bound — request latency percentiles,
+per-bucket occupancy, and ``compile_misses_after_warmup`` (MUST be 0:
+every steady-state request routes to an AOT-compiled bucket; a nonzero
+value means the ladder no longer covers the traffic and requests are
+paying XLA compiles on the serving path).
+
+Two phases after startup AOT warmup:
+  1. a short warmup burst (stabilizes jit/allocator state; its requests
+     are excluded from the timed window);
+  2. the timed load phase — ``SERVE_THREADS`` concurrent closed-loop
+     clients submitting ``SERVE_REQUESTS`` graphs sampled from the
+     dataset size distribution.
+
+CPU mode (``JAX_PLATFORMS=cpu python bench_serve.py``) runs a smoke-
+sized model; the same knobs scale it to a real chip. Knobs:
+SERVE_REQUESTS, SERVE_THREADS, SERVE_MAX_BATCH, SERVE_DELAY_MS,
+SERVE_BUCKETS, SERVE_SAMPLES, SERVE_HIDDEN, SERVE_LAYERS.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+
+def main() -> None:
+    from hydragnn_tpu.utils.platform import BackendInitError, pin_platform_from_env
+
+    metric = "serve_bucketed_throughput"
+    try:
+        pin_platform_from_env()
+        import jax  # noqa: F401
+
+        jax.devices()
+    except (BackendInitError, RuntimeError) as exc:
+        from bench import emit_backend_failure
+
+        raise emit_backend_failure(metric, exc) from exc
+
+    import numpy as np
+
+    from hydragnn_tpu.flagship import build_flagship
+    from hydragnn_tpu.serve import ModelRegistry, ModelServer, ServeConfig
+
+    n_requests = int(os.environ.get("SERVE_REQUESTS", 96))
+    n_threads = int(os.environ.get("SERVE_THREADS", 2))
+    max_batch = int(os.environ.get("SERVE_MAX_BATCH", 8))
+    delay_ms = float(os.environ.get("SERVE_DELAY_MS", 5.0))
+    num_buckets = int(os.environ.get("SERVE_BUCKETS", 3))
+    n_samples = int(os.environ.get("SERVE_SAMPLES", 64))
+    hidden = int(os.environ.get("SERVE_HIDDEN", 16))
+    layers = int(os.environ.get("SERVE_LAYERS", 2))
+
+    # Random-init flagship (PNA multi-head): serving cost does not depend
+    # on the weights, and skipping the train/checkpoint round-trip keeps
+    # the bench self-contained. The checkpoint path is covered by
+    # tests/test_serve.py's run_prediction-equivalence test.
+    _, model, variables, loader = build_flagship(
+        n_samples=n_samples,
+        hidden_dim=hidden,
+        num_conv_layers=layers,
+        batch_size=max(max_batch, 2),
+        unit_cells=(2, 4),
+    )
+    registry = ModelRegistry()
+    served = registry.register("bench_serve", model, variables)
+
+    requests = list(loader.all_samples)
+    server = ModelServer(
+        served,
+        requests,
+        ServeConfig(
+            max_batch=max_batch,
+            num_buckets=num_buckets,
+            max_delay_ms=delay_ms,
+            max_pending=max(4 * max_batch * n_threads, 64),
+        ),
+    )
+    t0 = time.perf_counter()
+    server.start()  # AOT-compiles the whole bucket ladder
+    warmup_s = time.perf_counter() - t0
+
+    # phase 1: warmup burst (excluded from the timed window)
+    for s in requests[: min(2 * max_batch, len(requests))]:
+        server.predict(s, timeout=60)
+    snap_warm = server.metrics_snapshot()
+    misses_at_warmup = snap_warm["compile_misses"]
+
+    # phase 2: timed closed-loop clients over the dataset distribution
+    rng = np.random.default_rng(0)
+    order = rng.integers(0, len(requests), size=n_requests)
+    per_thread = np.array_split(order, n_threads)
+    errors: list = []
+
+    def client(idx_list) -> None:
+        try:
+            for i in idx_list:
+                server.predict(requests[int(i)], timeout=120)
+        except BaseException as exc:  # pragma: no cover - surfaced in record
+            errors.append(repr(exc))
+
+    threads = [threading.Thread(target=client, args=(ix,)) for ix in per_thread]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+
+    snap = server.metrics_snapshot()
+    server.stop()
+    misses_after_warmup = snap["compile_misses"] - misses_at_warmup
+    occ = {
+        name: round(b["occupancy_mean"], 2)
+        for name, b in snap["buckets"].items()
+        if b["batches"]
+    }
+    record = {
+        "metric": metric,
+        "value": round(n_requests / wall, 2),
+        "unit": "graphs/sec",
+        "requests": n_requests,
+        "threads": n_threads,
+        "max_batch": max_batch,
+        "max_delay_ms": delay_ms,
+        "buckets": len(server.buckets),
+        "bucket_plans": [
+            [b.cap_nodes, b.cap_edges, b.node_pad, b.edge_pad] for b in server.buckets
+        ],
+        "warmup_compile_s": round(warmup_s, 2),
+        "compile_warmup": snap["compile_warmup"],
+        "compile_misses_after_warmup": misses_after_warmup,
+        "latency": {k: round(v, 2) for k, v in snap["latency"].items()},
+        "occupancy_mean": occ,
+        "queue_depth_peak": snap["queue_depth_peak"],
+        "rejected_overload": snap["rejected_overload"],
+        "errors": errors[:3],
+    }
+    print(json.dumps(record))
+    if errors:
+        raise SystemExit(1)
+    if misses_after_warmup != 0:
+        print(
+            f"FAIL: {misses_after_warmup} compile-cache misses after warmup — "
+            "steady-state traffic recompiled",
+            file=sys.stderr,
+        )
+        raise SystemExit(1)
+
+
+if __name__ == "__main__":
+    main()

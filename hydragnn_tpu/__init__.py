@@ -40,3 +40,9 @@ def run_prediction(config, **kwargs):
     from hydragnn_tpu.api import run_prediction as _rp
 
     return _rp(config, **kwargs)
+
+
+def serve_model(config, **kwargs):
+    from hydragnn_tpu.api import serve_model as _sm
+
+    return _sm(config, **kwargs)

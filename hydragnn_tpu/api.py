@@ -1,4 +1,4 @@
-"""Top-level entry points: ``run_training`` and ``run_prediction``.
+"""Top-level entry points: ``run_training``, ``run_prediction``, ``serve_model``.
 
 Mirrors the reference pipelines (reference: hydragnn/run_training.py:42-133
 and hydragnn/run_prediction.py:27-83): log setup -> distributed init ->
@@ -297,22 +297,76 @@ def run_training(
 
     timer = Timer("total_training")
     timer.start()
-
-    device_stack = _choose_device_stack(config)
-    train_loader, val_loader, test_loader, config = prepare_loaders_and_config(
-        config, samples, device_stack=device_stack
-    )
-    model, state, history = train_with_loaders(
-        config,
-        train_loader,
-        val_loader,
-        test_loader,
-        log_dir=log_dir,
-        device_stack=device_stack,
-    )
-    timer.stop()
+    # stop on ANY exit: the registry timer is process-global, and a run
+    # that raised mid-training would otherwise poison every later
+    # run_training in the process with "Timer already running"
+    try:
+        device_stack = _choose_device_stack(config)
+        train_loader, val_loader, test_loader, config = prepare_loaders_and_config(
+            config, samples, device_stack=device_stack
+        )
+        model, state, history = train_with_loaders(
+            config,
+            train_loader,
+            val_loader,
+            test_loader,
+            log_dir=log_dir,
+            device_stack=device_stack,
+        )
+    finally:
+        timer.stop()
     print_timers(verbosity)
     return model, state, history, config
+
+
+def serve_model(
+    config_file_or_dict,
+    samples: Optional[List] = None,
+    log_dir: str = "./logs/",
+    serve_config=None,
+    start: bool = True,
+):
+    """Stand up a batched online-inference server over a trained run.
+
+    Where :func:`run_prediction` re-pads and re-dispatches the whole test
+    set offline, this loads the checkpoint ONCE (same restore machinery),
+    AOT-compiles a ladder of padded batch shapes, and returns a
+    :class:`hydragnn_tpu.serve.ModelServer` answering single-graph
+    requests with deadline micro-batching — the online counterpart for
+    the paper's one-encoder/N-heads design, where one warm model serves
+    every property endpoint concurrently.
+
+    The dataset pipeline runs exactly as in prediction (normalization,
+    radius edges, config inference) — its prepared samples size the
+    bucket ladder and fix the request field spec; requests must be
+    prepared the same way. Predictions are returned in MODEL space
+    (normalized targets) — apply ``postprocess.output_denormalize`` for
+    physical units.
+
+    Returns the server (started unless ``start=False``); callers own its
+    lifecycle (``server.stop()``, or use it as a context manager).
+    """
+    config = load_config(config_file_or_dict)
+    train_loader, val_loader, test_loader, config = prepare_loaders_and_config(
+        config, samples
+    )
+    log_name = get_log_name_config(config)
+    reference = (
+        list(train_loader.all_samples)
+        + list(val_loader.all_samples)
+        + list(test_loader.all_samples)
+    )
+
+    from hydragnn_tpu.serve import ModelRegistry, ModelServer, ServeConfig
+
+    registry = ModelRegistry(log_dir)
+    served = registry.load(
+        log_name, config["NeuralNetwork"], example_graph=reference[0]
+    )
+    server = ModelServer(served, reference, serve_config or ServeConfig())
+    if start:
+        server.start()
+    return server
 
 
 def run_prediction(

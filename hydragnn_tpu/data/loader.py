@@ -55,6 +55,61 @@ def pad_plan_for(
     )
 
 
+class _CapSize:
+    """Synthetic (num_nodes, num_edges)-only sample for pad planning."""
+
+    __slots__ = ("num_nodes", "num_edges")
+
+    def __init__(self, num_nodes: int, num_edges: int):
+        self.num_nodes = num_nodes
+        self.num_edges = num_edges
+
+
+def bucket_pad_plans(
+    samples: Sequence,
+    batch_size: int,
+    num_buckets: int = 3,
+    node_multiple: int = 16,
+    edge_multiple: int = 8,
+) -> list:
+    """Ladder of serving pad plans over the dataset's size distribution.
+
+    Returns an ascending, plan-deduplicated list of
+    ``((cap_nodes, cap_edges), (n_node_pad, n_edge_pad, n_graph_pad))``.
+    Caps are per-graph quantile cut points (bucket ``i`` covers graphs up
+    to the ``(i+1)/num_buckets`` quantile of nodes AND of edges; the last
+    bucket's caps are the dataset maxima); each plan is
+    :func:`pad_plan_for` over a synthetic worst-case batch of
+    ``batch_size`` cap-sized graphs, so ANY batch of up to ``batch_size``
+    graphs within the caps fits the plan — the guarantee the serving
+    router (hydragnn_tpu/serve/buckets.py) relies on to never trigger a
+    fresh compile in steady state.
+    """
+    if not samples:
+        raise ValueError("bucket_pad_plans needs a non-empty sample set")
+    if num_buckets < 1:
+        raise ValueError(f"num_buckets must be >= 1, got {num_buckets}")
+    nodes = np.sort(np.asarray([s.num_nodes for s in samples]))
+    edges = np.sort(np.asarray([s.num_edges for s in samples]))
+    n = len(nodes)
+    plans = []
+    seen = set()
+    for i in range(num_buckets):
+        k = min(n - 1, max(0, math.ceil((i + 1) / num_buckets * n) - 1))
+        cap_n, cap_e = int(nodes[k]), int(edges[k])
+        plan = pad_plan_for(
+            [_CapSize(cap_n, cap_e)] * batch_size,
+            batch_size,
+            node_multiple,
+            edge_multiple,
+        )
+        if plan in seen:
+            continue
+        seen.add(plan)
+        plans.append(((cap_n, cap_e), plan))
+    return plans
+
+
 class GraphLoader:
     """Iterable over fixed-shape GraphBatches.
 
@@ -194,12 +249,35 @@ class GraphLoader:
                 # would multiply E_pad (a 176-edge CI batch would pad to
                 # 4096), bloating memory and perturbing every
                 # accumulation-order-sensitive equivalence test.
-                from hydragnn_tpu.ops.segment_pallas import CE as _kernel_ce
+                from hydragnn_tpu.ops.segment_pallas import (
+                    _BCAST_CE as _bcast_ce,
+                    CE as _kernel_ce,
+                )
 
                 grid_mult = self.run_align * _kernel_ce
                 mult = math.lcm(edge_multiple, self.run_align)
                 if max(sum(worst) + 1, self.pad_edges) >= 8 * grid_mult:
                     mult = math.lcm(edge_multiple, grid_mult)
+                    # The fused gather+stats kernel additionally needs
+                    # E % _BCAST_CE == 0 and _BCAST_CE % K == 0
+                    # (ops/segment_pallas.py:gather_presum_eligible); a
+                    # hand-tuned HYDRAGNN_BCAST_CE outside the lcm would
+                    # otherwise silently disable it (ADVICE r5 #1) —
+                    # correct fallback, vanished perf, no signal.
+                    if _bcast_ce % self.run_align == 0:
+                        mult = math.lcm(mult, _bcast_ce)
+                    else:
+                        import warnings
+
+                        warnings.warn(
+                            f"HYDRAGNN_BCAST_CE={_bcast_ce} is not a "
+                            f"multiple of run_align={self.run_align}; the "
+                            "fused PNA gather+stats kernel stays DISABLED "
+                            "for this loader (unfused fallback, correct "
+                            "but slower)",
+                            RuntimeWarning,
+                            stacklevel=2,
+                        )
                 self.pad_edges = _round_up(
                     max(sum(worst) + 1, self.pad_edges), mult
                 )

@@ -108,10 +108,12 @@ def xla_segment_ops():
 
 def _vma_of(*arrays) -> frozenset:
     """Union of the manual-mesh axes the given arrays vary over (empty
-    outside shard_map)."""
+    outside shard_map, and on jax versions without vma tracking)."""
+    from hydragnn_tpu.utils.jax_compat import typeof_vma
+
     out: frozenset = frozenset()
     for a in arrays:
-        out = out | frozenset(getattr(jax.typeof(a), "vma", frozenset()))
+        out = out | typeof_vma(a)
     return out
 
 
@@ -119,11 +121,18 @@ def _match_vma(x, vma: frozenset):
     """Promote ``x`` to vary over ``vma`` (jax.lax.pvary) — constructed
     operands (zero padding, window plans) otherwise arrive non-varying
     inside shard_map with check_vma=True and fail the interpreter's
-    per-operand vma match."""
-    need = vma - frozenset(getattr(jax.typeof(x), "vma", frozenset()))
-    if need:
-        return jax.lax.pvary(x, tuple(need))
-    return x
+    per-operand vma match. No-op on pre-vma jax."""
+    from hydragnn_tpu.utils.jax_compat import pvary, typeof_vma
+
+    return pvary(x, tuple(vma - typeof_vma(x)))
+
+
+def _sds(shape, dtype, vma: frozenset = frozenset()):
+    """ShapeDtypeStruct carrying vma where the jax version supports it
+    (utils/jax_compat.shape_dtype_struct)."""
+    from hydragnn_tpu.utils.jax_compat import shape_dtype_struct
+
+    return shape_dtype_struct(shape, dtype, vma)
 
 
 def pallas_available() -> bool:
@@ -365,7 +374,7 @@ def _csr_kernel_call(data, segment_ids, mask, num_segments, interpret, family):
     data = _match_vma(data, vma)
     recv = _match_vma(recv, vma)
     block_ptr = _match_vma(block_ptr, vma)
-    out_sds = jax.ShapeDtypeStruct((n_pad, h), jnp.float32, vma=vma)
+    out_sds = _sds((n_pad, h), jnp.float32, vma=vma)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(n_blocks,),
@@ -397,6 +406,20 @@ def _csr_kernel_call(data, segment_ids, mask, num_segments, interpret, family):
         ones, segment_ids, num_segments, indices_are_sorted=True
     )
     return outs[0][:num_segments], outs[1][:num_segments], cnt
+
+
+def _def_partition_compat(op, *, partition, infer_sharding_from_operands, sharding_rule):
+    """``def_partition`` across jax versions (utils/jax_compat): the
+    shardy ``sharding_rule`` spec only exists on newer jax; 0.4.x takes
+    the same partition/infer pair and uses classic GSPMD propagation."""
+    from hydragnn_tpu.utils.jax_compat import def_partition
+
+    def_partition(
+        op,
+        partition=partition,
+        infer_sharding_from_operands=infer_sharding_from_operands,
+        sharding_rule=sharding_rule,
+    )
 
 
 def _make_partitioned_op(family: bool, has_mask: bool):
@@ -450,7 +473,8 @@ def _make_partitioned_op(family: bool, has_mask: bool):
 
     ins = "e h, e" + (", e" if has_mask else "")
     outs = "n h, n h, n" if family else "n h"
-    op.def_partition(
+    _def_partition_compat(
+        op,
         partition=partition,
         infer_sharding_from_operands=infer,
         sharding_rule=f"{ins} -> {outs}",
@@ -571,7 +595,7 @@ def segment_sum_local_pallas(
     data = _match_vma(data, vma)
     ids = _match_vma(ids, vma)
     win = _match_vma(win.astype(jnp.int32), vma)
-    out_sds = jax.ShapeDtypeStruct((n_pad, h), jnp.float32, vma=vma)
+    out_sds = _sds((n_pad, h), jnp.float32, vma=vma)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(n_blocks,),
@@ -827,7 +851,7 @@ def _bcast_kernel_call(table, ids, interpret, sorted_ids=True):
     table = _match_vma(table, vma)
     recv = _match_vma(recv, vma)
     scal = _match_vma(scal, vma)
-    out_sds = jax.ShapeDtypeStruct((e_pad, h), table.dtype, vma=vma)
+    out_sds = _sds((e_pad, h), table.dtype, vma=vma)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(n_chunks,),
@@ -909,7 +933,16 @@ def _gather_stats_call(table, ids, mask, k_group, interpret):
     e = ids.shape[0]
     n, h = table.shape
     bce = _BCAST_CE
-    assert e % bce == 0 and bce % k_group == 0, (e, bce, k_group)
+    # explicit raise, not assert: a direct (non-gated) caller under
+    # ``python -O`` must still get the invariant message rather than an
+    # opaque Pallas BlockSpec/shape error downstream
+    if e % bce != 0 or bce % k_group != 0:
+        raise ValueError(
+            "gather_presum_stats divisibility contract violated: needs "
+            f"len(ids) % _BCAST_CE == 0 and _BCAST_CE % k_group == 0, got "
+            f"len(ids)={e}, _BCAST_CE={bce}, k_group={k_group} — gate calls "
+            "with gather_presum_eligible()"
+        )
     n_pad = max(((n + ALIGN - 1) // ALIGN) * ALIGN, BW)
     if n_pad != n:
         table = jnp.concatenate(
@@ -925,8 +958,8 @@ def _gather_stats_call(table, ids, mask, k_group, interpret):
     mask_i = _match_vma(mask_i, vma)
     scal = _match_vma(scal, vma)
     rows = e // k_group
-    stats_sds = jax.ShapeDtypeStruct((rows, 2 * h), jnp.float32, vma=vma)
-    both_sds = jax.ShapeDtypeStruct((rows, 2 * h), table.dtype, vma=vma)
+    stats_sds = _sds((rows, 2 * h), jnp.float32, vma=vma)
+    both_sds = _sds((rows, 2 * h), table.dtype, vma=vma)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(n_chunks,),
@@ -1113,7 +1146,8 @@ def _make_partitioned_bcast():
         )
         return mesh, lower_fn, NamedSharding(mesh, P(edge_axis, None)), arg_sh
 
-    op.def_partition(
+    _def_partition_compat(
+        op,
         partition=partition,
         infer_sharding_from_operands=infer,
         sharding_rule="n h, e -> e h",
@@ -1578,7 +1612,7 @@ def _pna_bwd_kernels(v, receivers, mask, both, g_sum, g_sumsq, g_both,
     block_ptr = _match_vma(block_ptr, vma)
     cnt_both = pl.pallas_call(
         k1_kernel,
-        out_shape=jax.ShapeDtypeStruct((n_pad_out, 2 * h), jnp.float32, vma=vma),
+        out_shape=_sds((n_pad_out, 2 * h), jnp.float32, vma=vma),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(n_blocks,),
@@ -1628,7 +1662,7 @@ def _pna_bwd_kernels(v, receivers, mask, both, g_sum, g_sumsq, g_both,
     scal = _match_vma(scal, vma2)
     grad = pl.pallas_call(
         k2_kernel,
-        out_shape=jax.ShapeDtypeStruct((e_pad, h), vd, vma=vma2),
+        out_shape=_sds((e_pad, h), vd, vma=vma2),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(n_chunks,),

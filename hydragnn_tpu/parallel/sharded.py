@@ -35,7 +35,7 @@ from hydragnn_tpu.models.base import HydraModel, model_loss
 from hydragnn_tpu.parallel.mesh import DATA_AXIS
 from hydragnn_tpu.train.state import TrainState
 
-shard_map = jax.shard_map
+from hydragnn_tpu.utils.jax_compat import shard_map
 
 
 def _zero1_sharding(mesh: Mesh, state: TrainState) -> TrainState:

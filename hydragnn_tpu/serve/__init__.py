@@ -1,0 +1,37 @@
+"""hydragnn_tpu.serve — batched online inference for trained models.
+
+The training side of this tree compiles ONE worst-case batch shape and
+streams epochs through it; serving inverts the problem: requests arrive
+one graph at a time, at unpredictable sizes, and a fresh XLA compile on
+the request path is a multi-second latency cliff. This package answers
+with four pieces:
+
+  - :mod:`~hydragnn_tpu.serve.registry` — named checkpoints loaded once
+    and held warm (restored variables + jitted forward);
+  - :mod:`~hydragnn_tpu.serve.buckets` — a ladder of pad plans, each
+    AOT-compiled at startup, with smallest-fitting-bucket routing;
+  - :mod:`~hydragnn_tpu.serve.batcher` — a bounded deadline queue that
+    coalesces single-graph requests into bucket batches;
+  - :mod:`~hydragnn_tpu.serve.metrics` — the operator surface (per-
+    bucket traffic, occupancy, latency percentiles, compile hits/misses).
+
+Entry points: ``hydragnn_tpu.api.serve_model`` stands a server up from a
+trained run; :class:`ModelServer` composes the pieces for in-memory
+models (benches, tests).
+"""
+
+from hydragnn_tpu.serve.batcher import MicroBatchQueue, Overloaded  # noqa: F401
+from hydragnn_tpu.serve.buckets import (  # noqa: F401
+    Bucket,
+    BucketCompileCache,
+    build_bucket_ladder,
+    route,
+)
+from hydragnn_tpu.serve.metrics import ServeMetrics, latency_percentiles  # noqa: F401
+from hydragnn_tpu.serve.registry import ModelRegistry, ServedModel  # noqa: F401
+from hydragnn_tpu.serve.server import (  # noqa: F401
+    ModelServer,
+    Oversize,
+    ServeConfig,
+    request_to_dict,
+)

@@ -1,0 +1,156 @@
+"""Async micro-batcher: coalesce single-graph requests into bucket batches.
+
+Online traffic arrives one graph at a time; the TPU wants batches. The
+queue here buys batch occupancy with a bounded latency budget: a bucket
+flushes the moment it holds ``max_batch`` requests (occupancy win) or
+when its OLDEST request has waited ``max_delay_s`` (latency bound) —
+whichever comes first. Under light load every request pays at most the
+deadline; under heavy load batches fill before the deadline and the
+deadline never fires.
+
+Backpressure is explicit: the queue is bounded across all buckets and
+``put`` raises :class:`Overloaded` instead of buffering unboundedly —
+the caller (or a fronting load balancer) decides whether to retry,
+shed, or route elsewhere. An overloaded server that queues silently
+just converts overload into timeout storms downstream.
+
+This module is deliberately jax-free: it moves (item, Future) pairs
+between threads. The server owns execution.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import Any, List, Optional, Tuple
+
+
+class Overloaded(RuntimeError):
+    """The request queue is full — explicit load-shedding signal."""
+
+
+@dataclasses.dataclass
+class PendingRequest:
+    item: Any
+    future: Future
+    t_enqueue: float  # time.monotonic() at admission
+    bucket: int
+
+
+class MicroBatchQueue:
+    """Thread-safe bounded multi-bucket queue with deadline coalescing.
+
+    Producers call :meth:`put` (any thread); a single consumer thread
+    loops on :meth:`take_batch`, which blocks until some bucket is
+    flushable and returns ``(bucket_index, requests, reason)`` with
+    reason one of ``"full"`` / ``"deadline"`` / ``"drain"`` (close-time
+    flush), or ``None`` once closed and drained.
+    """
+
+    def __init__(self, num_buckets: int, max_batch: int, max_delay_s: float, max_pending: int):
+        if num_buckets < 1:
+            raise ValueError("num_buckets must be >= 1")
+        if max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        if max_pending < 1:
+            raise ValueError("max_pending must be >= 1")
+        self._max_batch = max_batch
+        self._max_delay_s = float(max_delay_s)
+        self._max_pending = max_pending
+        self._cv = threading.Condition()
+        self._pending: List[deque] = [deque() for _ in range(num_buckets)]
+        self._count = 0
+        self._closed = False
+
+    def put(self, bucket: int, item: Any) -> Future:
+        """Admit one request into ``bucket``'s lane; returns its Future.
+        Raises :class:`Overloaded` when the queue is at capacity and
+        RuntimeError after :meth:`close`."""
+        fut: Future = Future()
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("queue is closed")
+            if self._count >= self._max_pending:
+                raise Overloaded(
+                    f"serving queue full ({self._count}/{self._max_pending} pending)"
+                )
+            self._pending[bucket].append(
+                PendingRequest(item, fut, time.monotonic(), bucket)
+            )
+            self._count += 1
+            self._cv.notify_all()
+        return fut
+
+    def depth(self) -> int:
+        with self._cv:
+            return self._count
+
+    def take_batch(self) -> Optional[Tuple[int, List[PendingRequest], str]]:
+        with self._cv:
+            while True:
+                # full buckets flush immediately, fullest first — under
+                # sustained load the deadline never gates throughput
+                best_full = None
+                for i, dq in enumerate(self._pending):
+                    if len(dq) >= self._max_batch and (
+                        best_full is None
+                        or len(dq) > len(self._pending[best_full])
+                    ):
+                        best_full = i
+                if best_full is not None:
+                    reason = "full" if not self._closed else "drain"
+                    return best_full, self._pop(best_full), reason
+
+                if self._closed:
+                    for i, dq in enumerate(self._pending):
+                        if dq:
+                            return i, self._pop(i), "drain"
+                    return None
+
+                # earliest-deadline bucket next
+                now = time.monotonic()
+                soonest, soonest_t = None, None
+                for i, dq in enumerate(self._pending):
+                    if dq:
+                        t = dq[0].t_enqueue + self._max_delay_s
+                        if soonest_t is None or t < soonest_t:
+                            soonest, soonest_t = i, t
+                if soonest is not None and soonest_t <= now:
+                    return soonest, self._pop(soonest), "deadline"
+                self._cv.wait(
+                    timeout=None if soonest_t is None else max(soonest_t - now, 0.0)
+                )
+
+    def _pop(self, bucket: int) -> List[PendingRequest]:
+        dq = self._pending[bucket]
+        out = [dq.popleft() for _ in range(min(len(dq), self._max_batch))]
+        self._count -= len(out)
+        self._cv.notify_all()
+        return out
+
+    def close(self) -> None:
+        """Stop admitting; take_batch drains what is queued then returns
+        None. Idempotent."""
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+
+    def cancel_pending(self, exc: Optional[BaseException] = None) -> int:
+        """Fail every queued request (server teardown without drain);
+        returns how many were cancelled."""
+        with self._cv:
+            n = 0
+            for dq in self._pending:
+                while dq:
+                    req = dq.popleft()
+                    if exc is not None:
+                        req.future.set_exception(exc)
+                    else:
+                        req.future.cancel()
+                    n += 1
+            self._count = 0
+            self._cv.notify_all()
+            return n

@@ -1,0 +1,149 @@
+"""Bucketed pad-plan ladder: the serving-side compile cache.
+
+Training amortizes one worst-case pad plan over an epoch; serving cannot
+— a single-graph request padded to the dataset worst case wastes compute
+proportional to the size spread, while padding each request to its own
+shape recompiles per shape (seconds on XLA:TPU — a latency cliff no
+online path can absorb). The middle ground is a small LADDER of padded
+shapes ("buckets"), each AOT-compiled once at startup: every request
+routes to the smallest bucket whose per-graph caps fit it, so
+steady-state traffic never sees a fresh compile and small graphs never
+pay the big-graph pad.
+
+The plans themselves come from ``data/loader.py:bucket_pad_plans`` (the
+same ``pad_plan_for`` arithmetic every GraphLoader uses), so a bucket
+batch obeys exactly the invariants the model chassis assumes of loader
+batches.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+
+@dataclasses.dataclass(frozen=True)
+class Bucket:
+    """One rung of the ladder.
+
+    ``cap_nodes``/``cap_edges`` are PER-GRAPH routing caps; the pad plan
+    (``node_pad``, ``edge_pad``, ``graph_pad``) covers any batch of up to
+    ``max_batch`` graphs each within the caps, by construction
+    (bucket_pad_plans builds it from a synthetic worst-case batch of
+    cap-sized graphs)."""
+
+    index: int
+    cap_nodes: int
+    cap_edges: int
+    node_pad: int
+    edge_pad: int
+    graph_pad: int
+    max_batch: int
+
+    def fits_graph(self, num_nodes: int, num_edges: int) -> bool:
+        return num_nodes <= self.cap_nodes and num_edges <= self.cap_edges
+
+    def fits_totals(self, tot_nodes: int, tot_edges: int, n_graphs: int) -> bool:
+        """Whether a concrete batch fits the PAD PLAN (batch_graphs needs
+        one spare node slot and one spare graph slot for padding)."""
+        return (
+            tot_nodes < self.node_pad
+            and tot_edges <= self.edge_pad
+            and n_graphs < self.graph_pad
+        )
+
+
+def build_bucket_ladder(
+    reference_samples: Sequence,
+    max_batch: int,
+    num_buckets: int = 3,
+    node_multiple: int = 16,
+    edge_multiple: int = 8,
+) -> List[Bucket]:
+    """Size a ladder from a reference sample set (typically the prepared
+    dataset the model was trained on — serving traffic is assumed to be
+    drawn from a similar size distribution; graphs beyond the top rung
+    take the server's oversize fallback path).
+
+    Ascending, deduplicated by pad plan: quantile spacing on a tight size
+    distribution can collapse adjacent rungs into one."""
+    from hydragnn_tpu.data.loader import bucket_pad_plans
+
+    plans = bucket_pad_plans(
+        reference_samples,
+        max_batch,
+        num_buckets=num_buckets,
+        node_multiple=node_multiple,
+        edge_multiple=edge_multiple,
+    )
+    return [
+        Bucket(
+            index=i,
+            cap_nodes=cap_n,
+            cap_edges=cap_e,
+            node_pad=plan[0],
+            edge_pad=plan[1],
+            graph_pad=plan[2],
+            max_batch=max_batch,
+        )
+        for i, ((cap_n, cap_e), plan) in enumerate(plans)
+    ]
+
+
+def route(
+    buckets: Sequence[Bucket], num_nodes: int, num_edges: int
+) -> Optional[Bucket]:
+    """Smallest bucket whose per-graph caps fit, or None (oversize —
+    the server's fallback path decides what happens next). Buckets are
+    ascending, so the first fit is the smallest."""
+    for b in buckets:
+        if b.fits_graph(num_nodes, num_edges):
+            return b
+    return None
+
+
+class BucketCompileCache:
+    """AOT-compiled forward executable per bucket.
+
+    ``warmup`` compiles the whole ladder up front (startup cost, recorded
+    as ``compile_warmup`` in the metrics); after that, :meth:`executable`
+    is a dict lookup — a serving dispatch can only recompile by going
+    through the eager fallback, which the server counts as a miss."""
+
+    def __init__(self, forward, variables, build_warm_batch, metrics=None):
+        """``forward`` is the jitted forward fn (variables, batch) ->
+        outputs; ``build_warm_batch(bucket)`` builds a structurally
+        representative all-padding batch at the bucket's plan."""
+        self._forward = forward
+        self._variables = variables
+        self._build_warm_batch = build_warm_batch
+        self._metrics = metrics
+        self._compiled = {}
+
+    def warmup(self, buckets: Sequence[Bucket]) -> None:
+        for b in buckets:
+            if b.index in self._compiled:
+                continue
+            warm = self._build_warm_batch(b)
+            self._compiled[b.index] = self._forward.lower(
+                self._variables, warm
+            ).compile()
+            if self._metrics is not None:
+                self._metrics.record_compile(hit=False, warmup=True)
+
+    def executable(self, bucket: Bucket):
+        """The pre-built executable for ``bucket``; compiles on demand
+        (recorded as a MISS — this only happens if warmup was skipped)."""
+        exe = self._compiled.get(bucket.index)
+        if exe is None:
+            warm = self._build_warm_batch(bucket)
+            exe = self._forward.lower(self._variables, warm).compile()
+            self._compiled[bucket.index] = exe
+            if self._metrics is not None:
+                self._metrics.record_compile(hit=False)
+        elif self._metrics is not None:
+            self._metrics.record_compile(hit=True)
+        return exe
+
+    def __len__(self) -> int:
+        return len(self._compiled)

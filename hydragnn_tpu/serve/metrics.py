@@ -1,0 +1,170 @@
+"""Serving metrics: the observable surface of the online-inference path.
+
+Counters answer the questions an operator of a bucketed server actually
+asks: is traffic landing in the right buckets (per-bucket request count,
+batch occupancy), is the deadline batcher coalescing or just timing out
+(flush reasons), is the server keeping up (queue depth, overload
+rejections), and — the TPU-specific one — is anything recompiling in
+steady state (compile hits/misses; a miss on the serving path is a
+multi-second latency cliff, which is the whole reason the bucket ladder
+exists).
+
+Everything is a plain thread-safe in-process aggregate exported as a
+dict (:meth:`ServeMetrics.snapshot`); tensorboard export rides the
+existing rank-0 writer plumbing (``utils/tensorboard.py:
+write_scalar_dict``).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Dict, List, Optional
+
+
+def latency_percentiles(values_s) -> Dict[str, float]:
+    """p50/p95/p99 over a sequence of second-latencies, in milliseconds.
+    Nearest-rank on the sorted sample — exact for the small windows kept
+    here, no interpolation surprises at the tail."""
+    vals: List[float] = sorted(values_s)
+    if not vals:
+        return {"p50_ms": 0.0, "p95_ms": 0.0, "p99_ms": 0.0}
+    n = len(vals)
+
+    def rank(q: float) -> float:
+        i = min(n - 1, max(0, int(round(q * (n - 1)))))
+        return vals[i] * 1e3
+
+    return {"p50_ms": rank(0.50), "p95_ms": rank(0.95), "p99_ms": rank(0.99)}
+
+
+class ServeMetrics:
+    """Thread-safe serving counters for one :class:`~hydragnn_tpu.serve.
+    server.ModelServer`.
+
+    ``latency_window`` bounds the per-request latency sample the
+    percentiles are computed over (a rolling window, not all-time — a
+    serving process lives for days and early warmup latencies must age
+    out of the tail stats).
+    """
+
+    def __init__(self, num_buckets: int, latency_window: int = 2048):
+        self._lock = threading.Lock()
+        self._latencies: deque = deque(maxlen=latency_window)
+        self.requests_total = 0
+        self.results_total = 0
+        self.rejected_overload = 0
+        self.oversize_largest_bucket = 0
+        self.oversize_eager = 0
+        self.errors = 0
+        self.queue_depth = 0
+        self.queue_depth_peak = 0
+        # compile-cache accounting: warmup compiles are the startup AOT
+        # ladder (expected, paid once); a MISS is a post-warmup dispatch
+        # that required a fresh XLA compile — the thing steady-state
+        # serving must never do.
+        self.compile_warmup = 0
+        self.compile_hits = 0
+        self.compile_misses = 0
+        self._buckets = [
+            {
+                "requests": 0,
+                "batches": 0,
+                "graphs": 0,
+                "occupancy_sum": 0,
+                "flush_full": 0,
+                "flush_deadline": 0,
+                "flush_drain": 0,
+            }
+            for _ in range(num_buckets)
+        ]
+
+    # -- recording ---------------------------------------------------------
+
+    def record_request(self, bucket: Optional[int]) -> None:
+        with self._lock:
+            self.requests_total += 1
+            if bucket is not None:
+                self._buckets[bucket]["requests"] += 1
+
+    def record_batch(self, bucket: int, occupancy: int, capacity: int, reason: str) -> None:
+        with self._lock:
+            b = self._buckets[bucket]
+            b["batches"] += 1
+            b["graphs"] += occupancy
+            b["occupancy_sum"] += occupancy
+            b[f"flush_{reason}"] = b.get(f"flush_{reason}", 0) + 1
+            b["capacity"] = capacity
+
+    def record_reject(self) -> None:
+        with self._lock:
+            self.rejected_overload += 1
+
+    def record_oversize(self, kind: str) -> None:
+        with self._lock:
+            if kind == "largest_bucket":
+                self.oversize_largest_bucket += 1
+            else:
+                self.oversize_eager += 1
+
+    def record_compile(self, *, hit: bool, warmup: bool = False) -> None:
+        with self._lock:
+            if warmup:
+                self.compile_warmup += 1
+            elif hit:
+                self.compile_hits += 1
+            else:
+                self.compile_misses += 1
+
+    def record_error(self, n: int = 1) -> None:
+        with self._lock:
+            self.errors += n
+
+    def observe_latency(self, seconds: float, n_results: int = 1) -> None:
+        with self._lock:
+            self._latencies.append(seconds)
+            self.results_total += n_results
+
+    def set_queue_depth(self, depth: int) -> None:
+        with self._lock:
+            self.queue_depth = depth
+            self.queue_depth_peak = max(self.queue_depth_peak, depth)
+
+    # -- export ------------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """One consistent dict of every counter plus derived stats
+        (mean occupancy per bucket, latency percentiles)."""
+        with self._lock:
+            buckets = []
+            for b in self._buckets:
+                d = dict(b)
+                d["occupancy_mean"] = (
+                    b["occupancy_sum"] / b["batches"] if b["batches"] else 0.0
+                )
+                d.pop("occupancy_sum")
+                buckets.append(d)
+            out = {
+                "requests_total": self.requests_total,
+                "results_total": self.results_total,
+                "rejected_overload": self.rejected_overload,
+                "oversize_largest_bucket": self.oversize_largest_bucket,
+                "oversize_eager": self.oversize_eager,
+                "errors": self.errors,
+                "queue_depth": self.queue_depth,
+                "queue_depth_peak": self.queue_depth_peak,
+                "compile_warmup": self.compile_warmup,
+                "compile_hits": self.compile_hits,
+                "compile_misses": self.compile_misses,
+                "latency": latency_percentiles(self._latencies),
+                "buckets": {f"bucket_{i}": b for i, b in enumerate(buckets)},
+            }
+        return out
+
+    def to_tensorboard(self, writer, step: int, prefix: str = "serve") -> int:
+        """Flush a snapshot to a (rank-0) SummaryWriter from
+        ``utils/tensorboard.py:get_summary_writer``; returns the number of
+        scalars written."""
+        from hydragnn_tpu.utils.tensorboard import write_scalar_dict
+
+        return write_scalar_dict(writer, self.snapshot(), step, prefix=prefix)

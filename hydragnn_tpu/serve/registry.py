@@ -1,0 +1,141 @@
+"""Model registry: named checkpoints -> warm, jitted forward functions.
+
+One serving process answers requests for one or more trained runs (the
+multi-headed design makes a single loaded model already serve N property
+endpoints; the registry adds the run dimension). Loading goes through
+the exact training-side machinery — ``models/create.py`` for the
+factory, ``train.create_eval_state`` for the checkpoint schema,
+``utils/checkpoint.py:load_existing_model`` for the restore — so a
+served model is bit-identical to what ``api.run_prediction`` would
+evaluate, and a ZeRO-1-trained checkpoint restores without ever
+materializing optimizer state on device.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Dict, List, Optional
+
+
+@dataclasses.dataclass
+class ServedModel:
+    """A loaded model held warm for inference: flax module + restored
+    variables + the jitted forward. ``forward`` donates the batch buffers
+    on accelerator backends (each dispatch consumes a freshly-built
+    request batch, so its device memory can be recycled into outputs);
+    CPU skips donation — XLA:CPU cannot use donated buffers and would
+    warn per dispatch."""
+
+    name: str
+    model: Any  # HydraModel
+    variables: Dict[str, Any]  # {'params': ..., 'batch_stats': ...}
+    nn_config: Optional[Dict[str, Any]] = None
+    _forward: Any = dataclasses.field(default=None, repr=False)
+
+    @property
+    def cfg(self):
+        return self.model.cfg
+
+    @property
+    def forward(self):
+        """Jitted ``(variables, batch) -> [outputs per head]`` eval
+        forward (train=False: dropout off, running BatchNorm stats —
+        identical semantics to ``train.make_eval_step``)."""
+        if self._forward is None:
+            import jax
+
+            model = self.model
+
+            def fwd(variables, batch):
+                return model.apply(variables, batch, train=False)
+
+            donate = () if jax.default_backend() == "cpu" else (1,)
+            self._forward = jax.jit(fwd, donate_argnums=donate)
+        return self._forward
+
+    def head_names(self) -> List[str]:
+        return list(self.cfg.output_names)
+
+
+class ModelRegistry:
+    """Thread-safe name -> :class:`ServedModel` map.
+
+    Two admission paths:
+      - :meth:`load`: restore a named checkpoint from a run directory
+        (the ``log_name`` convention ``api.run_training`` saves under);
+      - :meth:`register`: adopt an in-memory (model, variables) pair —
+        benches and tests serve random-init models without a checkpoint
+        round-trip.
+    """
+
+    def __init__(self, log_dir: str = "./logs/"):
+        self.log_dir = log_dir
+        self._lock = threading.Lock()
+        self._models: Dict[str, ServedModel] = {}
+
+    def register(
+        self,
+        name: str,
+        model: Any,
+        variables: Dict[str, Any],
+        nn_config: Optional[Dict[str, Any]] = None,
+    ) -> ServedModel:
+        served = ServedModel(
+            name=name, model=model, variables=dict(variables), nn_config=nn_config
+        )
+        with self._lock:
+            self._models[name] = served
+        return served
+
+    def load(
+        self,
+        log_name: str,
+        nn_config: Dict[str, Any],
+        example_graph: Any,
+        seed: int = 0,
+    ) -> ServedModel:
+        """Build the model from its (completed) ``NeuralNetwork`` config,
+        then overwrite the fresh init with the checkpoint under
+        ``<log_dir>/<log_name>/``. ``example_graph`` is one prepared
+        sample (GraphSample or graph dict) — init only needs its feature
+        shapes, not the serving pad plan. Idempotent per name: a second
+        load replaces the entry (checkpoint refresh)."""
+        from hydragnn_tpu.graph.batch import batch_graphs
+        from hydragnn_tpu.models.create import create_model_config
+        from hydragnn_tpu.serve.server import request_to_dict
+        from hydragnn_tpu.train import create_eval_state, select_optimizer
+        from hydragnn_tpu.utils.checkpoint import load_existing_model
+
+        example_batch = batch_graphs([request_to_dict(example_graph)])
+        model, variables = create_model_config(nn_config, example_batch, seed=seed)
+        # The optimizer chain defines the checkpoint's opt_state SCHEMA
+        # (freeze_conv changes the pytree structure); eval never reads it
+        # and create_eval_state keeps the restore target host-side.
+        tx = select_optimizer(
+            nn_config["Training"],
+            freeze_conv=bool(nn_config["Architecture"].get("freeze_conv_layers")),
+        )
+        state = create_eval_state(variables, tx)
+        state = load_existing_model(state, log_name, self.log_dir)
+        served = ServedModel(
+            name=log_name,
+            model=model,
+            variables={"params": state.params, "batch_stats": state.batch_stats},
+            nn_config=nn_config,
+        )
+        with self._lock:
+            self._models[log_name] = served
+        return served
+
+    def get(self, name: str) -> ServedModel:
+        with self._lock:
+            if name not in self._models:
+                raise KeyError(
+                    f"model {name!r} not in registry (loaded: {sorted(self._models)})"
+                )
+            return self._models[name]
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._models)

@@ -1,0 +1,392 @@
+"""ModelServer: the batched online-inference front end.
+
+Wiring: requests (single prepared graphs) -> bucket router
+(``serve/buckets.py``) -> deadline micro-batcher (``serve/batcher.py``)
+-> one executor thread that pads the coalesced batch to the bucket's
+plan, runs the AOT-compiled forward, and slices per-request results out
+of the padded outputs. Degradation is graceful by construction:
+
+  - a graph over every routing cap but under the LARGEST bucket's pad
+    plan dispatches immediately as a batch-of-1 on that bucket (no new
+    compile, just wasted padding);
+  - a graph over even the largest plan takes the eager path — its own
+    natural pad, compiled on first sight (counted as a compile-cache
+    MISS: the operator signal that the ladder no longer covers traffic);
+  - a full queue rejects with :class:`~hydragnn_tpu.serve.batcher.
+    Overloaded` instead of buffering unboundedly.
+
+Requests carry NO targets (there is nothing to supervise at inference
+time); the builder strips them so request batches and warmup batches
+share one pytree structure — an AOT executable is shape-exact.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from hydragnn_tpu.serve.batcher import MicroBatchQueue, Overloaded, PendingRequest
+from hydragnn_tpu.serve.buckets import Bucket, BucketCompileCache, build_bucket_ladder, route
+from hydragnn_tpu.serve.metrics import ServeMetrics
+from hydragnn_tpu.serve.registry import ServedModel
+
+
+class Oversize(RuntimeError):
+    """Request exceeds every bucket and the eager fallback is disabled."""
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Knobs of the serving path.
+
+    max_batch: graphs coalesced per device dispatch (bucket batch size).
+    num_buckets: pad-plan ladder size (before dedup of identical plans).
+    max_delay_ms: deadline before a partial batch flushes — the latency
+      budget a request can pay waiting for co-batched traffic.
+    max_pending: bounded queue across all buckets; beyond it ``submit``
+      raises Overloaded (explicit backpressure).
+    eager_fallback: compile-on-demand natural-pad path for graphs larger
+      than every bucket plan; off -> such requests raise Oversize.
+    """
+
+    max_batch: int = 8
+    num_buckets: int = 3
+    max_delay_ms: float = 5.0
+    max_pending: int = 256
+    node_multiple: int = 16
+    edge_multiple: int = 8
+    eager_fallback: bool = True
+    latency_window: int = 2048
+
+
+def request_to_dict(sample: Any) -> Dict[str, Any]:
+    """Normalize a request (GraphSample or graph dict) to the dict form
+    ``graph/batch.py:batch_graphs`` consumes, WITHOUT targets."""
+    if isinstance(sample, dict):
+        g = dict(sample)
+        if "senders" not in g:
+            ei = g.pop("edge_index", None)
+            if ei is None:
+                raise ValueError("request dict needs 'senders'/'receivers' or 'edge_index'")
+            ei = np.asarray(ei)
+            g["senders"], g["receivers"] = ei[0], ei[1]
+    else:
+        if getattr(sample, "edge_index", None) is None:
+            raise ValueError("request sample has no edge_index (no edges built)")
+        g = {
+            "x": sample.x,
+            "senders": sample.edge_index[0],
+            "receivers": sample.edge_index[1],
+        }
+        if getattr(sample, "pos", None) is not None:
+            g["pos"] = sample.pos
+        if getattr(sample, "edge_attr", None) is not None:
+            g["edge_attr"] = sample.edge_attr
+    g.pop("graph_targets", None)
+    g.pop("node_targets", None)
+    return g
+
+
+def _dict_sizes(g: Dict[str, Any]) -> tuple:
+    return int(np.asarray(g["x"]).shape[0]), int(np.asarray(g["senders"]).shape[0])
+
+
+class ModelServer:
+    """Batched online inference over one :class:`ServedModel`.
+
+    ``reference_samples`` size the bucket ladder and fix the request
+    FIELD SPEC (feature width, pos/edge_attr presence) every request
+    must match — use the prepared dataset the model was trained on.
+    """
+
+    def __init__(
+        self,
+        served: ServedModel,
+        reference_samples: Sequence,
+        config: Optional[ServeConfig] = None,
+        metrics: Optional[ServeMetrics] = None,
+    ):
+        if not reference_samples:
+            raise ValueError("reference_samples must be non-empty (sizes the buckets)")
+        self.served = served
+        self.config = config or ServeConfig()
+        self.buckets: List[Bucket] = build_bucket_ladder(
+            reference_samples,
+            self.config.max_batch,
+            num_buckets=self.config.num_buckets,
+            node_multiple=self.config.node_multiple,
+            edge_multiple=self.config.edge_multiple,
+        )
+        self.metrics = metrics or ServeMetrics(
+            len(self.buckets), latency_window=self.config.latency_window
+        )
+        ref = request_to_dict(reference_samples[0])
+        ref_x = np.asarray(ref["x"])
+        ref_ea = np.asarray(ref["edge_attr"]) if "edge_attr" in ref else None
+        self._spec = {
+            "feat_dim": int(ref_x.shape[1]) if ref_x.ndim > 1 else 1,
+            "has_pos": "pos" in ref,
+            "pos_dim": int(np.asarray(ref["pos"]).shape[-1]) if "pos" in ref else 0,
+            "has_edge_attr": ref_ea is not None,
+            "edge_dim": (
+                int(ref_ea.shape[-1]) if ref_ea is not None and ref_ea.ndim > 1 else (1 if ref_ea is not None else 0)
+            ),
+        }
+        self._cache = BucketCompileCache(
+            served.forward,
+            served.variables,
+            self._build_warm_batch,
+            metrics=self.metrics,
+        )
+        self._queue = MicroBatchQueue(
+            len(self.buckets),
+            self.config.max_batch,
+            self.config.max_delay_ms / 1e3,
+            self.config.max_pending,
+        )
+        self._eager_shapes: set = set()
+        self._eager_lock = threading.Lock()
+        self._worker: Optional[threading.Thread] = None
+        self._started = False
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "ModelServer":
+        """AOT-compile the whole bucket ladder, then start the executor
+        thread. Returns self (``serve_model(...).start()`` chains)."""
+        if self._started:
+            return self
+        self._cache.warmup(self.buckets)
+        self._worker = threading.Thread(
+            target=self._run, name="hydragnn-serve-executor", daemon=True
+        )
+        self._worker.start()
+        self._started = True
+        return self
+
+    def stop(self, timeout: Optional[float] = 10.0) -> None:
+        """Stop admitting, drain what is queued, join the executor."""
+        self._queue.close()
+        if self._worker is not None:
+            self._worker.join(timeout)
+            self._worker = None
+        self._started = False
+
+    def __enter__(self) -> "ModelServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- request path ------------------------------------------------------
+
+    def submit(self, sample: Any) -> Future:
+        """Admit one graph; returns a Future resolving to
+        ``{head_name: np.ndarray}`` (graph heads: [d]; node heads:
+        [n_nodes, d], this graph's rows only). Raises Overloaded on
+        backpressure and Oversize when nothing can take the graph."""
+        if not self._started:
+            raise RuntimeError("server not started (call start())")
+        g = self._validated(request_to_dict(sample))
+        n, e = _dict_sizes(g)
+        bucket = route(self.buckets, n, e)
+        if bucket is not None:
+            self.metrics.record_request(bucket.index)
+            try:
+                fut = self._queue.put(bucket.index, g)
+            except Overloaded:
+                self.metrics.record_reject()
+                raise
+            self.metrics.set_queue_depth(self._queue.depth())
+            return fut
+        return self._submit_oversize(g, n, e)
+
+    def predict(self, sample: Any, timeout: Optional[float] = None) -> Dict[str, np.ndarray]:
+        """Blocking single-request convenience around :meth:`submit`."""
+        return self.submit(sample).result(timeout)
+
+    def predict_many(
+        self, samples: Sequence[Any], timeout: Optional[float] = None
+    ) -> List[Dict[str, np.ndarray]]:
+        futures = [self.submit(s) for s in samples]
+        return [f.result(timeout) for f in futures]
+
+    def metrics_snapshot(self) -> dict:
+        return self.metrics.snapshot()
+
+    # -- oversize fallbacks ------------------------------------------------
+
+    def _submit_oversize(self, g: Dict[str, Any], n: int, e: int) -> Future:
+        self.metrics.record_request(None)
+        fut: Future = Future()
+        largest = self.buckets[-1]
+        if largest.fits_totals(n, e, 1):
+            # over the per-graph routing caps (sized for max_batch
+            # co-tenants) but within the biggest plan alone: dispatch
+            # unbatched on the ALREADY-COMPILED largest bucket
+            self.metrics.record_oversize("largest_bucket")
+            t0 = time.monotonic()
+            reqs = [PendingRequest(g, fut, t0, largest.index)]
+            try:
+                self._execute_bucket(largest.index, reqs, reason="oversize")
+            except BaseException as exc:
+                self.metrics.record_error()
+                if not fut.done():
+                    fut.set_exception(exc)
+            return fut
+        if not self.config.eager_fallback:
+            self.metrics.record_error()
+            fut.set_exception(
+                Oversize(
+                    f"graph ({n} nodes, {e} edges) exceeds the largest bucket "
+                    f"plan {largest.node_pad}/{largest.edge_pad} and "
+                    "eager_fallback is disabled"
+                )
+            )
+            return fut
+        self.metrics.record_oversize("eager")
+        t0 = time.monotonic()
+        try:
+            result = self._execute_eager(g)
+            fut.set_result(result)
+            self.metrics.observe_latency(time.monotonic() - t0)
+        except BaseException as exc:
+            self.metrics.record_error()
+            fut.set_exception(exc)
+        return fut
+
+    def _execute_eager(self, g: Dict[str, Any]) -> Dict[str, np.ndarray]:
+        """Natural-pad unbatched call through the plain jit cache. Each
+        NEW padded shape is a fresh XLA compile — recorded as a
+        compile-cache miss; repeats of a shape hit jit's own cache."""
+        from hydragnn_tpu.graph.batch import batch_graphs
+
+        batch = batch_graphs(
+            [g],
+            node_multiple=self.config.node_multiple,
+            edge_multiple=self.config.edge_multiple,
+        )
+        shape_key = (batch.num_nodes, batch.num_edges, batch.num_graphs)
+        with self._eager_lock:
+            seen = shape_key in self._eager_shapes
+            self._eager_shapes.add(shape_key)
+        self.metrics.record_compile(hit=seen)
+        outputs = self.served.forward(self.served.variables, batch)
+        n, _ = _dict_sizes(g)
+        return self._slice_result(outputs, graph_index=0, node_offset=0, num_nodes=n)
+
+    # -- executor ----------------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            got = self._queue.take_batch()
+            if got is None:
+                return
+            bucket_index, requests, reason = got
+            self.metrics.set_queue_depth(self._queue.depth())
+            try:
+                self._execute_bucket(bucket_index, requests, reason)
+            except BaseException as exc:  # surface to every caller, keep serving
+                self.metrics.record_error(len(requests))
+                for r in requests:
+                    if not r.future.done():
+                        r.future.set_exception(exc)
+
+    def _execute_bucket(
+        self, bucket_index: int, requests: List[PendingRequest], reason: str
+    ) -> None:
+        from hydragnn_tpu.graph.batch import batch_graphs
+
+        bucket = self.buckets[bucket_index]
+        dicts = [r.item for r in requests]
+        batch = batch_graphs(
+            dicts,
+            n_node_pad=bucket.node_pad,
+            n_edge_pad=bucket.edge_pad,
+            n_graph_pad=bucket.graph_pad,
+        )
+        exe = self._cache.executable(bucket)
+        outputs = [np.asarray(o) for o in exe(self.served.variables, batch)]
+        self.metrics.record_batch(
+            bucket_index, len(requests), bucket.max_batch, reason
+        )
+        t_done = time.monotonic()
+        node_offset = 0
+        for gi, r in enumerate(requests):
+            n, _ = _dict_sizes(r.item)
+            result = self._slice_result(
+                outputs, graph_index=gi, node_offset=node_offset, num_nodes=n
+            )
+            node_offset += n
+            r.future.set_result(result)
+            self.metrics.observe_latency(t_done - r.t_enqueue)
+
+    def _slice_result(
+        self, outputs, graph_index: int, node_offset: int, num_nodes: int
+    ) -> Dict[str, np.ndarray]:
+        cfg = self.served.cfg
+        result: Dict[str, np.ndarray] = {}
+        for ihead in range(cfg.num_heads):
+            out = np.asarray(outputs[ihead])
+            if cfg.output_type[ihead] == "graph":
+                result[cfg.output_names[ihead]] = out[graph_index]
+            else:
+                result[cfg.output_names[ihead]] = out[
+                    node_offset : node_offset + num_nodes
+                ]
+        return result
+
+    # -- batch construction ------------------------------------------------
+
+    def _validated(self, g: Dict[str, Any]) -> Dict[str, Any]:
+        """Enforce the field spec: AOT executables are pytree-exact, so a
+        request whose optional fields differ from the reference spec must
+        fail loudly at admission, not as an opaque structure error inside
+        the executor."""
+        spec = self._spec
+        x = np.asarray(g["x"])
+        feat = x.shape[1] if x.ndim > 1 else 1
+        if feat != spec["feat_dim"]:
+            raise ValueError(
+                f"request feature width {feat} != model's {spec['feat_dim']}"
+            )
+        if ("pos" in g) != spec["has_pos"]:
+            raise ValueError(
+                "request 'pos' presence does not match the serving spec "
+                f"(expected {'present' if spec['has_pos'] else 'absent'})"
+            )
+        if ("edge_attr" in g) != spec["has_edge_attr"]:
+            raise ValueError(
+                "request 'edge_attr' presence does not match the serving spec "
+                f"(expected {'present' if spec['has_edge_attr'] else 'absent'})"
+            )
+        return g
+
+    def _build_warm_batch(self, bucket: Bucket):
+        """A structurally representative batch at ``bucket``'s plan for
+        AOT lowering: one minimal graph matching the field spec, padded
+        to the plan — the same builder and options as request batches,
+        so the traced structure is exact."""
+        from hydragnn_tpu.graph.batch import batch_graphs
+
+        spec = self._spec
+        g: Dict[str, Any] = {
+            "x": np.zeros((2, spec["feat_dim"]), dtype=np.float32),
+            "senders": np.zeros((1,), dtype=np.int32),
+            "receivers": np.ones((1,), dtype=np.int32),
+        }
+        if spec["has_pos"]:
+            g["pos"] = np.zeros((2, spec["pos_dim"]), dtype=np.float32)
+        if spec["has_edge_attr"]:
+            g["edge_attr"] = np.zeros((1, spec["edge_dim"]), dtype=np.float32)
+        return batch_graphs(
+            [g],
+            n_node_pad=bucket.node_pad,
+            n_edge_pad=bucket.edge_pad,
+            n_graph_pad=bucket.graph_pad,
+        )

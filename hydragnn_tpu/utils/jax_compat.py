@@ -1,0 +1,84 @@
+"""Version-compat shims for the jax APIs this tree straddles.
+
+The dev TPU image runs a recent jax (``jax.shard_map``, ``check_vma``,
+varying-manual-axes types via ``jax.typeof``/``jax.lax.pvary``,
+``ShapeDtypeStruct(..., vma=...)``); CPU CI containers can carry 0.4.x,
+where shard_map lives under ``jax.experimental`` with the kwarg named
+``check_rep`` and the vma machinery does not exist at all. One tree must
+import and run on both, so every usage goes through here:
+
+  - :data:`shard_map` — resolved once; translates ``check_vma`` to
+    ``check_rep`` when needed (same semantics, renamed kwarg).
+  - :func:`typeof_vma` / :func:`pvary` — the manual-axes queries; on jax
+    without vma tracking they degrade to "varies over nothing" / identity,
+    which is exactly the pre-vma behavior those versions implement.
+  - :func:`shape_dtype_struct` — drops the ``vma`` argument when the
+    constructor predates it.
+
+Import this module, not the jax spellings, anywhere version-sensitive.
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+
+import jax
+
+try:
+    _shard_map_impl = jax.shard_map
+except AttributeError:  # jax < 0.5 keeps shard_map under experimental
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+
+if "check_vma" in inspect.signature(_shard_map_impl).parameters:
+    shard_map = _shard_map_impl
+else:
+
+    @functools.wraps(_shard_map_impl)
+    def shard_map(*args, check_vma=None, **kwargs):
+        if check_vma is not None:
+            kwargs.setdefault("check_rep", check_vma)
+        return _shard_map_impl(*args, **kwargs)
+
+
+_HAS_VMA = hasattr(jax, "typeof") and hasattr(jax.lax, "pvary")
+_SDS_HAS_VMA = "vma" in inspect.signature(jax.ShapeDtypeStruct.__init__).parameters
+
+
+def typeof_vma(x) -> frozenset:
+    """The manual-mesh axes ``x`` varies over (empty outside shard_map,
+    and always empty on jax without vma tracking)."""
+    if not _HAS_VMA:
+        return frozenset()
+    return frozenset(getattr(jax.typeof(x), "vma", frozenset()))
+
+
+def pvary(x, axes):
+    """``jax.lax.pvary`` where it exists; identity otherwise (pre-vma jax
+    has no per-operand varying-axes check to satisfy)."""
+    axes = tuple(axes)
+    if not axes or not _HAS_VMA:
+        return x
+    return jax.lax.pvary(x, axes)
+
+
+def shape_dtype_struct(shape, dtype, vma: frozenset = frozenset()):
+    """``jax.ShapeDtypeStruct`` carrying ``vma`` when the constructor
+    supports it (required under check_vma=True shard_map); without
+    support the plain struct is exactly what that jax expects."""
+    if _SDS_HAS_VMA and vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def def_partition(op, *, partition, infer_sharding_from_operands, sharding_rule):
+    """``custom_partitioning.def_partition`` across versions: the shardy
+    ``sharding_rule`` spec only exists on newer jax; 0.4.x takes the same
+    partition/infer pair and propagates through classic GSPMD."""
+    kwargs = dict(
+        partition=partition,
+        infer_sharding_from_operands=infer_sharding_from_operands,
+    )
+    if "sharding_rule" in inspect.signature(op.def_partition).parameters:
+        kwargs["sharding_rule"] = sharding_rule
+    op.def_partition(**kwargs)

@@ -16,6 +16,33 @@ import re
 _COUNT_FLAG = "--xla_force_host_platform_device_count"
 
 
+class BackendInitError(RuntimeError):
+    """The pinned JAX backend failed to initialize.
+
+    Raised by :func:`pin_platform_from_env` instead of letting the raw
+    jax RuntimeError unwind: driver-facing scripts (bench.py,
+    bench_serve.py) catch this and emit ``.record`` — a compact
+    structured failure line — rather than dying mid-traceback (the r05
+    ``rc=1`` capture this exists for). ``.record`` keeps the backend's
+    message truncated so the whole record survives a ~2000-char stdout
+    tail capture."""
+
+    def __init__(self, platform: str, cause: BaseException, stage: str = "backend_init"):
+        msg = str(cause).strip() or repr(cause)
+        # keep the tail: jax backend errors put the actionable line last
+        short = msg[-400:] if len(msg) > 400 else msg
+        super().__init__(
+            f"JAX backend init failed for JAX_PLATFORMS={platform!r}: {short}"
+        )
+        self.record = {
+            "failure": "backend_init",
+            "stage": stage,
+            "jax_platforms": platform,
+            "error": short,
+            "error_type": type(cause).__name__,
+        }
+
+
 def pin_virtual_cpu_mesh(n_devices: int = 8) -> None:
     """Force jax onto a virtual CPU mesh of at least ``n_devices`` devices.
 
@@ -88,7 +115,15 @@ def pin_platform_from_env() -> None:
     # JAX_PLATFORMS may be a priority list ("tpu,cpu"); any entry is a
     # legitimate outcome (jax falls back down the list)
     wants = [p.strip().lower() for p in plat.split(",") if p.strip()]
-    got = jax.default_backend().lower()
+    try:
+        got = jax.default_backend().lower()
+    # RuntimeError on current jax; older xla_bridge builds can surface a
+    # bare AssertionError from backends() when no platform comes up
+    except (RuntimeError, AssertionError) as exc:
+        # the pinned backend exists but cannot come up (driver handed us
+        # an unreachable device, plugin crash, ...): surface a typed,
+        # structured failure the calling script can report cleanly
+        raise BackendInitError(plat, exc) from exc
     if got not in wants:
         print(
             f"WARNING: JAX_PLATFORMS={plat!r} requested but the jax backend "

@@ -26,6 +26,26 @@ class _NullWriter:
         pass
 
 
+def write_scalar_dict(writer, scalars: dict, step: int, prefix: str = "") -> int:
+    """Flush a (possibly nested) dict of numbers to ``writer`` as
+    ``prefix/key/subkey`` scalar tags; non-numeric leaves are skipped.
+    Returns the number of scalars written. The serving metrics surface
+    (hydragnn_tpu/serve/metrics.py:ServeMetrics.to_tensorboard) exports
+    through this, so serve dashboards ride the same rank-0 writer
+    plumbing as training losses."""
+    written = 0
+    for key, value in scalars.items():
+        tag = f"{prefix}/{key}" if prefix else str(key)
+        if isinstance(value, dict):
+            written += write_scalar_dict(writer, value, step, prefix=tag)
+        elif isinstance(value, bool):
+            continue
+        elif isinstance(value, (int, float)):
+            writer.add_scalar(tag, value, step)
+            written += 1
+    return written
+
+
 def get_summary_writer(log_name: str, log_dir: str = "./logs/"):
     """Rank-0 SummaryWriter under ``<log_dir>/<log_name>``; null writer on
     other ranks or when tensorboard is not importable."""

@@ -13,8 +13,24 @@ import sys
 
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 # Persistent compilation cache: repeated test runs skip recompilation.
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_test_cache")
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+# Gated OFF on jax < 0.5: the 0.4.x persistent cache round-trips jitted
+# executables without their input-output aliasing (donation) metadata, so
+# a WARM cache hit returns a train step whose optimizer update never
+# lands (probed: cold run passes, identical warm rerun fails; it can also
+# abort outright). Correctness beats rerun speed there.
+# importlib.metadata, not `import jax` — jax must not load before the
+# platform pin below.
+try:
+    from importlib.metadata import version as _pkg_version
+
+    _jax_major_minor = tuple(
+        int(p) for p in _pkg_version("jax").split(".")[:2]
+    )
+except Exception:  # unknown/dev version string: assume current jax
+    _jax_major_minor = (99, 0)
+if _jax_major_minor >= (0, 5):
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_test_cache")
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
 
 # The ambient image registers a remote-TPU ("axon") PJRT plugin through
 # sitecustomize and pre-sets JAX_PLATFORMS=axon; if that backend wins, test

@@ -6,6 +6,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 
+from hydragnn_tpu.utils.jax_compat import shard_map
 from hydragnn_tpu.ops import (
     segment_sum_family_pallas,
     segment_sum_family_xla,
@@ -328,7 +329,7 @@ def pytest_partitioned_family_inside_shard_map(monkeypatch):
     # check_vma=False matches every in-tree shard_map (sharded.py,
     # edge_sharded.py); interpret-mode pallas does not propagate vma
     fn = jax.jit(
-        jax.shard_map(
+        shard_map(
             local, mesh=mesh, in_specs=(P("data"), P("data")),
             out_specs=P("data"), check_vma=False,
         )
@@ -614,7 +615,7 @@ def pytest_pna_aggregate_grad_inside_shard_map(monkeypatch):
         return ((s * s).sum() + sq.sum() + both.sum())[None]
 
     def loss(d, i):
-        per = jax.shard_map(
+        per = shard_map(
             local_loss, mesh=mesh, in_specs=(P("data"), P("data")),
             out_specs=P("data"), check_vma=False,
         )(d, i)
