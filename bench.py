@@ -498,7 +498,9 @@ def emit_backend_failure(metric: str, exc) -> "SystemExit":
     """Print ONE structured JSON failure line (the record a
     ``hydragnn_tpu.utils.platform.BackendInitError`` carries, or a
     synthesized one) and return a clean SystemExit — drivers capture a
-    parseable record instead of a raw traceback (ISSUE r05 Weak #1)."""
+    parseable record instead of a raw traceback (ISSUE r05 Weak #1).
+    The record carries ``retries`` (attempts beyond the first that
+    ``init_backend_with_retry`` burned before giving up)."""
     record = getattr(
         exc,
         "record",
@@ -510,25 +512,67 @@ def emit_backend_failure(metric: str, exc) -> "SystemExit":
             "error_type": type(exc).__name__,
         },
     )
+    record.setdefault("retries", 0)
     print(json.dumps({"metric": metric, "value": None, "unit": None, **record}))
     return SystemExit(1)
+
+
+def open_bench_flight(default_name: str) -> "object":
+    """Fresh flight recorder for a bench run — the self-contained JSONL
+    evidence artifact committed next to the BENCH_*.json records
+    (docs/OBSERVABILITY.md). ``BENCH_FLIGHT`` overrides the path; the
+    file is truncated per run (each bench run is one flight)."""
+    from hydragnn_tpu.obs import FlightRecorder
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    path = os.environ.get("BENCH_FLIGHT", os.path.join(here, default_name))
+    try:
+        os.remove(path)
+    except OSError:
+        pass
+    return FlightRecorder(path)
+
+
+def init_device_with_flight(metric: str, flight):
+    """Backend init with bounded retry-with-backoff (~5 attempts over
+    ~2 min for transient UNAVAILABLE-class failures; config errors fail
+    fast), every retry and the terminal failure recorded into the
+    flight record. Returns (device, retries)."""
+    from hydragnn_tpu.utils.platform import (
+        BackendInitError,
+        init_backend_with_retry,
+    )
+
+    def _on_retry(attempt, exc, delay):
+        flight.retry(
+            attempt, str(exc), stage="backend_init", next_delay_s=delay
+        )
+        print(
+            f"backend init attempt {attempt} failed ({str(exc).strip()[-200:]});"
+            f" retrying in {delay:.0f}s",
+            file=sys.stderr,
+        )
+
+    try:
+        devices, retries = init_backend_with_retry(on_retry=_on_retry)
+    except (BackendInitError, RuntimeError, AssertionError) as exc:
+        flight.error(exc, stage="backend_init")
+        flight.end_run(status="failed")
+        flight.close()
+        raise emit_backend_failure(metric, exc) from exc
+    return devices[0], retries
 
 
 def main() -> None:
     # honor an explicit JAX_PLATFORMS (e.g. cpu for CI smoke) — the axon
     # plugin image overrides the env unless pinned through jax.config
     # BEFORE backend init (hydragnn_tpu/utils/platform.py); without a
-    # pin the bench stays on the real device the driver provides
-    from hydragnn_tpu.utils.platform import BackendInitError, pin_platform_from_env
-
+    # pin the bench stays on the real device the driver provides.
+    # Transient init failures retry with backoff; the flight record is
+    # the evidence artifact either way.
     _metric = "flagship_pna_multihead_train_throughput"
-    try:
-        pin_platform_from_env()
-        import jax
-
-        device = jax.devices()[0]
-    except (BackendInitError, RuntimeError, AssertionError) as exc:
-        raise emit_backend_failure(_metric, exc) from exc
+    flight = open_bench_flight("BENCH_FLIGHT.jsonl")
+    device, init_retries = init_device_with_flight(_metric, flight)
     peak = _peak_flops(device)
     bf16 = os.environ.get("BENCH_BF16", "1") == "1"
     cache = os.environ.get("BENCH_CACHE", "0") == "1"
@@ -580,10 +624,45 @@ def main() -> None:
     scan = os.environ.get("BENCH_SCAN", "0") == "1"
     configs: dict = {}
 
+    flight.start_run(
+        {
+            "mode": "bench",
+            "metric": _metric,
+            "device_kind": getattr(device, "device_kind", str(device)),
+            "configs": which,
+            "bf16": bf16,
+            "smoke": smoke,
+            "dispatch_ms": dispatch_ms,
+            "init_retries": init_retries,
+            "knobs": {
+                "samples": n_samples,
+                "batch": batch_size,
+                "hidden": hidden,
+                "layers": layers,
+                "steps": measure_steps,
+            },
+        }
+    )
+
+    def _run_config(name: str, **kw) -> dict:
+        """One bench config, flight-recorded: the result event lands as
+        soon as the config finishes, so a later config dying (the r05
+        artifact failure mode) cannot erase the evidence of the ones
+        that ran."""
+        try:
+            out = _bench_one(name, **kw)
+        except BaseException as exc:
+            flight.error(exc, stage=f"config:{name}")
+            flight.end_run(status="failed")
+            flight.close()
+            raise
+        flight.record("bench_config", name=name, result=out)
+        return out
+
     # headline first: the tunnel throttles after a dispatch burst, so the
     # round-over-round comparable number gets the fresh budget
     if "flagship" in which:
-        configs["flagship_tiny_bcc"] = _bench_one(
+        configs["flagship_tiny_bcc"] = _run_config(
             "flagship_tiny_bcc",
             n_samples=n_samples,
             batch_size=batch_size,
@@ -602,7 +681,7 @@ def main() -> None:
         # QM9-realistic: molecule-sized graphs (QM9 mean ~18 heavy+H
         # atoms), length edge features through the PNA stack, the
         # examples/qm9 architecture shape
-        configs["qm9_scale"] = _bench_one(
+        configs["qm9_scale"] = _run_config(
             "qm9_scale",
             n_samples=48 if smoke else 384,
             batch_size=16 if smoke else 256,
@@ -623,7 +702,7 @@ def main() -> None:
         )
     if "large" in which:
         # large graphs (hundreds of nodes: OC-supercell scale per graph)
-        configs["large_graph"] = _bench_one(
+        configs["large_graph"] = _run_config(
             "large_graph",
             n_samples=12 if smoke else 48,
             batch_size=4 if smoke else 32,
@@ -662,6 +741,7 @@ def main() -> None:
         "unit": "graphs/sec",
         "vs_baseline": round(vs_baseline, 3),
         "timing": "d2h-sync",
+        "init_retries": init_retries,
         "vs_baseline_note": (
             "r01 measured dispatch-ack timing (no device sync; see module "
             "docstring) — comparable baselines start at r02"
@@ -722,6 +802,14 @@ def main() -> None:
         compact["summary"].pop(next(reversed(compact["summary"])))
         compact["summary_truncated"] = True
         line = json.dumps(compact)
+    flight.end_run(
+        status="completed",
+        metric=metric,
+        value=graphs_per_sec,
+        vs_baseline=round(vs_baseline, 3),
+        init_retries=init_retries,
+    )
+    flight.close()
     print(line)
 
 

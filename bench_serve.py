@@ -31,18 +31,16 @@ import time
 
 
 def main() -> None:
-    from hydragnn_tpu.utils.platform import BackendInitError, pin_platform_from_env
+    from bench import init_device_with_flight, open_bench_flight
 
     metric = "serve_bucketed_throughput"
-    try:
-        pin_platform_from_env()
-        import jax  # noqa: F401
-
-        jax.devices()
-    except (BackendInitError, RuntimeError) as exc:
-        from bench import emit_backend_failure
-
-        raise emit_backend_failure(metric, exc) from exc
+    # backend init with bounded transient-failure retry + a fresh flight
+    # record: the serving bench leaves the same self-contained JSONL
+    # evidence artifact training and bench.py do (BENCH_FLIGHT overrides
+    # the path for both benches; default name differs so one round can
+    # keep both artifacts)
+    flight = open_bench_flight("BENCH_SERVE_FLIGHT.jsonl")
+    device, init_retries = init_device_with_flight(metric, flight)
 
     import numpy as np
 
@@ -82,6 +80,7 @@ def main() -> None:
             max_delay_ms=delay_ms,
             max_pending=max(4 * max_batch * n_threads, 64),
         ),
+        flight=flight,
     )
     t0 = time.perf_counter()
     server.start()  # AOT-compiles the whole bucket ladder
@@ -126,6 +125,7 @@ def main() -> None:
         "metric": metric,
         "value": round(n_requests / wall, 2),
         "unit": "graphs/sec",
+        "init_retries": init_retries,
         "requests": n_requests,
         "threads": n_threads,
         "max_batch": max_batch,
@@ -143,6 +143,14 @@ def main() -> None:
         "rejected_overload": snap["rejected_overload"],
         "errors": errors[:3],
     }
+    # server.stop() already logged its run_end (metrics snapshot); the
+    # bench's own verdict rides a final event, then the file closes
+    flight.record(
+        "bench_result",
+        record=record,
+        passed=bool(not errors and misses_after_warmup == 0),
+    )
+    flight.close()
     print(json.dumps(record))
     if errors:
         raise SystemExit(1)

@@ -9,7 +9,12 @@
 #                         baked TPU image ships no formatter, so the gate
 #                         degrades to a full-tree syntax check (compileall)
 #                         and prints which gate ran.
-#   2. serial suite     — python -m pytest tests/ -q on the virtual
+#   2. chip hygiene     — tools/chip_hygiene.py reports processes holding
+#                         accelerator devices/lockfiles (informational:
+#                         a lingering holder from a dead run is the
+#                         transient-init failure class bench.py retries
+#                         through; VERDICT r05 next-round #1).
+#   3. serial suite     — python -m pytest tests/ -q on the virtual
 #                         8-device CPU mesh (conftest pins it). This
 #                         INCLUDES the 2-OS-process distributed pass: the
 #                         reference re-runs its whole suite under
@@ -18,45 +23,79 @@
 #                         spawns 2 python processes with a shared
 #                         coordinator itself (TPU-native launch shape —
 #                         jax.distributed, not MPI).
-#   3. full matrix      — opt-in (CI_FULL=1): all 7 models x head configs
+#   4. telemetry smoke  — one tiny training through api.run_training,
+#                         then the emitted flight record is schema-
+#                         validated (tools/obs_report.py --validate
+#                         --require-complete) and pretty-printed: the
+#                         committed proof that a default run leaves a
+#                         parseable evidence artifact
+#                         (docs/OBSERVABILITY.md).
+#   5. full matrix      — opt-in (CI_FULL=1): all 7 models x head configs
 #                         trained to the reference accuracy thresholds
 #                         (HYDRAGNN_FULL_MATRIX=1, ~15 min).
-#   4. TPU kernel suite — opt-in (CI_TPU=1, needs a real TPU):
+#   6. TPU kernel suite — opt-in (CI_TPU=1, needs a real TPU):
 #                         HYDRAGNN_TPU_TESTS=1 on-chip kernel-vs-XLA
 #                         checks, budgeted under the tunnel's dispatch
 #                         throttle (tests/test_tpu_chip.py).
 #
-# Usage: ./ci.sh            # stages 1-2 (the default CI gate)
+# Usage: ./ci.sh            # stages 1-4 (the default CI gate)
 #        CI_FULL=1 ./ci.sh  # + acceptance matrix
 #        CI_TPU=1  ./ci.sh  # + real-chip kernel suite
 set -euo pipefail
 cd "$(dirname "$0")"
 
-echo "== [1/4] format gate =="
+echo "== [1/6] format gate =="
 if python -m black --version >/dev/null 2>&1; then
     python -m black --check .
 elif command -v black >/dev/null 2>&1; then
     black --check .
 else
     echo "black not installed in this image; running syntax gate (compileall)"
-    python -m compileall -q hydragnn_tpu tests examples bench.py bench_scaling.py __graft_entry__.py
+    python -m compileall -q hydragnn_tpu tests examples tools bench.py bench_scaling.py bench_serve.py __graft_entry__.py
 fi
 
-echo "== [2/4] serial suite (virtual 8-device CPU mesh, incl. 2-process pass) =="
+echo "== [2/6] chip hygiene report =="
+python tools/chip_hygiene.py || true
+
+echo "== [3/6] serial suite (virtual 8-device CPU mesh, incl. 2-process pass) =="
 python -m pytest tests/ -q
 
+echo "== [4/6] telemetry smoke (tiny training -> schema-valid flight record) =="
+SMOKE_DIR="$(mktemp -d)"
+JAX_PLATFORMS=cpu python - "$SMOKE_DIR" <<'EOF'
+import sys
+
+from hydragnn_tpu.api import run_training
+from hydragnn_tpu.data.synthetic import deterministic_graph_data
+from hydragnn_tpu.flagship import flagship_config
+
+cfg = flagship_config(hidden_dim=8, num_conv_layers=2, batch_size=5, num_epoch=2)
+samples = deterministic_graph_data(
+    number_configurations=20,
+    unit_cell_x_range=(2, 3),
+    unit_cell_y_range=(2, 3),
+    unit_cell_z_range=(2, 3),
+    seed=0,
+)
+run_training(cfg, samples=samples, log_dir=sys.argv[1] + "/logs/")
+EOF
+FLIGHT="$(ls "$SMOKE_DIR"/logs/*/flight.jsonl)"
+python tools/obs_report.py --validate --require-complete "$FLIGHT"
+python tools/obs_report.py "$FLIGHT"
+rm -rf "$SMOKE_DIR"
+
 if [ "${CI_FULL:-0}" = "1" ]; then
-    echo "== [3/4] full acceptance matrix (reference thresholds) =="
+    echo "== [5/6] full acceptance matrix (reference thresholds) =="
     HYDRAGNN_FULL_MATRIX=1 python -m pytest tests/test_train_matrix.py -q
 else
-    echo "== [3/4] full acceptance matrix: skipped (set CI_FULL=1) =="
+    echo "== [5/6] full acceptance matrix: skipped (set CI_FULL=1) =="
 fi
 
 if [ "${CI_TPU:-0}" = "1" ]; then
-    echo "== [4/4] real-chip TPU kernel suite =="
+    echo "== [6/6] real-chip TPU kernel suite =="
     HYDRAGNN_TPU_TESTS=1 python -m pytest tests/test_tpu_chip.py -q
 else
-    echo "== [4/4] real-chip TPU kernel suite: skipped (set CI_TPU=1, needs a TPU) =="
+    echo "== [6/6] real-chip TPU kernel suite: skipped (set CI_TPU=1, needs a TPU) =="
 fi
 
 echo "CI protocol complete."

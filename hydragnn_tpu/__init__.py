@@ -23,6 +23,7 @@ Public entry points mirror the reference API surface
 
 from hydragnn_tpu import graph  # noqa: F401
 from hydragnn_tpu import models  # noqa: F401
+from hydragnn_tpu import obs  # noqa: F401
 from hydragnn_tpu import utils  # noqa: F401
 
 __version__ = "0.1.0"

@@ -280,6 +280,10 @@ def train_with_loaders(
         eval_step=eval_step,
         eval_step_out=eval_step_out,
         stats_step=stats_step,
+        # the FULL resolved config goes into the flight-record manifest
+        # (the NeuralNetwork section alone loses Dataset/Verbosity —
+        # docs/OBSERVABILITY.md documents the manifest contract)
+        run_config=config,
     )
 
     save_model(state, log_name, log_dir, verbosity)

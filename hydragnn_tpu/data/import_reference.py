@@ -39,7 +39,6 @@ from __future__ import annotations
 import io
 import os
 import pickle
-import warnings
 from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
@@ -221,24 +220,24 @@ def _unpack_y(
         )
         if head_types is not None and h < len(head_types):
             htype = head_types[h]
-        else:
-            htype = (
-                "node"
-                if seg.shape[0] % n_nodes == 0 and seg.shape[0] >= n_nodes
-                else "graph"
+        elif seg.shape[0] % n_nodes == 0 and seg.shape[0] >= n_nodes:
+            # A graph head whose dim happens to be a multiple of
+            # num_nodes is indistinguishable from a node head here, and
+            # silent misinference reshapes (= corrupts) targets. This
+            # used to be a warning; an importer that keeps going on a
+            # coin-flip classification writes a permanently wrong
+            # container, so it is a hard error with an escape hatch.
+            raise ValueError(
+                f"head {h} ({name!r}): length {seg.shape[0]} divides "
+                f"num_nodes={n_nodes}, so it could be a node head "
+                f"([{n_nodes}, {seg.shape[0] // n_nodes}]) or a "
+                f"graph-level head of dim {seg.shape[0]} — ambiguous. "
+                "Pass head_types=['graph'|'node', ...] (CLI: repeat "
+                "--head-type in y_loc order) to pin every head "
+                "explicitly."
             )
-            if htype == "node":
-                # A graph head whose dim happens to be a multiple of
-                # num_nodes is indistinguishable from a node head here;
-                # silent misinference would reshape (= corrupt) targets.
-                warnings.warn(
-                    f"head {h} ({name!r}): inferred 'node' because its "
-                    f"length {seg.shape[0]} divides num_nodes={n_nodes}; "
-                    "a graph-level head of that size would be "
-                    "misclassified — pass head_types/--head-type to pin "
-                    "it explicitly",
-                    stacklevel=2,
-                )
+        else:
+            htype = "graph"
         if htype == "node":
             out["node_targets"][name] = seg.reshape(n_nodes, -1)
         else:

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import math
 import os
+import time
 from typing import Iterator, List, Optional, Sequence
 
 import jax
@@ -409,10 +410,30 @@ class GraphLoader:
             for b in batch_order:
                 yield self._cached_batches[b]
             return
+        # Prefetch accounting into the shared telemetry registry
+        # (hydragnn_tpu/obs): build_s is host batching + H2D placement,
+        # prefetch_wait_s is time the CONSUMER blocked on the queue (the
+        # part the producer thread failed to hide — the loader's share
+        # of the train loop's data-wait span). Null counters when
+        # telemetry is off; the timing branches are skipped entirely.
+        from hydragnn_tpu.obs.registry import get_registry
+
+        _reg = get_registry()
+        _obs_on = _reg.enabled
+        _c_build = _reg.counter("loader.build_s")
+        _c_batches = _reg.counter("loader.batches_built")
+        _c_wait = _reg.counter("loader.prefetch_wait_s")
+        _c_stalls = _reg.counter("loader.prefetch_stalls")
+
         order = self._order()
         if self.prefetch <= 0:
             for b in range(nb):
-                yield self._place(self._make_batch(order[b * bs : (b + 1) * bs]))
+                t0 = time.perf_counter() if _obs_on else 0.0
+                batch = self._place(self._make_batch(order[b * bs : (b + 1) * bs]))
+                if _obs_on:
+                    _c_build.inc(time.perf_counter() - t0)
+                    _c_batches.inc()
+                yield batch
             return
         # Background producer thread: batch assembly + H2D transfer
         # overlap with device compute (the reference's HydraDataLoader
@@ -437,7 +458,11 @@ class GraphLoader:
         def producer():
             try:
                 for b in range(nb):
+                    t0 = time.perf_counter() if _obs_on else 0.0
                     batch = self._place(self._make_batch(order[b * bs : (b + 1) * bs]))
+                    if _obs_on:
+                        _c_build.inc(time.perf_counter() - t0)
+                        _c_batches.inc()
                     if not put_stop_aware(batch):
                         return
                 put_stop_aware(sentinel)
@@ -448,7 +473,15 @@ class GraphLoader:
         t.start()
         try:
             while True:
-                item = q.get()
+                if _obs_on:
+                    t0 = time.perf_counter()
+                    item = q.get()
+                    dt = time.perf_counter() - t0
+                    _c_wait.inc(dt)
+                    if dt > 1e-3:  # the producer was actually behind
+                        _c_stalls.inc()
+                else:
+                    item = q.get()
                 if item is sentinel:
                     break
                 if isinstance(item, BaseException):

@@ -1,0 +1,70 @@
+"""Unified telemetry: metrics registry, flight recorder, span tracing,
+compile monitoring — the one observability layer train, serve, the
+loader, and the benches all emit into (docs/OBSERVABILITY.md).
+
+Pieces:
+  - :mod:`~hydragnn_tpu.obs.registry` — counters / gauges / windowed
+    histograms in a rank-aware store; null-object disabled path.
+  - :mod:`~hydragnn_tpu.obs.flight` — crash-safe append-only JSONL
+    event log per run (manifest, epochs, compiles, errors, summary).
+  - :mod:`~hydragnn_tpu.obs.spans` — data-wait / host-dispatch /
+    device-execute step-time decomposition with a sampled sync window.
+  - :mod:`~hydragnn_tpu.obs.compile_monitor` — ``jax.monitoring``-based
+    compile counting ("no recompile after step 1", now assertable).
+  - :mod:`~hydragnn_tpu.obs.export` — tensorboard / JSONL / Prometheus
+    textfile exporters over the registry.
+
+Global gate: ``HYDRAGNN_TELEMETRY=0`` disables the process-global
+registry and everything the train loop wires up; each piece is also
+individually constructible as enabled/disabled.
+"""
+
+from hydragnn_tpu.obs.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    get_registry,
+    reset_registry,
+    telemetry_enabled,
+)
+from hydragnn_tpu.obs.flight import (
+    SCHEMA_VERSION,
+    FlightRecorder,
+    read_flight_record,
+    validate_flight_record,
+)
+from hydragnn_tpu.obs.spans import StepSpans
+from hydragnn_tpu.obs.compile_monitor import (
+    BACKEND_COMPILE_EVENT,
+    CompileMonitor,
+)
+from hydragnn_tpu.obs.export import (
+    prometheus_name,
+    registry_to_jsonl,
+    registry_to_prometheus,
+    registry_to_prometheus_text,
+    registry_to_tensorboard,
+)
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "get_registry",
+    "reset_registry",
+    "telemetry_enabled",
+    "SCHEMA_VERSION",
+    "FlightRecorder",
+    "read_flight_record",
+    "validate_flight_record",
+    "StepSpans",
+    "BACKEND_COMPILE_EVENT",
+    "CompileMonitor",
+    "prometheus_name",
+    "registry_to_jsonl",
+    "registry_to_prometheus",
+    "registry_to_prometheus_text",
+    "registry_to_tensorboard",
+]

@@ -1,0 +1,114 @@
+"""Exporters for the metrics registry: tensorboard, JSONL, Prometheus.
+
+Three sinks, one source (:class:`~hydragnn_tpu.obs.registry.
+MetricsRegistry`):
+
+  - **tensorboard** rides the existing rank-0 writer plumbing
+    (``utils/tensorboard.py:write_scalar_dict``) — dashboards for a
+    long-lived server or training run;
+  - **JSONL** appends one snapshot line per call — the same parseable
+    shape the flight recorder uses, for ad-hoc scraping;
+  - **Prometheus textfile** writes the node-exporter textfile-collector
+    format (atomic tmp+rename, as that collector requires), with the
+    process rank as a label — the hook a fleet scraper needs without
+    this package growing an HTTP server.
+
+All exporters read a snapshot under the registry's locks and then work
+on plain dicts — an export never blocks a recording hot path for
+longer than the snapshot copy.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import time
+from typing import Optional
+
+from hydragnn_tpu.obs.registry import MetricsRegistry
+
+_PROM_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def registry_to_tensorboard(
+    writer, registry: MetricsRegistry, step: int, prefix: str = "obs"
+) -> int:
+    """Flush a registry snapshot as scalar tags; returns scalars
+    written."""
+    from hydragnn_tpu.utils.tensorboard import write_scalar_dict
+
+    return write_scalar_dict(writer, registry.snapshot(), step, prefix=prefix)
+
+
+def registry_to_jsonl(
+    path: str, registry: MetricsRegistry, extra: Optional[dict] = None
+) -> None:
+    """Append one snapshot line ``{"t": ..., "rank": ..., "metrics":
+    {...}}`` (plus ``extra``'s keys) to ``path``."""
+    line = {
+        "t": round(time.time(), 3),
+        "rank": registry.rank,
+        "metrics": registry.snapshot(),
+    }
+    if extra:
+        line.update(extra)
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "a") as f:
+        f.write(json.dumps(line) + "\n")
+
+
+def prometheus_name(name: str, prefix: str = "hydragnn") -> str:
+    """Dotted metric path -> a legal Prometheus metric name."""
+    return _PROM_BAD.sub("_", f"{prefix}_{name.replace('.', '_')}")
+
+
+def registry_to_prometheus_text(
+    registry: MetricsRegistry, prefix: str = "hydragnn"
+) -> str:
+    """Render the registry in Prometheus exposition format. Counters
+    and gauges become single samples; histograms expose _count/_sum
+    plus quantile-labeled samples (the summary convention)."""
+    from hydragnn_tpu.obs.registry import Counter, Gauge, Histogram
+
+    rank = registry.rank
+    lines = []
+    for name in registry.names():
+        metric = registry.get(name)
+        if metric is None:
+            continue
+        pname = prometheus_name(name, prefix)
+        label = f'{{rank="{rank}"}}'
+        if isinstance(metric, Histogram):
+            snap = metric.snapshot()
+            lines.append(f"# TYPE {pname} summary")
+            for q in ("p50", "p95", "p99"):
+                lines.append(
+                    f'{pname}{{rank="{rank}",quantile="0.{q[1:]}"}} {snap[q]}'
+                )
+            lines.append(f"{pname}_count{label} {snap['count']}")
+            lines.append(f"{pname}_sum{label} {snap['sum']}")
+        elif isinstance(metric, Gauge):
+            lines.append(f"# TYPE {pname} gauge")
+            lines.append(f"{pname}{label} {metric.value}")
+            lines.append(f"# TYPE {pname}_peak gauge")
+            lines.append(f"{pname}_peak{label} {metric.peak}")
+        elif isinstance(metric, Counter):
+            lines.append(f"# TYPE {pname} counter")
+            lines.append(f"{pname}{label} {metric.value}")
+    return "\n".join(lines) + "\n"
+
+
+def registry_to_prometheus(
+    registry: MetricsRegistry, path: str, prefix: str = "hydragnn"
+) -> None:
+    """Write the textfile-collector snapshot atomically (write to a
+    sibling tmp file, rename over — the collector may read at any
+    moment and must never see a partial file)."""
+    text = registry_to_prometheus_text(registry, prefix)
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(text)
+    os.replace(tmp, path)

@@ -1,0 +1,269 @@
+"""Run flight recorder: a crash-safe, append-only JSONL event log.
+
+One file per run tells the whole story in machine-readable form: a
+``run_start`` manifest (resolved config, jax/backend versions, mesh
+shape, pad plans), one ``epoch`` record per epoch (losses, the
+data-wait / dispatch / device step-time decomposition, compile counts),
+``compile`` / ``retry`` / ``error`` events as they happen, and a
+``run_end`` summary. Training writes it alongside checkpoints
+(``<log_dir>/<log_name>/flight.jsonl``); ``bench.py`` / ``bench_serve.py``
+write one next to their JSON records — the self-contained evidence
+artifact a round verdict can parse instead of a builder anecdote (a
+run that died mid-way still has every event up to the crash: each line
+is written and flushed atomically-enough that the tail is at worst one
+truncated line, which the reader skips).
+
+Schema (``SCHEMA_VERSION``): every event is one JSON object per line
+with ``v`` (schema version), ``kind``, ``t`` (unix seconds), ``rank``;
+kind-specific required fields are in ``_REQUIRED``. Validate with
+:func:`validate_flight_record` (ci.sh runs it on a tiny training run;
+``tools/obs_report.py`` pretty-prints and diffs records).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional, Union
+
+SCHEMA_VERSION = 1
+
+# kind -> fields every event of that kind must carry (beyond the
+# envelope v/kind/t/rank). Unknown kinds are allowed (forward compat);
+# unknown extra fields always are.
+_REQUIRED: Dict[str, tuple] = {
+    "run_start": ("manifest",),
+    "epoch": ("epoch", "train_loss", "val_loss"),
+    "compile": ("count",),
+    "retry": ("attempt", "error"),
+    "error": ("error", "error_type"),
+    "profile_trace": ("path",),
+    "run_end": ("status",),
+}
+
+_MANIFEST_REQUIRED = ("jax_version", "backend", "num_processes")
+
+
+def _jsonable(obj: Any, depth: int = 0) -> Any:
+    """Best-effort conversion to JSON-serializable structures: numpy
+    scalars/arrays to python, unknown leaves to repr — a flight record
+    write must never take the run down."""
+    if depth > 8:
+        return repr(obj)
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    if isinstance(obj, dict):
+        return {str(k): _jsonable(v, depth + 1) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonable(v, depth + 1) for v in obj]
+    if hasattr(obj, "item") and getattr(obj, "ndim", None) == 0:
+        return obj.item()  # numpy scalar
+    if hasattr(obj, "tolist"):
+        try:
+            return obj.tolist()
+        except Exception:
+            return repr(obj)
+    return repr(obj)
+
+
+class FlightRecorder:
+    """Append-only JSONL writer for one run.
+
+    Each :meth:`record` opens nothing (the fd stays open), writes one
+    line, and flushes — crash-safe in the sense that every completed
+    event survives the process dying right after it. Disabled
+    recorders (``enabled=False``) are inert: no file is created, every
+    method is a no-op, so call sites never need their own gate.
+    """
+
+    def __init__(self, path: Optional[str], enabled: bool = True):
+        self.path = path
+        self.enabled = bool(enabled and path)
+        self._f = None
+        if self.enabled:
+            d = os.path.dirname(os.path.abspath(path))
+            os.makedirs(d, exist_ok=True)
+            self._f = open(path, "a", buffering=1)
+
+    # -- core --------------------------------------------------------------
+
+    def record(self, kind: str, **payload) -> None:
+        if not self.enabled or self._f is None:
+            return
+        event = {
+            "v": SCHEMA_VERSION,
+            "kind": kind,
+            "t": round(time.time(), 3),
+            "rank": _rank(),
+        }
+        event.update({k: _jsonable(v) for k, v in payload.items()})
+        try:
+            self._f.write(json.dumps(event) + "\n")
+            self._f.flush()
+        except (OSError, ValueError):
+            # a full disk or closed fd must not take the run down;
+            # stop recording rather than raise per-event
+            self.enabled = False
+
+    # -- typed convenience wrappers ---------------------------------------
+
+    def start_run(self, manifest: Dict[str, Any]) -> None:
+        """The run's identity card. Callers pass what they know
+        (resolved config, pad plans, mesh); the environment fields the
+        schema requires are filled in here."""
+        manifest = dict(manifest)
+        manifest.setdefault("jax_version", _jax_version())
+        manifest.setdefault("backend", _backend_name())
+        manifest.setdefault("num_processes", _num_processes())
+        self.record("run_start", manifest=manifest)
+
+    def epoch(self, epoch: int, **payload) -> None:
+        self.record("epoch", epoch=epoch, **payload)
+
+    def compile_event(self, count: int, **payload) -> None:
+        self.record("compile", count=count, **payload)
+
+    def retry(self, attempt: int, error: str, **payload) -> None:
+        self.record("retry", attempt=attempt, error=str(error)[-400:], **payload)
+
+    def error(self, error: BaseException | str, **payload) -> None:
+        self.record(
+            "error",
+            error=str(error)[-400:],
+            error_type=type(error).__name__
+            if isinstance(error, BaseException)
+            else "str",
+            **payload,
+        )
+
+    def end_run(self, status: str, **payload) -> None:
+        self.record("run_end", status=status, **payload)
+
+    def close(self) -> None:
+        if self._f is not None:
+            try:
+                self._f.close()
+            except OSError:
+                pass
+            self._f = None
+            self.enabled = False
+
+    def __enter__(self) -> "FlightRecorder":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def _rank() -> int:
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def _jax_version() -> str:
+    try:
+        import jax
+
+        return jax.__version__
+    except Exception:
+        return "unavailable"
+
+
+def _backend_name() -> str:
+    try:
+        import jax
+
+        return jax.default_backend()
+    except Exception:
+        return "unavailable"
+
+
+def _num_processes() -> int:
+    try:
+        import jax
+
+        return jax.process_count()
+    except Exception:
+        return 1
+
+
+def read_flight_record(path: str) -> List[dict]:
+    """Parse a flight record, tolerating a truncated final line (the
+    crash case the recorder exists for). Raises FileNotFoundError when
+    the file is absent; malformed INTERIOR lines are kept as
+    ``{"kind": "_unparseable", "line": ...}`` so validation can flag
+    them without losing the rest."""
+    events: List[dict] = []
+    with open(path) as f:
+        lines = f.read().split("\n")
+    for i, line in enumerate(lines):
+        if not line.strip():
+            continue
+        try:
+            events.append(json.loads(line))
+        except json.JSONDecodeError:
+            if i == len(lines) - 1 or (i == len(lines) - 2 and not lines[-1]):
+                continue  # truncated tail: expected for a crashed run
+            events.append({"kind": "_unparseable", "line": line[:200]})
+    return events
+
+
+def validate_flight_record(
+    record: Union[str, List[dict]], require_complete: bool = False
+) -> List[str]:
+    """Schema check; returns a list of problems (empty = valid).
+
+    ``require_complete=True`` additionally demands the happy-path
+    shape: exactly one ``run_start`` first, at least one ``epoch``,
+    and a terminal ``run_end`` — what ci.sh asserts of a tiny run.
+    Without it, a crashed run (no run_end) still validates as long as
+    every event it DID write is well-formed.
+    """
+    events = read_flight_record(record) if isinstance(record, str) else record
+    problems: List[str] = []
+    if not events:
+        return ["empty flight record"]
+    for i, ev in enumerate(events):
+        where = f"event[{i}]"
+        if ev.get("kind") == "_unparseable":
+            problems.append(f"{where}: unparseable line {ev.get('line')!r}")
+            continue
+        for field in ("v", "kind", "t", "rank"):
+            if field not in ev:
+                problems.append(f"{where}: missing envelope field {field!r}")
+        if ev.get("v") not in (None, SCHEMA_VERSION):
+            problems.append(
+                f"{where}: schema version {ev['v']} != {SCHEMA_VERSION}"
+            )
+        kind = ev.get("kind")
+        for field in _REQUIRED.get(kind, ()):
+            if field not in ev:
+                problems.append(f"{where} ({kind}): missing field {field!r}")
+        if kind == "run_start":
+            man = ev.get("manifest")
+            if not isinstance(man, dict):
+                problems.append(f"{where}: manifest is not a dict")
+            else:
+                for field in _MANIFEST_REQUIRED:
+                    if field not in man:
+                        problems.append(
+                            f"{where}: manifest missing field {field!r}"
+                        )
+    kinds = [e.get("kind") for e in events]
+    if require_complete:
+        if kinds.count("run_start") != 1:
+            problems.append(
+                f"expected exactly one run_start, got {kinds.count('run_start')}"
+            )
+        elif kinds[0] != "run_start":
+            problems.append(f"first event is {kinds[0]!r}, expected run_start")
+        if "epoch" not in kinds:
+            problems.append("no epoch events")
+        if kinds[-1] != "run_end":
+            problems.append(f"last event is {kinds[-1]!r}, expected run_end")
+    return problems

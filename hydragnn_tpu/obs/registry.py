@@ -1,0 +1,289 @@
+"""Shared metrics registry: counters, gauges, windowed histograms.
+
+One rank-aware in-process store that train, serve, the loader, and the
+benches all record into — the generalization of the serving-only
+counters that ``serve/metrics.py:ServeMetrics`` grew first (that class
+is now a facade over this registry; its ``snapshot()`` keys are
+unchanged). Three metric kinds cover everything the subsystems emit:
+
+  - :class:`Counter` — monotone accumulator (requests, compile events,
+    seconds spent waiting on the prefetch queue);
+  - :class:`Gauge` — last-write-wins level with a tracked peak (queue
+    depth);
+  - :class:`Histogram` — bounded rolling window with nearest-rank
+    p50/p95/p99 (request latency; a serving process lives for days, so
+    warmup samples must age out of the tail stats).
+
+Cost discipline: a DISABLED registry hands out process-wide null
+singletons whose record methods are empty-body no-ops — no lock, no
+allocation, no time syscall — so instrumented hot paths stay honest
+when telemetry is off (tests/test_obs.py pins this). Export goes
+through :mod:`hydragnn_tpu.obs.export` (tensorboard / JSONL /
+Prometheus textfile).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import deque
+from typing import Dict, Optional
+
+
+def _percentile_nearest_rank(sorted_vals, q: float) -> float:
+    """Nearest-rank percentile on an already-sorted sample — exact for
+    the small windows kept here, no interpolation surprises at the
+    tail (same protocol as serve latency stats)."""
+    n = len(sorted_vals)
+    if not n:
+        return 0.0
+    i = min(n - 1, max(0, int(round(q * (n - 1)))))
+    return float(sorted_vals[i])
+
+
+class Counter:
+    """Monotone float/int accumulator."""
+
+    __slots__ = ("name", "_lock", "_value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def inc(self, n: float = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def snapshot(self):
+        v = self.value
+        return int(v) if float(v).is_integer() else v
+
+
+class Gauge:
+    """Last-write-wins level; ``peak`` tracks the max ever set."""
+
+    __slots__ = ("name", "_lock", "_value", "_peak")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self._value = 0.0
+        self._peak = 0.0
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = v
+            if v > self._peak:
+                self._peak = v
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    @property
+    def peak(self) -> float:
+        with self._lock:
+            return self._peak
+
+    def snapshot(self):
+        v = self.value
+        return int(v) if float(v).is_integer() else v
+
+
+class Histogram:
+    """Bounded rolling window of observations with nearest-rank
+    percentiles. ``window`` bounds memory AND makes the percentiles a
+    recent-traffic statistic rather than an all-time one."""
+
+    __slots__ = ("name", "_lock", "_window", "_count", "_sum")
+
+    def __init__(self, name: str, window: int = 2048):
+        self.name = name
+        self._lock = threading.Lock()
+        self._window: deque = deque(maxlen=window)
+        self._count = 0
+        self._sum = 0.0
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            self._window.append(v)
+            self._count += 1
+            self._sum += v
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    def values(self):
+        """The current window (a copy), oldest first."""
+        with self._lock:
+            return list(self._window)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            vals = sorted(self._window)
+            count, total = self._count, self._sum
+        return {
+            "count": count,
+            "sum": total,
+            "mean": (sum(vals) / len(vals)) if vals else 0.0,
+            "p50": _percentile_nearest_rank(vals, 0.50),
+            "p95": _percentile_nearest_rank(vals, 0.95),
+            "p99": _percentile_nearest_rank(vals, 0.99),
+        }
+
+
+class _NullCounter(Counter):
+    __slots__ = ()
+
+    def inc(self, n: float = 1) -> None:
+        pass
+
+
+class _NullGauge(Gauge):
+    __slots__ = ()
+
+    def set(self, v: float) -> None:
+        pass
+
+
+class _NullHistogram(Histogram):
+    __slots__ = ()
+
+    def observe(self, v: float) -> None:
+        pass
+
+
+# process-wide singletons: every disabled-registry lookup returns these,
+# so the disabled path allocates nothing per call site
+NULL_COUNTER = _NullCounter("null")
+NULL_GAUGE = _NullGauge("null")
+NULL_HISTOGRAM = _NullHistogram("null", window=1)
+
+
+class MetricsRegistry:
+    """Named metric store. Metric names are dotted paths
+    (``serve.requests_total``, ``loader.prefetch_wait_s``); ``snapshot``
+    nests them back into a dict tree so the tensorboard exporter
+    (``utils/tensorboard.py:write_scalar_dict``) and the flight
+    recorder consume it directly.
+
+    ``enabled=False`` turns every factory into a null-singleton lookup
+    (see module docstring); ``snapshot`` is then empty.
+    """
+
+    def __init__(self, enabled: bool = True, rank: Optional[int] = None):
+        self.enabled = enabled
+        self._rank = rank
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, object] = {}
+
+    # -- factories ---------------------------------------------------------
+
+    def _get(self, name: str, cls, *args):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, *args)
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as "
+                    f"{type(m).__name__}, requested {cls.__name__}"
+                )
+            return m
+
+    def counter(self, name: str) -> Counter:
+        if not self.enabled:
+            return NULL_COUNTER
+        return self._get(name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        if not self.enabled:
+            return NULL_GAUGE
+        return self._get(name, Gauge)
+
+    def histogram(self, name: str, window: int = 2048) -> Histogram:
+        if not self.enabled:
+            return NULL_HISTOGRAM
+        return self._get(name, Histogram, window)
+
+    # -- introspection -----------------------------------------------------
+
+    @property
+    def rank(self) -> int:
+        """This process's rank; resolved lazily so building a registry
+        never forces jax backend initialization."""
+        if self._rank is None:
+            try:
+                import jax
+
+                self._rank = jax.process_index()
+            except Exception:
+                self._rank = 0
+        return self._rank
+
+    def names(self):
+        with self._lock:
+            return sorted(self._metrics)
+
+    def get(self, name: str):
+        with self._lock:
+            return self._metrics.get(name)
+
+    def snapshot(self) -> dict:
+        """Nested dict of every metric's current value, keyed by the
+        dotted-path segments (counters/gauges -> numbers, histograms ->
+        {count, sum, mean, p50, p95, p99})."""
+        with self._lock:
+            items = list(self._metrics.items())
+        out: dict = {}
+        for name, metric in items:
+            node = out
+            parts = name.split(".")
+            for p in parts[:-1]:
+                node = node.setdefault(p, {})
+            node[parts[-1]] = metric.snapshot()
+        return out
+
+
+_GLOBAL: Optional[MetricsRegistry] = None
+_GLOBAL_LOCK = threading.Lock()
+
+
+def telemetry_enabled() -> bool:
+    """Process-wide telemetry gate: ``HYDRAGNN_TELEMETRY`` accepts
+    0/false/off (any case) to disable; default on."""
+    return os.environ.get("HYDRAGNN_TELEMETRY", "1").lower() not in (
+        "0",
+        "false",
+        "off",
+    )
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-global registry (created on first use, honoring
+    ``HYDRAGNN_TELEMETRY`` at creation time). Subsystems that need
+    isolation (one ``ServeMetrics`` per server) construct their own
+    :class:`MetricsRegistry` instead."""
+    global _GLOBAL
+    with _GLOBAL_LOCK:
+        if _GLOBAL is None:
+            _GLOBAL = MetricsRegistry(enabled=telemetry_enabled())
+        return _GLOBAL
+
+
+def reset_registry() -> None:
+    """Drop the process-global registry (tests; a fresh one re-reads
+    ``HYDRAGNN_TELEMETRY``)."""
+    global _GLOBAL
+    with _GLOBAL_LOCK:
+        _GLOBAL = None

@@ -1,0 +1,163 @@
+"""Host-side span tracing: where does a train step's wall time go?
+
+Decomposes each epoch's steps into the three places wall time hides:
+
+  - **data-wait** — time the consumer blocks on the loader (host
+    batching + H2D that the prefetch thread failed to hide);
+  - **host-dispatch** — time inside the jitted call before it returns
+    (async: tracing/arg handling; on step 0 this includes the compile);
+  - **device-execute** — sampled: for a small window of steps per epoch
+    the step's outputs are ``block_until_ready``-ed and the extra wait
+    beyond dispatch is recorded. Only the window pays the sync; every
+    steady-state step stays fully async, so instrumented training keeps
+    the device-sync discipline the train loop documents.
+
+This makes the "wall is 6.7x device time" class of gap (VERDICT r05
+Weak #4) a measured, per-epoch number: ``epoch_snapshot`` feeds the
+flight recorder (``hydragnn_tpu/obs/flight.py``) and tensorboard.
+Sampled steps are wrapped in a ``jax.profiler`` trace annotation
+("obs.sampled_sync_step") so they are identifiable in XProf timelines
+captured by ``utils/profile.py:Profiler``.
+
+Caveat carried over from bench.py: on tunneled dev chips
+``block_until_ready`` returns at dispatch-ack, not device completion —
+there the device-execute sample is a lower bound (the flight record's
+manifest carries the backend so a reader can judge).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Iterable, Iterator, Optional
+
+
+class StepSpans:
+    """Per-epoch span accumulator for the per-step training path.
+
+    Usage (the train loop's shape):
+
+        spans.epoch_start(epoch)
+        for batch in spans.timed_iter(loader):
+            out = spans.step(train_step, state, batch)
+        record = spans.epoch_snapshot()
+
+    ``sample_steps`` steps per epoch (after ``skip_first``, which skips
+    the compile step) are synchronously fenced to sample device time.
+    Use :meth:`disabled` for the inert variant — ``timed_iter`` returns
+    its argument unchanged and ``step`` is a direct call, so the off
+    path adds no per-step timing syscalls or allocations.
+    """
+
+    def __init__(self, sample_steps: int = 3, skip_first: int = 1):
+        self.sample_steps = sample_steps
+        self.skip_first = skip_first
+        self.enabled = True
+        self.epoch = -1
+        self._reset()
+
+    @staticmethod
+    def disabled() -> "_NullSpans":
+        return _NULL_SPANS
+
+    def _reset(self) -> None:
+        self.steps = 0
+        self.data_wait_s = 0.0
+        self.dispatch_s = 0.0
+        self.first_step_s = 0.0
+        self.sampled = 0
+        self.device_wait_s = 0.0
+        self.sync_step_s = 0.0
+
+    def epoch_start(self, epoch: int) -> None:
+        self.epoch = epoch
+        self._reset()
+
+    # -- recording ---------------------------------------------------------
+
+    def timed_iter(self, iterable: Iterable) -> Iterator:
+        """Yield from ``iterable``, accumulating the time this consumer
+        spends blocked waiting for the next batch."""
+        it = iter(iterable)
+        while True:
+            t0 = time.perf_counter()
+            try:
+                item = next(it)
+            except StopIteration:
+                return
+            self.data_wait_s += time.perf_counter() - t0
+            yield item
+
+    def step(self, fn, *args) -> Any:
+        """Run one train step, recording dispatch time; inside the
+        sampling window, fence the outputs and record device wait."""
+        t0 = time.perf_counter()
+        sampling = (
+            self.skip_first <= self.steps < self.skip_first + self.sample_steps
+        )
+        if sampling:
+            import jax
+
+            from hydragnn_tpu.utils.profile import trace_annotation
+
+            with trace_annotation("obs.sampled_sync_step"):
+                out = fn(*args)
+                t1 = time.perf_counter()
+                jax.block_until_ready(out)
+            t2 = time.perf_counter()
+            self.dispatch_s += t1 - t0
+            self.device_wait_s += t2 - t1
+            self.sync_step_s += t2 - t0
+            self.sampled += 1
+        else:
+            out = fn(*args)
+            dt = time.perf_counter() - t0
+            self.dispatch_s += dt
+            if self.steps == 0:
+                self.first_step_s = dt  # includes trace + compile
+        self.steps += 1
+        return out
+
+    # -- export ------------------------------------------------------------
+
+    def epoch_snapshot(self) -> dict:
+        """One epoch's breakdown, flight-record-ready. Millisecond
+        per-step means; seconds for the epoch totals."""
+        sampled = max(self.sampled, 1) if self.sampled else 0
+        return {
+            "steps": self.steps,
+            "data_wait_s": round(self.data_wait_s, 6),
+            "dispatch_s": round(self.dispatch_s, 6),
+            "first_step_s": round(self.first_step_s, 6),
+            "sampled_steps": self.sampled,
+            "device_wait_ms_mean": (
+                round(self.device_wait_s / sampled * 1e3, 3) if sampled else None
+            ),
+            "sync_step_ms_mean": (
+                round(self.sync_step_s / sampled * 1e3, 3) if sampled else None
+            ),
+        }
+
+
+class _NullSpans(StepSpans):
+    """Telemetry-off spans: structurally a StepSpans (callers need no
+    gate) but every hook is free — ``timed_iter`` IS the identity and
+    ``step`` a direct call, pinned by tests/test_obs.py."""
+
+    def __init__(self):
+        super().__init__(sample_steps=0)
+        self.enabled = False
+
+    def epoch_start(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def timed_iter(self, iterable: Iterable) -> Iterable:
+        return iterable
+
+    def step(self, fn, *args) -> Any:
+        return fn(*args)
+
+    def epoch_snapshot(self) -> Optional[dict]:
+        return None
+
+
+_NULL_SPANS = _NullSpans()

@@ -9,17 +9,24 @@ steady state (compile hits/misses; a miss on the serving path is a
 multi-second latency cliff, which is the whole reason the bucket ladder
 exists).
 
-Everything is a plain thread-safe in-process aggregate exported as a
-dict (:meth:`ServeMetrics.snapshot`); tensorboard export rides the
-existing rank-0 writer plumbing (``utils/tensorboard.py:
-write_scalar_dict``).
+Since the unified-telemetry refactor, :class:`ServeMetrics` is a facade
+over the shared metrics registry (``hydragnn_tpu/obs/registry.py``) —
+the same counter/gauge/histogram store train, loader, and bench record
+into — but its public surface is unchanged: the ``record_*`` methods
+the server calls and the exact ``snapshot()`` key set operators and
+tests already depend on. Tensorboard export rides the existing rank-0
+writer plumbing (``utils/tensorboard.py:write_scalar_dict``);
+Prometheus textfile export comes free from the registry
+(``hydragnn_tpu/obs/export.py``).
 """
 
 from __future__ import annotations
 
-import threading
-from collections import deque
 from typing import Dict, List, Optional
+
+from hydragnn_tpu.obs.registry import MetricsRegistry
+
+_FLUSH_REASONS = ("full", "deadline", "drain")
 
 
 def latency_percentiles(values_s) -> Dict[str, float]:
@@ -40,126 +47,150 @@ def latency_percentiles(values_s) -> Dict[str, float]:
 
 class ServeMetrics:
     """Thread-safe serving counters for one :class:`~hydragnn_tpu.serve.
-    server.ModelServer`.
+    server.ModelServer`, stored in a metrics registry.
 
     ``latency_window`` bounds the per-request latency sample the
     percentiles are computed over (a rolling window, not all-time — a
     serving process lives for days and early warmup latencies must age
     out of the tail stats).
+
+    ``registry`` defaults to a private :class:`MetricsRegistry` so two
+    servers in one process never alias counters; pass a shared registry
+    (e.g. ``hydragnn_tpu.obs.get_registry()``) to co-locate serve
+    metrics with everything else a process records — with a distinct
+    ``prefix`` per server if more than one shares it.
     """
 
-    def __init__(self, num_buckets: int, latency_window: int = 2048):
-        self._lock = threading.Lock()
-        self._latencies: deque = deque(maxlen=latency_window)
-        self.requests_total = 0
-        self.results_total = 0
-        self.rejected_overload = 0
-        self.oversize_largest_bucket = 0
-        self.oversize_eager = 0
-        self.errors = 0
-        self.queue_depth = 0
-        self.queue_depth_peak = 0
+    def __init__(
+        self,
+        num_buckets: int,
+        latency_window: int = 2048,
+        registry: Optional[MetricsRegistry] = None,
+        prefix: str = "serve",
+    ):
+        self.registry = registry if registry is not None else MetricsRegistry()
+        self.prefix = prefix
+        self.num_buckets = num_buckets
+        r = self.registry
+        p = prefix
+        self._requests = r.counter(f"{p}.requests_total")
+        self._results = r.counter(f"{p}.results_total")
+        self._rejected = r.counter(f"{p}.rejected_overload")
+        self._oversize_largest = r.counter(f"{p}.oversize_largest_bucket")
+        self._oversize_eager = r.counter(f"{p}.oversize_eager")
+        self._errors = r.counter(f"{p}.errors")
+        self._queue_depth = r.gauge(f"{p}.queue_depth")
         # compile-cache accounting: warmup compiles are the startup AOT
         # ladder (expected, paid once); a MISS is a post-warmup dispatch
         # that required a fresh XLA compile — the thing steady-state
         # serving must never do.
-        self.compile_warmup = 0
-        self.compile_hits = 0
-        self.compile_misses = 0
-        self._buckets = [
-            {
-                "requests": 0,
-                "batches": 0,
-                "graphs": 0,
-                "occupancy_sum": 0,
-                "flush_full": 0,
-                "flush_deadline": 0,
-                "flush_drain": 0,
-            }
-            for _ in range(num_buckets)
-        ]
+        self._compile_warmup = r.counter(f"{p}.compile_warmup")
+        self._compile_hits = r.counter(f"{p}.compile_hits")
+        self._compile_misses = r.counter(f"{p}.compile_misses")
+        self._latency = r.histogram(f"{p}.latency_s", window=latency_window)
+        self._buckets = []
+        for i in range(num_buckets):
+            bp = f"{p}.bucket_{i}"
+            self._buckets.append(
+                {
+                    "requests": r.counter(f"{bp}.requests"),
+                    "batches": r.counter(f"{bp}.batches"),
+                    "graphs": r.counter(f"{bp}.graphs"),
+                    "occupancy_sum": r.counter(f"{bp}.occupancy_sum"),
+                    "flush": {
+                        reason: r.counter(f"{bp}.flush_{reason}")
+                        for reason in _FLUSH_REASONS
+                    },
+                    "capacity": r.gauge(f"{bp}.capacity"),
+                    "capacity_set": False,
+                }
+            )
 
     # -- recording ---------------------------------------------------------
 
     def record_request(self, bucket: Optional[int]) -> None:
-        with self._lock:
-            self.requests_total += 1
-            if bucket is not None:
-                self._buckets[bucket]["requests"] += 1
+        self._requests.inc()
+        if bucket is not None:
+            self._buckets[bucket]["requests"].inc()
 
     def record_batch(self, bucket: int, occupancy: int, capacity: int, reason: str) -> None:
-        with self._lock:
-            b = self._buckets[bucket]
-            b["batches"] += 1
-            b["graphs"] += occupancy
-            b["occupancy_sum"] += occupancy
-            b[f"flush_{reason}"] = b.get(f"flush_{reason}", 0) + 1
-            b["capacity"] = capacity
+        b = self._buckets[bucket]
+        b["batches"].inc()
+        b["graphs"].inc(occupancy)
+        b["occupancy_sum"].inc(occupancy)
+        flush = b["flush"].get(reason)
+        if flush is None:
+            flush = self.registry.counter(
+                f"{self.prefix}.bucket_{bucket}.flush_{reason}"
+            )
+            b["flush"][reason] = flush
+        flush.inc()
+        b["capacity"].set(capacity)
+        b["capacity_set"] = True
 
     def record_reject(self) -> None:
-        with self._lock:
-            self.rejected_overload += 1
+        self._rejected.inc()
 
     def record_oversize(self, kind: str) -> None:
-        with self._lock:
-            if kind == "largest_bucket":
-                self.oversize_largest_bucket += 1
-            else:
-                self.oversize_eager += 1
+        if kind == "largest_bucket":
+            self._oversize_largest.inc()
+        else:
+            self._oversize_eager.inc()
 
     def record_compile(self, *, hit: bool, warmup: bool = False) -> None:
-        with self._lock:
-            if warmup:
-                self.compile_warmup += 1
-            elif hit:
-                self.compile_hits += 1
-            else:
-                self.compile_misses += 1
+        if warmup:
+            self._compile_warmup.inc()
+        elif hit:
+            self._compile_hits.inc()
+        else:
+            self._compile_misses.inc()
 
     def record_error(self, n: int = 1) -> None:
-        with self._lock:
-            self.errors += n
+        self._errors.inc(n)
 
     def observe_latency(self, seconds: float, n_results: int = 1) -> None:
-        with self._lock:
-            self._latencies.append(seconds)
-            self.results_total += n_results
+        self._latency.observe(seconds)
+        self._results.inc(n_results)
 
     def set_queue_depth(self, depth: int) -> None:
-        with self._lock:
-            self.queue_depth = depth
-            self.queue_depth_peak = max(self.queue_depth_peak, depth)
+        self._queue_depth.set(depth)
 
     # -- export ------------------------------------------------------------
 
     def snapshot(self) -> dict:
-        """One consistent dict of every counter plus derived stats
-        (mean occupancy per bucket, latency percentiles)."""
-        with self._lock:
-            buckets = []
-            for b in self._buckets:
-                d = dict(b)
-                d["occupancy_mean"] = (
-                    b["occupancy_sum"] / b["batches"] if b["batches"] else 0.0
-                )
-                d.pop("occupancy_sum")
-                buckets.append(d)
-            out = {
-                "requests_total": self.requests_total,
-                "results_total": self.results_total,
-                "rejected_overload": self.rejected_overload,
-                "oversize_largest_bucket": self.oversize_largest_bucket,
-                "oversize_eager": self.oversize_eager,
-                "errors": self.errors,
-                "queue_depth": self.queue_depth,
-                "queue_depth_peak": self.queue_depth_peak,
-                "compile_warmup": self.compile_warmup,
-                "compile_hits": self.compile_hits,
-                "compile_misses": self.compile_misses,
-                "latency": latency_percentiles(self._latencies),
-                "buckets": {f"bucket_{i}": b for i, b in enumerate(buckets)},
+        """One dict of every counter plus derived stats (mean occupancy
+        per bucket, latency percentiles). Key set is the pre-registry
+        contract — bench_serve.py and test_serve.py parse it."""
+        buckets = {}
+        for i, b in enumerate(self._buckets):
+            batches = b["batches"].snapshot()
+            occupancy_sum = b["occupancy_sum"].snapshot()
+            d = {
+                "requests": b["requests"].snapshot(),
+                "batches": batches,
+                "graphs": b["graphs"].snapshot(),
             }
-        return out
+            for reason, c in b["flush"].items():
+                d[f"flush_{reason}"] = c.snapshot()
+            if b["capacity_set"]:
+                d["capacity"] = b["capacity"].snapshot()
+            d["occupancy_mean"] = occupancy_sum / batches if batches else 0.0
+            buckets[f"bucket_{i}"] = d
+        return {
+            "requests_total": self._requests.snapshot(),
+            "results_total": self._results.snapshot(),
+            "rejected_overload": self._rejected.snapshot(),
+            "oversize_largest_bucket": self._oversize_largest.snapshot(),
+            "oversize_eager": self._oversize_eager.snapshot(),
+            "errors": self._errors.snapshot(),
+            "queue_depth": self._queue_depth.snapshot(),
+            "queue_depth_peak": int(self._queue_depth.peak),
+            "compile_warmup": self._compile_warmup.snapshot(),
+            "compile_hits": self._compile_hits.snapshot(),
+            "compile_misses": self._compile_misses.snapshot(),
+            "latency": latency_percentiles(self._latency.values()),
+            "buckets": buckets,
+        }
 
     def to_tensorboard(self, writer, step: int, prefix: str = "serve") -> int:
         """Flush a snapshot to a (rank-0) SummaryWriter from
@@ -168,3 +199,10 @@ class ServeMetrics:
         from hydragnn_tpu.utils.tensorboard import write_scalar_dict
 
         return write_scalar_dict(writer, self.snapshot(), step, prefix=prefix)
+
+    def to_prometheus_text(self) -> str:
+        """Prometheus exposition snapshot of this server's registry
+        (``hydragnn_tpu/obs/export.py:registry_to_prometheus_text``)."""
+        from hydragnn_tpu.obs.export import registry_to_prometheus_text
+
+        return registry_to_prometheus_text(self.registry)

@@ -110,6 +110,7 @@ class ModelServer:
         reference_samples: Sequence,
         config: Optional[ServeConfig] = None,
         metrics: Optional[ServeMetrics] = None,
+        flight=None,
     ):
         if not reference_samples:
             raise ValueError("reference_samples must be non-empty (sizes the buckets)")
@@ -153,6 +154,16 @@ class ModelServer:
         self._eager_lock = threading.Lock()
         self._worker: Optional[threading.Thread] = None
         self._started = False
+        # optional run flight recorder (hydragnn_tpu/obs/flight.py):
+        # start() logs a serving manifest (bucket ladder, request spec),
+        # stop() the final metrics snapshot — bench_serve.py passes one
+        # so a serving bench leaves the same evidence artifact training
+        # runs do. None -> an inert recorder; no call site needs a gate.
+        if flight is None:
+            from hydragnn_tpu.obs import FlightRecorder
+
+            flight = FlightRecorder(None, enabled=False)
+        self.flight = flight
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -161,7 +172,26 @@ class ModelServer:
         thread. Returns self (``serve_model(...).start()`` chains)."""
         if self._started:
             return self
+        t0 = time.monotonic()
         self._cache.warmup(self.buckets)
+        self.flight.start_run(
+            {
+                "mode": "serve",
+                "serve_config": dataclasses.asdict(self.config),
+                "request_spec": dict(self._spec),
+                "buckets": [
+                    {
+                        "cap_nodes": b.cap_nodes,
+                        "cap_edges": b.cap_edges,
+                        "node_pad": b.node_pad,
+                        "edge_pad": b.edge_pad,
+                        "graph_pad": b.graph_pad,
+                    }
+                    for b in self.buckets
+                ],
+                "warmup_compile_s": round(time.monotonic() - t0, 3),
+            }
+        )
         self._worker = threading.Thread(
             target=self._run, name="hydragnn-serve-executor", daemon=True
         )
@@ -171,11 +201,14 @@ class ModelServer:
 
     def stop(self, timeout: Optional[float] = 10.0) -> None:
         """Stop admitting, drain what is queued, join the executor."""
+        was_started = self._started
         self._queue.close()
         if self._worker is not None:
             self._worker.join(timeout)
             self._worker = None
         self._started = False
+        if was_started:
+            self.flight.end_run(status="stopped", metrics=self.metrics_snapshot())
 
     def __enter__(self) -> "ModelServer":
         return self.start()
@@ -218,6 +251,14 @@ class ModelServer:
 
     def metrics_snapshot(self) -> dict:
         return self.metrics.snapshot()
+
+    def export_prometheus(self, path: str) -> None:
+        """Write this server's metrics as a Prometheus textfile snapshot
+        (atomic rename; point a node-exporter textfile collector at it
+        and scrape — no HTTP server in-process)."""
+        from hydragnn_tpu.obs.export import registry_to_prometheus
+
+        registry_to_prometheus(self.metrics.registry, path)
 
     # -- oversize fallbacks ------------------------------------------------
 

@@ -146,12 +146,26 @@ class _MetricAccum:
 
 
 def train_epoch(
-    loader, state: TrainState, train_step, verbosity: int = 0, profiler=None
+    loader,
+    state: TrainState,
+    train_step,
+    verbosity: int = 0,
+    profiler=None,
+    spans=None,
 ) -> Tuple[TrainState, float, np.ndarray]:
-    """One training epoch; returns (state, avg_loss, avg_tasks_loss[H])."""
+    """One training epoch; returns (state, avg_loss, avg_tasks_loss[H]).
+
+    ``spans`` (hydragnn_tpu/obs/spans.py:StepSpans) decomposes the
+    epoch's wall time into data-wait / host-dispatch / sampled device
+    time; the default disabled spans keep the loop's plain async shape
+    (identity iterator, direct step call)."""
+    if spans is None:
+        from hydragnn_tpu.obs import StepSpans
+
+        spans = StepSpans.disabled()
     acc = _MetricAccum()
-    for batch in iterate_tqdm(loader, verbosity, desc="train"):
-        state, loss, task_losses = train_step(state, batch)
+    for batch in spans.timed_iter(iterate_tqdm(loader, verbosity, desc="train")):
+        state, loss, task_losses = spans.step(train_step, state, batch)
         acc.add(loss, task_losses, batch.graph_mask.sum())
         if profiler is not None:
             profiler.step()
@@ -306,13 +320,24 @@ def train_validate_test(
     eval_step=None,
     eval_step_out=None,
     stats_step=None,
+    flight=None,
+    run_config=None,
 ) -> Tuple[TrainState, Dict[str, Any]]:
     """Train for ``Training.num_epoch`` epochs with validation-driven LR
     plateau + early stopping; returns (final_state, history dict). ``config``
     is the ``NeuralNetwork`` section (reference signature parity,
     train_validate_test.py:37-58). Callers running data-parallel pass the
     sharded step functions (hydragnn_tpu/parallel); defaults are the
-    single-device jitted steps."""
+    single-device jitted steps.
+
+    Telemetry (hydragnn_tpu/obs, gated by ``HYDRAGNN_TELEMETRY``): the
+    run writes a flight record — ``<log_dir>/<log_name>/flight.jsonl``,
+    rank 0 — with a start manifest (resolved config, backend, mesh,
+    pad plans), per-epoch records carrying the losses plus the
+    data-wait / dispatch / device step-time decomposition and compile
+    counts, and a final summary. Callers may pass their own ``flight``
+    recorder (bench harnesses) and ``run_config`` (the full resolved
+    config for the manifest; defaults to the NeuralNetwork section)."""
     training = config["Training"]
     num_epoch = int(training["num_epoch"])
     early_stop = bool(training.get("EarlyStopping", False))
@@ -437,6 +462,47 @@ def train_validate_test(
                 stopper.count = int(meta["stopper"]["count"])
                 stopper.min_loss = float(meta["stopper"]["min_loss"])
             history = meta["history"]
+
+    # Unified telemetry (hydragnn_tpu/obs): flight record + step spans +
+    # compile monitor, all inert when HYDRAGNN_TELEMETRY=0. Created
+    # AFTER resume handling so a config error there cannot leak a
+    # registered monitor or an empty flight file. The flight record is
+    # rank-0 (like checkpoints/tensorboard); spans and the compile
+    # monitor run everywhere but only rank 0 persists them.
+    from hydragnn_tpu.obs import (
+        CompileMonitor,
+        FlightRecorder,
+        StepSpans,
+        telemetry_enabled,
+    )
+
+    telemetry_on = telemetry_enabled()
+    own_flight = flight is None
+    if flight is None:
+        flight_path = (
+            os.path.join(log_dir, log_name, "flight.jsonl")
+            if telemetry_on and jax.process_index() == 0
+            else None
+        )
+        flight = FlightRecorder(flight_path, enabled=telemetry_on)
+    spans = StepSpans() if telemetry_on else StepSpans.disabled()
+    cmon = CompileMonitor().start() if telemetry_on else None
+    if profiler is not None and getattr(profiler, "on_trace", None) is None:
+        profiler.on_trace = lambda path, ep: flight.record(
+            "profile_trace", path=path, epoch=ep
+        )
+
+    def _abort_telemetry(exc: BaseException, epochs: int) -> None:
+        """Record the failure into the flight record before unwinding —
+        a crashed run must still leave a parseable artifact (the r05
+        'traceback was the only evidence' failure mode)."""
+        flight.error(exc)
+        flight.end_run(status="failed", epochs=epochs)
+        if cmon is not None:
+            cmon.stop()
+        if own_flight:
+            flight.close()
+
     metrics_path = None
     if jax.process_index() == 0:
         out_dir = os.path.join(log_dir, log_name)
@@ -446,6 +512,44 @@ def train_validate_test(
     from hydragnn_tpu.utils.tensorboard import get_summary_writer
 
     writer = get_summary_writer(log_name, log_dir)
+
+    # Flight-record manifest: everything needed to interpret (and rerun)
+    # this run without the builder's shell history. Recorded AFTER resume
+    # handling so start_epoch reflects what will actually execute.
+    def _loader_plan(ld) -> Dict[str, Any]:
+        return {
+            "num_batches": len(ld),
+            "num_samples": getattr(ld, "num_samples", None),
+            "batch_size": getattr(ld, "batch_size", None),
+            "pad_nodes": getattr(ld, "pad_nodes", None),
+            "pad_edges": getattr(ld, "pad_edges", None),
+            "pad_graphs": getattr(ld, "pad_graphs", None),
+        }
+
+    _dev0 = jax.devices()[0]
+    flight.start_run(
+        {
+            "run": log_name,
+            "log_dir": log_dir,
+            "config": run_config if run_config is not None else {"NeuralNetwork": config},
+            "device_kind": getattr(_dev0, "device_kind", str(_dev0)),
+            "local_device_count": jax.local_device_count(),
+            "mesh": {
+                "device_stack": getattr(train_loader, "device_stack", 1),
+                "process_count": jax.process_count(),
+            },
+            "pad_plans": {
+                "train": _loader_plan(train_loader),
+                "val": _loader_plan(val_loader),
+                "test": _loader_plan(test_loader),
+            },
+            "num_epoch": num_epoch,
+            "start_epoch": start_epoch,
+            "mixed_precision": compute_dtype is not None,
+            "scan_epoch": scan_fn is not None,
+            "compile_monitor_available": bool(cmon and cmon.available),
+        }
+    )
 
     # Visualization (reference: Visualizer wiring, train_validate_test.py:
     # 71-97,90-96: initial-solution scatter, per-epoch histograms, final
@@ -472,10 +576,14 @@ def train_validate_test(
         # num_nodes_plot wiring, train_validate_test.py:71-97)
         visualizer.num_nodes_plot(viz_nodes_per_graph)
     if visualizer is not None and plot_init_solution:
-        _, _, tv, pv = test_epoch(
-            test_loader, state, eval_step_out, cfg, verbosity, return_samples=True
-        )
-        visualizer.create_scatter_plots(tv, pv, iepoch=-1)
+        try:
+            _, _, tv, pv = test_epoch(
+                test_loader, state, eval_step_out, cfg, verbosity, return_samples=True
+            )
+            visualizer.create_scatter_plots(tv, pv, iepoch=-1)
+        except BaseException as exc:
+            _abort_telemetry(exc, 0)
+            raise
 
     def _write_checkpoint(ckpt_state, epoch_next: int, early_stopped: bool) -> None:
         from hydragnn_tpu.utils.checkpoint import save_model, save_train_meta
@@ -505,12 +613,16 @@ def train_validate_test(
     timer = Timer("train_validate_test")
     timer.start()
     epochs_done = start_epoch
-    for epoch in range(start_epoch, num_epoch):
+    try:
+      for epoch in range(start_epoch, num_epoch):
         for loader in (train_loader, val_loader, test_loader):
             if hasattr(loader, "set_epoch"):
                 loader.set_epoch(epoch)
         if profiler is not None:
             profiler.set_current_epoch(epoch)
+        if cmon is not None:
+            cmon.mark("epoch_start")
+        spans.epoch_start(epoch)
 
         # the profiler context closes an in-flight trace at epoch end even
         # when the epoch has fewer steps than its schedule expects
@@ -521,7 +633,12 @@ def train_validate_test(
                 )
             else:
                 state, train_loss, train_tasks = train_epoch(
-                    train_loader, state, train_step, verbosity, profiler=profiler
+                    train_loader,
+                    state,
+                    train_step,
+                    verbosity,
+                    profiler=profiler,
+                    spans=spans,
                 )
         if scan_eval_fn is not None:
             val_loss, val_tasks = evaluate_epoch_scan(val_loader, state, scan_eval_fn)
@@ -587,6 +704,43 @@ def train_validate_test(
                     + "\n"
                 )
 
+        # per-epoch flight record: losses + the step-time decomposition
+        # + compile counts. After the first executed epoch every train
+        # step function is compiled; further compiles are the silent
+        # recompile class this exists to surface.
+        span_snap = None if scan_fn is not None else spans.epoch_snapshot()
+        step_time = (
+            dict(span_snap, mode="per_step")
+            if span_snap is not None
+            # scan mode is ONE device dispatch per epoch — there are no
+            # host-side per-step spans to decompose
+            else {"mode": "scan_epoch" if scan_fn is not None else "disabled"}
+        )
+        compiles: Dict[str, Any] = {"available": bool(cmon and cmon.available)}
+        if cmon is not None:
+            n_compiles = cmon.count_since("epoch_start")
+            compiles["count"] = n_compiles
+            compiles["unexpected"] = bool(
+                cmon.available and epoch > start_epoch and n_compiles > 0
+            )
+        flight.epoch(
+            epoch,
+            train_loss=train_loss,
+            val_loss=val_loss,
+            test_loss=test_loss,
+            lr=lr,
+            train_tasks=train_tasks.tolist(),
+            val_tasks=val_tasks.tolist(),
+            step_time=step_time,
+            compiles=compiles,
+        )
+        if span_snap is not None:
+            from hydragnn_tpu.utils.tensorboard import write_scalar_dict
+
+            write_scalar_dict(writer, span_snap, epoch, prefix="obs/step_time")
+            if compiles.get("count") is not None:
+                writer.add_scalar("obs/compiles", compiles["count"], epoch)
+
         stop = stopper is not None and stopper(val_loss)
         epochs_done = epoch + 1
 
@@ -596,6 +750,14 @@ def train_validate_test(
         if stop:
             print_distributed(verbosity, f"Early stopping at epoch {epoch}")
             break
+    except BaseException as exc:
+        # the registry timer is process-global: close its interval or
+        # every later train_validate_test in this process raises
+        # "Timer already running" (same discipline as run_training's
+        # try/finally around its total_training timer)
+        timer.stop_if_running()
+        _abort_telemetry(exc, epochs_done - start_epoch)
+        raise
     timer.stop()
 
     # A resume that trained zero epochs (e.g. continuing an early-stopped
@@ -605,44 +767,70 @@ def train_validate_test(
     ran_epochs = epochs_done > start_epoch
     resumed_noop = training.get("continue") == 1 and not ran_epochs
 
-    # BatchNorm recalibration: the in-training running-stat EMA trails
-    # the last few (noisy, small) batches; with frozen final parameters,
-    # two passes over the train set re-estimate faithful eval statistics.
-    if (
-        stats_step is not None
-        and training.get("bn_recalibration", True)
-        and not resumed_noop
-    ):
-        for _ in range(2):
-            for b in train_loader:
-                state = stats_step(state, b)
+    try:
+        # BatchNorm recalibration: the in-training running-stat EMA trails
+        # the last few (noisy, small) batches; with frozen final parameters,
+        # two passes over the train set re-estimate faithful eval statistics.
+        if (
+            stats_step is not None
+            and training.get("bn_recalibration", True)
+            and not resumed_noop
+        ):
+            for _ in range(2):
+                for b in train_loader:
+                    state = stats_step(state, b)
 
-    # Final checkpoint+meta pair AFTER BN recalibration: the model file
-    # and the loop-state sidecar must describe the same state (a mid-run
-    # meta against the final recalibrated weights would make a later
-    # continue run replay epochs on the wrong state); an early-stopped
-    # run is marked so resume honors the stop instead of training on.
-    if ckpt_every and not resumed_noop:
-        _write_checkpoint(
-            state, epochs_done, early_stopped=bool(stopper and stopper.count >= stopper.patience)
-        )
+        # Final checkpoint+meta pair AFTER BN recalibration: the model file
+        # and the loop-state sidecar must describe the same state (a mid-run
+        # meta against the final recalibrated weights would make a later
+        # continue run replay epochs on the wrong state); an early-stopped
+        # run is marked so resume honors the stop instead of training on.
+        if ckpt_every and not resumed_noop:
+            _write_checkpoint(
+                state, epochs_done, early_stopped=bool(stopper and stopper.count >= stopper.patience)
+            )
 
-    writer.flush()
-    writer.close()
+        writer.flush()
+        writer.close()
 
-    # Final plots (reference: train_validate_test.py:173-215 rank-0 plots).
-    if visualizer is not None:
-        _, _, tv, pv = test_epoch(
-            test_loader, state, eval_step_out, cfg, verbosity, return_samples=True
-        )
-        visualizer.create_scatter_plots(tv, pv)
-        visualizer.create_plot_global(tv, pv)
-        # vector parity grids, per-node diagnostics (fixed-size graphs),
-        # and the scalar/vector global-analysis figures (reference:
-        # visualizer.py:134-280, 387-613)
-        visualizer.create_reference_plot_suite(
-            tv, pv, cfg.output_type, viz_nodes_per_graph
-        )
-        visualizer.plot_history(history)
+        # Final plots (reference: train_validate_test.py:173-215 rank-0 plots).
+        if visualizer is not None:
+            _, _, tv, pv = test_epoch(
+                test_loader, state, eval_step_out, cfg, verbosity, return_samples=True
+            )
+            visualizer.create_scatter_plots(tv, pv)
+            visualizer.create_plot_global(tv, pv)
+            # vector parity grids, per-node diagnostics (fixed-size graphs),
+            # and the scalar/vector global-analysis figures (reference:
+            # visualizer.py:134-280, 387-613)
+            visualizer.create_reference_plot_suite(
+                tv, pv, cfg.output_type, viz_nodes_per_graph
+            )
+            visualizer.plot_history(history)
+    except BaseException as exc:
+        _abort_telemetry(exc, epochs_done - start_epoch)
+        raise
+
+    # run_end summary: the flight record's terminal event — per-process
+    # timers, whatever landed in the global metrics registry (loader
+    # prefetch accounting, ...), and the whole-run compile count.
+    if cmon is not None:
+        cmon.stop()
+    from hydragnn_tpu.obs import get_registry
+    from hydragnn_tpu.utils.time_utils import timers_snapshot
+
+    flight.end_run(
+        status="completed",
+        epochs=epochs_done - start_epoch,
+        epochs_total=epochs_done,
+        early_stopped=bool(stopper and stopper.count >= stopper.patience),
+        best_val_loss=min(history["val_loss"]) if history["val_loss"] else None,
+        final_lr=history["lr"][-1] if history["lr"] else None,
+        compiles=cmon.snapshot() if cmon is not None else None,
+        timers=timers_snapshot(),
+        metrics=get_registry().snapshot(),
+    )
+    if own_flight:
+        flight.close()
 
     return state, history

@@ -95,6 +95,108 @@ def require_virtual_cpu_mesh(n_devices: int) -> None:
         )
 
 
+# Substrings that mark a backend-init failure as TRANSIENT (the device
+# is momentarily unreachable/held and a later attempt can succeed):
+# gRPC status names the tunneled-TPU plugin surfaces, connection-layer
+# noise, and the device-held-by-a-dying-process window that
+# tools/chip_hygiene.py exists to diagnose. Anything else (unknown
+# platform name, missing plugin, bad flags) is a genuine config error —
+# retrying it just burns two minutes to fail identically.
+_TRANSIENT_PATTERNS = (
+    "unavailable",
+    "deadline_exceeded",
+    "deadline exceeded",
+    "resource_exhausted",
+    "resource exhausted",
+    "failed to connect",
+    "connection reset",
+    "connection refused",
+    "socket closed",
+    "temporarily",
+    "timed out",
+    "device or resource busy",
+    "already in use",
+    "libtpu",
+    "unreachable",
+)
+
+
+def is_transient_backend_error(exc: BaseException) -> bool:
+    msg = str(exc).lower()
+    return any(p in msg for p in _TRANSIENT_PATTERNS)
+
+
+def _clear_failed_backends() -> None:
+    """Best-effort reset of jax's cached backend state so the next
+    ``jax.devices()`` re-attempts initialization instead of replaying
+    the cached failure. API location moved across jax versions; all
+    paths are optional."""
+    try:
+        from jax.extend import backend as _jex_backend
+
+        _jex_backend.clear_backends()
+        return
+    except Exception:
+        pass
+    try:
+        from jax._src import xla_bridge as _bridge
+
+        _bridge._clear_backends()
+    except Exception:
+        pass
+
+
+def init_backend_with_retry(
+    attempts: int = 5,
+    delays: tuple = (5.0, 10.0, 30.0, 60.0),
+    sleep=None,
+    on_retry=None,
+):
+    """Pin the platform and bring the jax backend up, retrying TRANSIENT
+    failures with backoff (default: 5 attempts over ~2 minutes — long
+    enough for a lingering chip-holder from the previous run to die,
+    short enough that a driver's capture window still sees the result).
+
+    Returns ``(devices, retries_used)``. Genuine config errors raise on
+    the FIRST attempt; after the last attempt the error propagates
+    either way. Whatever raises is normalized to :class:`BackendInitError`
+    whose ``.record`` carries ``retries`` — the structured failure line
+    bench.py prints gains the count (VERDICT next-round #1).
+
+    ``on_retry(attempt, exc, delay)`` observes each retry (benches log a
+    flight-record event + stderr line).
+    """
+    import time
+
+    if sleep is None:
+        sleep = time.sleep
+    last: BaseException = RuntimeError("init_backend_with_retry: attempts < 1")
+    for attempt in range(max(attempts, 1)):
+        try:
+            if attempt > 0:
+                _clear_failed_backends()
+            pin_platform_from_env()
+            import jax
+
+            return jax.devices(), attempt
+        except (BackendInitError, RuntimeError, AssertionError) as exc:
+            last = exc
+            transient = is_transient_backend_error(exc)
+            final = attempt >= max(attempts, 1) - 1
+            if not transient or final:
+                break
+            delay = delays[min(attempt, len(delays) - 1)] if delays else 0.0
+            if on_retry is not None:
+                on_retry(attempt + 1, exc, delay)
+            sleep(delay)
+    if isinstance(last, BackendInitError):
+        last.record["retries"] = attempt
+        raise last
+    err = BackendInitError(os.environ.get("JAX_PLATFORMS", ""), last)
+    err.record["retries"] = attempt
+    raise err from last
+
+
 def pin_platform_from_env() -> None:
     """If ``JAX_PLATFORMS`` is set, pin it via ``jax.config`` and verify
     the backend actually honors it. Callers should invoke this before any

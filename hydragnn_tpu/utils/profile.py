@@ -41,6 +41,11 @@ class Profiler:
         self.done = False
         self._step_in_epoch = 0
         self._tracing = False
+        # observer hook: called as on_trace(prefix, epoch) when a trace
+        # window closes — the train loop points it at the run flight
+        # recorder so the trace artifact is discoverable from the run's
+        # event log (hydragnn_tpu/obs/flight.py "profile_trace" events)
+        self.on_trace = None
 
     def setup(self, config: dict) -> None:
         """Configure from the ``Profile`` config section (reference keys:
@@ -81,6 +86,8 @@ class Profiler:
             self._tracing = False
             self.done = True
             print(f"Profiler trace written to {self.prefix} (epoch {self.target_epoch})")
+            if self.on_trace is not None:
+                self.on_trace(self.prefix, self.target_epoch)
 
     def __enter__(self) -> "Profiler":
         return self

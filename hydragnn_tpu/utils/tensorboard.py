@@ -33,6 +33,8 @@ def write_scalar_dict(writer, scalars: dict, step: int, prefix: str = "") -> int
     (hydragnn_tpu/serve/metrics.py:ServeMetrics.to_tensorboard) exports
     through this, so serve dashboards ride the same rank-0 writer
     plumbing as training losses."""
+    import numbers
+
     written = 0
     for key, value in scalars.items():
         tag = f"{prefix}/{key}" if prefix else str(key)
@@ -40,8 +42,10 @@ def write_scalar_dict(writer, scalars: dict, step: int, prefix: str = "") -> int
             written += write_scalar_dict(writer, value, step, prefix=tag)
         elif isinstance(value, bool):
             continue
-        elif isinstance(value, (int, float)):
-            writer.add_scalar(tag, value, step)
+        # numbers.Real also admits numpy scalar floats/ints — the
+        # metrics-registry snapshots (hydragnn_tpu/obs) carry those
+        elif isinstance(value, numbers.Real):
+            writer.add_scalar(tag, float(value), step)
             written += 1
     return written
 

@@ -40,6 +40,13 @@ class Timer:
         self.count += 1
         self._start = None
 
+    def stop_if_running(self) -> None:
+        """Exception-path stop: registry timers are process-global, so a
+        run that unwinds mid-interval must close it or every later run
+        in the process dies with 'Timer already running'."""
+        if self._start is not None:
+            self.stop()
+
     def __enter__(self):
         self.start()
         return self
@@ -51,6 +58,18 @@ class Timer:
 
 def reset_timers() -> None:
     _REGISTRY.clear()
+
+
+def timers_snapshot() -> Dict[str, Dict[str, float]]:
+    """This process's timers as plain numbers (no printing, no
+    cross-process reduction) — the shape the run flight recorder
+    (hydragnn_tpu/obs/flight.py) embeds in its run_end summary. A
+    still-running timer reports the elapsed time of its completed
+    start/stop pairs."""
+    return {
+        name: {"elapsed_s": round(t.elapsed, 6), "count": t.count}
+        for name, t in sorted(_REGISTRY.items())
+    }
 
 
 def print_timers(verbosity: int = 1) -> Dict[str, Dict[str, float]]:

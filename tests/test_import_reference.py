@@ -253,16 +253,92 @@ def test_malicious_globals_are_stubbed(tmp_path):
     assert isinstance(obj2, _Stub)
 
 
-def test_head_type_inference(tmp_path):
-    """Without explicit head types the node head is recognized by row
-    count divisibility."""
+def test_head_type_inference_ambiguity_raises(tmp_path):
+    """A head whose length divides num_nodes is AMBIGUOUS (a graph head
+    of that size would be silently misclassified and its targets
+    reshaped = corrupted), so inference refuses with a ValueError naming
+    the head_types/--head-type escape hatch; heads shorter than
+    num_nodes stay unambiguously graph-level and still infer."""
     basedir = str(tmp_path / "pkl")
     _write_fixture(basedir, "t", 2)
     for m in list(sys.modules):
         if m.startswith("torch_geometric"):
             del sys.modules[m]
     reader = ReferencePickleReader(basedir, "t")
-    s = reader.read(0)
-    assert len(s.graph_targets) + len(s.node_targets) == 2
-    node_heads = [v for v in s.node_targets.values()]
-    assert node_heads and node_heads[0].shape[0] == s.num_nodes
+    # head 1 (the node head, length == num_nodes) trips the ambiguity
+    with pytest.raises(ValueError, match="head_types"):
+        reader.read(0)
+    # explicit types resolve it
+    s = reader.read(0, head_types=["graph", "node"])
+    assert len(s.graph_targets) == 1 and len(s.node_targets) == 1
+    node_heads = list(s.node_targets.values())
+    assert node_heads[0].shape[0] == s.num_nodes
+
+
+def _write_coincident_fixture(basedir, label, n_samples, n_nodes=4):
+    """Every sample has exactly ``n_nodes`` nodes and TWO heads of the
+    SAME packed length ``n_nodes``: head 0 a graph-level vector of dim
+    n_nodes, head 1 a per-node scalar — indistinguishable by size, the
+    exact case the inference guard exists for."""
+    Data = _install_fake_pyg() or sys.modules["torch_geometric.data.data"].Data
+    rng = np.random.default_rng(11)
+    os.makedirs(basedir, exist_ok=True)
+    truth = []
+    for k in range(n_samples):
+        x = rng.standard_normal((n_nodes, 3)).astype(np.float32)
+        send = np.arange(n_nodes, dtype=np.int64)
+        ei = np.stack([send, (send + 1) % n_nodes])
+        g_y = rng.standard_normal(n_nodes).astype(np.float32)
+        n_y = rng.standard_normal((n_nodes, 1)).astype(np.float32)
+        y = np.concatenate([g_y, n_y.reshape(-1)])[:, None]
+        y_loc = np.array([[0, n_nodes, 2 * n_nodes]], dtype=np.int64)
+        d = Data(
+            x=torch.from_numpy(x),
+            edge_index=torch.from_numpy(ei),
+            y=torch.from_numpy(y),
+            y_loc=torch.from_numpy(y_loc),
+        )
+        with open(os.path.join(basedir, f"{label}-{k}.pkl"), "wb") as f:
+            pickle.dump(d, f)
+        truth.append((x, g_y, n_y))
+    with open(os.path.join(basedir, f"{label}-meta.pkl"), "wb") as f:
+        for obj in (None, None, n_samples, False, 2):
+            pickle.dump(obj, f)
+    return truth
+
+
+def test_multihead_coincident_sizes_need_explicit_types(tmp_path):
+    """Mixed graph+node heads of COINCIDENT packed size: refuse without
+    explicit types; with head_types each head lands in the right target
+    dict with the right shape, through the full container round-trip."""
+    basedir = str(tmp_path / "pkl")
+    out = str(tmp_path / "coincident.hgc")
+    truth = _write_coincident_fixture(basedir, "total", 3, n_nodes=4)
+    for m in list(sys.modules):
+        if m.startswith("torch_geometric"):
+            del sys.modules[m]
+
+    reader = ReferencePickleReader(basedir, "total")
+    with pytest.raises(ValueError, match="--head-type"):
+        reader.samples()
+
+    n = import_pickle_dataset(
+        basedir,
+        "total",
+        out,
+        head_types=["graph", "node"],
+        head_names=["spectrum", "charge"],
+    )
+    assert n == 3
+    ds = ContainerDataset(out)
+    for i, (x, g_y, n_y) in enumerate(truth):
+        s = ds.get(i)
+        np.testing.assert_allclose(s.x, x, rtol=1e-6)
+        # the graph head keeps its 4-dim vector form (NOT reshaped to
+        # per-node); the node head is [num_nodes, 1]
+        np.testing.assert_allclose(
+            np.ravel(s.graph_targets["spectrum"]), g_y, rtol=1e-6
+        )
+        assert s.node_targets["charge"].shape == (4, 1)
+        np.testing.assert_allclose(s.node_targets["charge"], n_y, rtol=1e-6)
+    ds.close()

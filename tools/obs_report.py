@@ -1,0 +1,244 @@
+"""Flight-record reporter: pretty-print, validate, and diff runs.
+
+The flight record (hydragnn_tpu/obs/flight.py) is the machine-readable
+artifact; this is the human view over it:
+
+    python tools/obs_report.py run/flight.jsonl             # summary
+    python tools/obs_report.py --validate run/flight.jsonl  # schema gate
+    python tools/obs_report.py --diff a/flight.jsonl b/flight.jsonl
+
+``--validate`` exits 1 on schema problems (``--require-complete`` also
+demands run_start/epoch/run_end — what ci.sh asserts of its smoke run);
+``--diff`` is the round-over-round tool: manifest drift (config,
+backend, pad plans) and per-epoch loss/step-time deltas between two
+runs — e.g. two rounds' BENCH flight records.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Dict, List, Optional
+
+_REPO = __file__.rsplit("/", 2)[0]
+if _REPO not in sys.path:  # runnable as `python tools/obs_report.py`
+    sys.path.insert(0, _REPO)
+
+from hydragnn_tpu.obs.flight import (  # noqa: E402
+    read_flight_record,
+    validate_flight_record,
+)
+
+
+def _fmt(v, nd: int = 6) -> str:
+    if isinstance(v, float):
+        return f"{v:.{nd}g}"
+    return str(v)
+
+
+def _flatten(d: dict, prefix: str = "") -> Dict[str, object]:
+    out: Dict[str, object] = {}
+    for k, v in sorted(d.items()):
+        key = f"{prefix}.{k}" if prefix else str(k)
+        if isinstance(v, dict):
+            out.update(_flatten(v, key))
+        else:
+            out[key] = v
+    return out
+
+
+def _first(events: List[dict], kind: str) -> Optional[dict]:
+    for e in events:
+        if e.get("kind") == kind:
+            return e
+    return None
+
+
+def render_report(events: List[dict]) -> str:
+    """One run's story as text: manifest, epoch table, incidents,
+    summary."""
+    lines: List[str] = []
+    start = _first(events, "run_start")
+    if start:
+        man = start.get("manifest", {})
+        lines.append("== manifest ==")
+        for key in (
+            "run",
+            "mode",
+            "metric",
+            "jax_version",
+            "backend",
+            "device_kind",
+            "num_processes",
+            "mesh",
+            "num_epoch",
+            "start_epoch",
+            "scan_epoch",
+            "mixed_precision",
+            "init_retries",
+        ):
+            if key in man:
+                lines.append(f"  {key}: {_fmt(man[key])}")
+        for split, plan in (man.get("pad_plans") or {}).items():
+            lines.append(f"  pad[{split}]: {plan}")
+    epochs = [e for e in events if e.get("kind") == "epoch"]
+    if epochs:
+        lines.append("== epochs ==")
+        lines.append(
+            "  ep    train_loss      val_loss        lr      steps  "
+            "data_wait_s  dispatch_s  device_ms  compiles"
+        )
+        for e in epochs:
+            st = e.get("step_time") or {}
+            comp = e.get("compiles") or {}
+            flag = " RECOMPILE!" if comp.get("unexpected") else ""
+            lines.append(
+                f"  {e.get('epoch', '?'):>4} "
+                f"{_fmt(e.get('train_loss'), 6):>13} "
+                f"{_fmt(e.get('val_loss'), 6):>13} "
+                f"{_fmt(e.get('lr'), 4):>9} "
+                f"{st.get('steps', '-'):>6} "
+                f"{_fmt(st.get('data_wait_s', '-'), 4):>12} "
+                f"{_fmt(st.get('dispatch_s', '-'), 4):>11} "
+                f"{_fmt(st.get('device_wait_ms_mean', '-'), 4):>10} "
+                f"{comp.get('count', '-'):>8}{flag}"
+            )
+    incidents = [
+        e for e in events if e.get("kind") in ("retry", "error", "_unparseable")
+    ]
+    if incidents:
+        lines.append("== incidents ==")
+        for e in incidents:
+            lines.append(
+                f"  [{e.get('kind')}] {e.get('error') or e.get('line') or ''}"
+            )
+    for kind in ("bench_config", "bench_result", "profile_trace"):
+        for e in events:
+            if e.get("kind") == kind:
+                name = e.get("name") or e.get("path") or ""
+                lines.append(f"== {kind} {name} ==")
+                payload = {
+                    k: v
+                    for k, v in e.items()
+                    if k not in ("v", "kind", "t", "rank", "name")
+                }
+                lines.append("  " + json.dumps(payload)[:400])
+    end = _first(events, "run_end")
+    if end is None:
+        lines.append("== run_end: MISSING (crashed or still running) ==")
+    else:
+        lines.append("== run_end ==")
+        for k, v in end.items():
+            if k in ("v", "kind", "t", "rank", "metrics", "timers"):
+                continue
+            lines.append(f"  {k}: {_fmt(v)}")
+        for k, t in (end.get("timers") or {}).items():
+            lines.append(f"  timer {k}: {t}")
+    return "\n".join(lines)
+
+
+def render_diff(a_events: List[dict], b_events: List[dict]) -> str:
+    """What changed between two runs: manifest drift + per-epoch and
+    summary deltas."""
+    lines: List[str] = []
+    a_start, b_start = _first(a_events, "run_start"), _first(b_events, "run_start")
+    a_man = _flatten((a_start or {}).get("manifest") or {})
+    b_man = _flatten((b_start or {}).get("manifest") or {})
+    drift = []
+    for key in sorted(set(a_man) | set(b_man)):
+        va, vb = a_man.get(key, "<absent>"), b_man.get(key, "<absent>")
+        if va != vb:
+            drift.append(f"  {key}: {_fmt(va)} -> {_fmt(vb)}")
+    lines.append(f"== manifest drift ({len(drift)} keys) ==")
+    lines.extend(drift or ["  (identical)"])
+
+    a_ep = {e.get("epoch"): e for e in a_events if e.get("kind") == "epoch"}
+    b_ep = {e.get("epoch"): e for e in b_events if e.get("kind") == "epoch"}
+    common = sorted(set(a_ep) & set(b_ep))
+    if common:
+        lines.append("== per-epoch deltas (B - A) ==")
+        for ep in common:
+            ea, eb = a_ep[ep], b_ep[ep]
+            parts = [f"  ep {ep}:"]
+            for field in ("train_loss", "val_loss"):
+                va, vb = ea.get(field), eb.get(field)
+                if isinstance(va, (int, float)) and isinstance(vb, (int, float)):
+                    parts.append(f"{field} {vb - va:+.6g}")
+            sa = (ea.get("step_time") or {}).get("data_wait_s")
+            sb = (eb.get("step_time") or {}).get("data_wait_s")
+            if isinstance(sa, (int, float)) and isinstance(sb, (int, float)):
+                parts.append(f"data_wait_s {sb - sa:+.4g}")
+            lines.append(" ".join(parts))
+    only_a, only_b = sorted(set(a_ep) - set(b_ep)), sorted(set(b_ep) - set(a_ep))
+    if only_a:
+        lines.append(f"  epochs only in A: {only_a}")
+    if only_b:
+        lines.append(f"  epochs only in B: {only_b}")
+
+    a_end, b_end = _first(a_events, "run_end"), _first(b_events, "run_end")
+    lines.append("== run_end ==")
+    for name, end in (("A", a_end), ("B", b_end)):
+        if end is None:
+            lines.append(f"  {name}: MISSING")
+        else:
+            brief = {
+                k: v
+                for k, v in end.items()
+                if k in ("status", "epochs", "best_val_loss", "value", "metric")
+            }
+            lines.append(f"  {name}: {json.dumps(brief)}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("records", nargs="+", help="flight-record .jsonl path(s)")
+    p.add_argument(
+        "--validate",
+        action="store_true",
+        help="schema-check instead of printing; exit 1 on problems",
+    )
+    p.add_argument(
+        "--require-complete",
+        action="store_true",
+        help="with --validate: also require run_start + epoch(s) + run_end",
+    )
+    p.add_argument(
+        "--diff",
+        action="store_true",
+        help="diff exactly two records (A B)",
+    )
+    args = p.parse_args(argv)
+
+    if args.diff:
+        if len(args.records) != 2:
+            p.error("--diff needs exactly two records")
+        a, b = (read_flight_record(r) for r in args.records)
+        print(render_diff(a, b))
+        return 0
+
+    rc = 0
+    for path in args.records:
+        events = read_flight_record(path)
+        if args.validate:
+            problems = validate_flight_record(
+                events, require_complete=args.require_complete
+            )
+            if problems:
+                rc = 1
+                print(f"{path}: INVALID ({len(problems)} problem(s))")
+                for prob in problems:
+                    print(f"  - {prob}")
+            else:
+                print(f"{path}: OK ({len(events)} events)")
+        else:
+            if len(args.records) > 1:
+                print(f"===== {path} =====")
+            print(render_report(events))
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
