@@ -30,21 +30,27 @@
 #                         committed proof that a default run leaves a
 #                         parseable evidence artifact
 #                         (docs/OBSERVABILITY.md).
-#   5. full matrix      — opt-in (CI_FULL=1): all 7 models x head configs
+#   5. fault-injection  — a tiny run is SIGTERM-killed mid-epoch via
+#      smoke               HYDRAGNN_INJECT_SIGTERM_STEP, the restart
+#                         supervisor (tools/supervise.py) resumes it to
+#                         completion, and the merged flight record must
+#                         validate with exactly one preempted run_end +
+#                         one resumed event (docs/RESILIENCE.md).
+#   6. full matrix      — opt-in (CI_FULL=1): all 7 models x head configs
 #                         trained to the reference accuracy thresholds
 #                         (HYDRAGNN_FULL_MATRIX=1, ~15 min).
-#   6. TPU kernel suite — opt-in (CI_TPU=1, needs a real TPU):
+#   7. TPU kernel suite — opt-in (CI_TPU=1, needs a real TPU):
 #                         HYDRAGNN_TPU_TESTS=1 on-chip kernel-vs-XLA
 #                         checks, budgeted under the tunnel's dispatch
 #                         throttle (tests/test_tpu_chip.py).
 #
-# Usage: ./ci.sh            # stages 1-4 (the default CI gate)
+# Usage: ./ci.sh            # stages 1-5 (the default CI gate)
 #        CI_FULL=1 ./ci.sh  # + acceptance matrix
 #        CI_TPU=1  ./ci.sh  # + real-chip kernel suite
 set -euo pipefail
 cd "$(dirname "$0")"
 
-echo "== [1/6] format gate =="
+echo "== [1/7] format gate =="
 if python -m black --version >/dev/null 2>&1; then
     python -m black --check .
 elif command -v black >/dev/null 2>&1; then
@@ -54,13 +60,13 @@ else
     python -m compileall -q hydragnn_tpu tests examples tools bench.py bench_scaling.py bench_serve.py __graft_entry__.py
 fi
 
-echo "== [2/6] chip hygiene report =="
+echo "== [2/7] chip hygiene report =="
 python tools/chip_hygiene.py || true
 
-echo "== [3/6] serial suite (virtual 8-device CPU mesh, incl. 2-process pass) =="
+echo "== [3/7] serial suite (virtual 8-device CPU mesh, incl. 2-process pass) =="
 python -m pytest tests/ -q
 
-echo "== [4/6] telemetry smoke (tiny training -> schema-valid flight record) =="
+echo "== [4/7] telemetry smoke (tiny training -> schema-valid flight record) =="
 SMOKE_DIR="$(mktemp -d)"
 JAX_PLATFORMS=cpu python - "$SMOKE_DIR" <<'EOF'
 import sys
@@ -84,18 +90,64 @@ python tools/obs_report.py --validate --require-complete "$FLIGHT"
 python tools/obs_report.py "$FLIGHT"
 rm -rf "$SMOKE_DIR"
 
+echo "== [5/7] fault-injection smoke (SIGTERM mid-epoch -> supervisor resume) =="
+FAULT_DIR="$(mktemp -d)"
+cat > "$FAULT_DIR/child.py" <<'EOF'
+import sys
+
+from hydragnn_tpu.resilience import run_guard
+from hydragnn_tpu.api import run_training
+from hydragnn_tpu.data.synthetic import deterministic_graph_data
+from hydragnn_tpu.flagship import flagship_config
+
+cfg = flagship_config(hidden_dim=8, num_conv_layers=2, batch_size=5, num_epoch=2)
+cfg["NeuralNetwork"]["Training"]["checkpoint_every"] = 1
+samples = deterministic_graph_data(
+    number_configurations=20,
+    unit_cell_x_range=(2, 3),
+    unit_cell_y_range=(2, 3),
+    unit_cell_z_range=(2, 3),
+    seed=0,
+)
+with run_guard():
+    run_training(cfg, samples=samples, log_dir=sys.argv[1] + "/logs/")
+EOF
+# PYTHONPATH: the child script lives in the temp dir, so the repo must
+# reach its sys.path through the environment
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" HYDRAGNN_INJECT_SIGTERM_STEP=2 \
+    python tools/supervise.py \
+    --flight "$FAULT_DIR/supervisor.jsonl" -- \
+    python "$FAULT_DIR/child.py" "$FAULT_DIR"
+FAULT_FLIGHT="$(ls "$FAULT_DIR"/logs/*/flight.jsonl)"
+python tools/obs_report.py --faults "$FAULT_FLIGHT"
+python tools/obs_report.py --validate "$FAULT_FLIGHT" "$FAULT_DIR/supervisor.jsonl"
+python - "$FAULT_FLIGHT" <<'EOF'
+import sys
+
+from hydragnn_tpu.obs.flight import read_flight_record
+
+ev = read_flight_record(sys.argv[1])
+ends = [e for e in ev if e.get("kind") == "run_end"]
+assert [e["status"] for e in ends] == ["preempted", "completed"], ends
+assert sum(1 for e in ev if e.get("kind") == "resumed") == 1, [
+    e.get("kind") for e in ev
+]
+print("fault-injection smoke: OK (one preempted + one resumed, run completed)")
+EOF
+rm -rf "$FAULT_DIR"
+
 if [ "${CI_FULL:-0}" = "1" ]; then
-    echo "== [5/6] full acceptance matrix (reference thresholds) =="
+    echo "== [6/7] full acceptance matrix (reference thresholds) =="
     HYDRAGNN_FULL_MATRIX=1 python -m pytest tests/test_train_matrix.py -q
 else
-    echo "== [5/6] full acceptance matrix: skipped (set CI_FULL=1) =="
+    echo "== [6/7] full acceptance matrix: skipped (set CI_FULL=1) =="
 fi
 
 if [ "${CI_TPU:-0}" = "1" ]; then
-    echo "== [6/6] real-chip TPU kernel suite =="
+    echo "== [7/7] real-chip TPU kernel suite =="
     HYDRAGNN_TPU_TESTS=1 python -m pytest tests/test_tpu_chip.py -q
 else
-    echo "== [6/6] real-chip TPU kernel suite: skipped (set CI_TPU=1, needs a TPU) =="
+    echo "== [7/7] real-chip TPU kernel suite: skipped (set CI_TPU=1, needs a TPU) =="
 fi
 
 echo "CI protocol complete."

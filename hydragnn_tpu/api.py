@@ -175,6 +175,12 @@ def train_with_loaders(
     example_one = _example_for_init(example, device_stack)
 
     training = nn_config["Training"]
+    # Restart-supervisor resume (hydragnn_tpu/resilience/supervisor.py):
+    # a restarted child runs with HYDRAGNN_AUTO_RESUME=1 and picks up
+    # its own checkpoint via the ordinary continue/startfrom machinery.
+    from hydragnn_tpu.resilience import auto_resume_config
+
+    auto_resume_config(training, log_name, log_dir)
     freeze = bool(nn_config["Architecture"].get("freeze_conv_layers"))
     tx = select_optimizer(training, freeze_conv=freeze)
 

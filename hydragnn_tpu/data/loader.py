@@ -425,9 +425,15 @@ class GraphLoader:
         _c_wait = _reg.counter("loader.prefetch_wait_s")
         _c_stalls = _reg.counter("loader.prefetch_stalls")
 
+        # Deterministic stalled-producer fault injection
+        # (HYDRAGNN_INJECT_STALL_LOADER, docs/RESILIENCE.md): drives the
+        # hang watchdog's data-wait abort path in tests; no-op otherwise.
+        from hydragnn_tpu.resilience.inject import maybe_stall_loader
+
         order = self._order()
         if self.prefetch <= 0:
             for b in range(nb):
+                maybe_stall_loader(b)
                 t0 = time.perf_counter() if _obs_on else 0.0
                 batch = self._place(self._make_batch(order[b * bs : (b + 1) * bs]))
                 if _obs_on:
@@ -458,6 +464,7 @@ class GraphLoader:
         def producer():
             try:
                 for b in range(nb):
+                    maybe_stall_loader(b)
                     t0 = time.perf_counter() if _obs_on else 0.0
                     batch = self._place(self._make_batch(order[b * bs : (b + 1) * bs]))
                     if _obs_on:

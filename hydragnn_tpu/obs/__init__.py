@@ -29,6 +29,7 @@ from hydragnn_tpu.obs.registry import (
     telemetry_enabled,
 )
 from hydragnn_tpu.obs.flight import (
+    FAULT_KINDS,
     SCHEMA_VERSION,
     FlightRecorder,
     read_flight_record,
@@ -55,6 +56,7 @@ __all__ = [
     "get_registry",
     "reset_registry",
     "telemetry_enabled",
+    "FAULT_KINDS",
     "SCHEMA_VERSION",
     "FlightRecorder",
     "read_flight_record",

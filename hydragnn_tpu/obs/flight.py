@@ -40,7 +40,16 @@ _REQUIRED: Dict[str, tuple] = {
     "error": ("error", "error_type"),
     "profile_trace": ("path",),
     "run_end": ("status",),
+    # fault-tolerance events (hydragnn_tpu/resilience, docs/RESILIENCE.md)
+    "preempt": ("signal", "epoch"),
+    "resumed": ("epoch",),
+    "rollback": ("epoch", "consec"),
+    "watchdog": ("stall_s", "stacks"),
+    "restart": ("attempt", "cause"),
 }
+
+# the fault-history subset tools/obs_report.py --faults narrates
+FAULT_KINDS = ("preempt", "resumed", "rollback", "watchdog", "restart", "retry", "error")
 
 _MANIFEST_REQUIRED = ("jax_version", "backend", "num_processes")
 
@@ -78,9 +87,14 @@ class FlightRecorder:
     """
 
     def __init__(self, path: Optional[str], enabled: bool = True):
+        import threading
+
         self.path = path
         self.enabled = bool(enabled and path)
         self._f = None
+        # the watchdog and preemption grace timer record from their own
+        # threads; one lock keeps lines whole
+        self._lock = threading.Lock()
         if self.enabled:
             d = os.path.dirname(os.path.abspath(path))
             os.makedirs(d, exist_ok=True)
@@ -99,8 +113,9 @@ class FlightRecorder:
         }
         event.update({k: _jsonable(v) for k, v in payload.items()})
         try:
-            self._f.write(json.dumps(event) + "\n")
-            self._f.flush()
+            with self._lock:
+                self._f.write(json.dumps(event) + "\n")
+                self._f.flush()
         except (OSError, ValueError):
             # a full disk or closed fd must not take the run down;
             # stop recording rather than raise per-event

@@ -1,0 +1,61 @@
+"""The per-batch hook bundle the train loop threads through its hot
+loop: preemption check, watchdog heartbeat, fault injection, and the
+non-finite sentry — one object so ``train_epoch``'s signature stays
+flat and the all-disabled path is a couple of attribute checks.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from hydragnn_tpu.resilience import inject
+
+
+class TrainHooks:
+    """Bundles the resilience actors for one training run.
+
+    ``before_step`` runs at batch granularity: beats the watchdog,
+    fires step-indexed fault injections, and returns the (possibly
+    NaN-injected) batch. ``step_counter`` is the process-local dispatch
+    count the injection specs index — deterministic regardless of
+    resume state.
+    """
+
+    def __init__(
+        self,
+        preempt=None,
+        sentry=None,
+        watchdog=None,
+    ):
+        self.preempt = preempt
+        self.sentry = sentry
+        self.watchdog = watchdog
+        self.step_counter = 0
+
+    @property
+    def preempted(self) -> bool:
+        return self.preempt is not None and self.preempt.should_stop()
+
+    def beat(self) -> None:
+        if self.watchdog is not None:
+            self.watchdog.beat()
+
+    def epoch_start(self, epoch: int) -> None:
+        self.beat()
+        inject.maybe_sigterm(epoch=epoch)
+        if self.sentry is not None:
+            self.sentry.epoch_start()
+
+    def before_step(self, batch):
+        self.beat()
+        inject.maybe_sigterm(step=self.step_counter)
+        batch = inject.maybe_nan_batch(batch, self.step_counter)
+        self.step_counter += 1
+        return batch
+
+    def teardown(self) -> None:
+        """Idempotent cleanup — every train-loop exit path calls this."""
+        if self.watchdog is not None:
+            self.watchdog.stop()
+        if self.preempt is not None:
+            self.preempt.uninstall()

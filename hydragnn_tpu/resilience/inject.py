@@ -1,0 +1,118 @@
+"""Env-gated deterministic fault injection.
+
+Every resilience path is only trustworthy if it can be driven on
+demand; these hooks make each failure mode a reproducible test case
+(tests/test_resilience.py, ci.sh fault-injection smoke stage) instead
+of a production anecdote. All hooks are no-ops unless their env var is
+set, and the restart supervisor strips ``HYDRAGNN_INJECT_*`` from
+restarted children by default so an injected fault fires exactly once
+per supervised run.
+
+  =================================  ==========================================
+  HYDRAGNN_INJECT_NAN_STEP=N[:M]     replace the batch's node features with
+                                     NaN for train steps N..N+M-1 (M=1)
+  HYDRAGNN_INJECT_SIGTERM_STEP=N     SIGTERM self-signal before train step N
+  HYDRAGNN_INJECT_SIGTERM_EPOCH=E    SIGTERM self-signal at the start of
+                                     epoch E (the epoch-boundary case)
+  HYDRAGNN_INJECT_KILL_CHECKPOINT=K  during the K-th (1-indexed) checkpoint
+                                     save of this process: write a TRUNCATED
+                                     checkpoint file in place (simulating a
+                                     torn write on a filesystem without
+                                     atomic replace) and SIGKILL the process
+  HYDRAGNN_INJECT_STALL_LOADER=B:S   the loader's producer sleeps S seconds
+                                     before building batch B of an epoch
+                                     (drives the hang watchdog)
+  =================================  ==========================================
+
+Step numbers are process-local dispatch counts (0-based, counted by
+``TrainHooks``), so injections are deterministic regardless of resume
+state.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import time
+from typing import Optional, Tuple
+
+
+def _spec(name: str) -> Optional[str]:
+    v = os.environ.get(name)
+    return v if v else None
+
+
+def _two_ints(spec: str, default_second: int) -> Tuple[int, int]:
+    parts = spec.split(":")
+    a = int(parts[0])
+    b = int(parts[1]) if len(parts) > 1 and parts[1] else default_second
+    return a, b
+
+
+def maybe_nan_batch(batch, step: int):
+    """Return ``batch`` with NaN node features when step is inside the
+    injected window, else the batch unchanged."""
+    spec = _spec("HYDRAGNN_INJECT_NAN_STEP")
+    if spec is None:
+        return batch
+    start, count = _two_ints(spec, 1)
+    if not start <= step < start + count:
+        return batch
+    import numpy as np
+
+    nodes = np.full_like(np.asarray(batch.nodes), np.nan)
+    return batch.replace(nodes=nodes)
+
+
+def maybe_sigterm(step: Optional[int] = None, epoch: Optional[int] = None) -> None:
+    """Self-SIGTERM at the injected step or epoch boundary."""
+    if step is not None:
+        spec = _spec("HYDRAGNN_INJECT_SIGTERM_STEP")
+        if spec is not None and step == int(spec):
+            os.kill(os.getpid(), signal.SIGTERM)
+    if epoch is not None:
+        spec = _spec("HYDRAGNN_INJECT_SIGTERM_EPOCH")
+        if spec is not None and epoch == int(spec):
+            os.kill(os.getpid(), signal.SIGTERM)
+
+
+_CHECKPOINT_SAVES = 0
+
+
+def maybe_kill_checkpoint(path: str, data: bytes) -> None:
+    """During the K-th checkpoint save: leave ``path`` TRUNCATED (half
+    the payload, written directly — deliberately bypassing the normal
+    tmp-file + atomic-replace discipline, like a filesystem that tears
+    writes on power loss) and SIGKILL the process. The restart must
+    then reject the truncated file and restore the previous good one —
+    the integrity-validation path this exists to prove."""
+    spec = _spec("HYDRAGNN_INJECT_KILL_CHECKPOINT")
+    if spec is None:
+        return
+    global _CHECKPOINT_SAVES
+    _CHECKPOINT_SAVES += 1
+    if _CHECKPOINT_SAVES != int(spec):
+        return
+    with open(path, "wb") as f:
+        f.write(data[: max(len(data) // 2, 1)])
+        f.flush()
+        os.fsync(f.fileno())
+    os.kill(os.getpid(), signal.SIGKILL)
+
+
+def maybe_stall_loader(batch_index: int) -> None:
+    """Sleep in the loader's producer before building the injected
+    batch index (per epoch)."""
+    spec = _spec("HYDRAGNN_INJECT_STALL_LOADER")
+    if spec is None:
+        return
+    b, seconds = _two_ints(spec, 3600)
+    if batch_index == b:
+        time.sleep(seconds)
+
+
+def strip_injection_env(env: dict) -> dict:
+    """Copy of ``env`` without any ``HYDRAGNN_INJECT_*`` keys — what the
+    restart supervisor hands to restarted children so injected faults
+    fire exactly once."""
+    return {k: v for k, v in env.items() if not k.startswith("HYDRAGNN_INJECT_")}

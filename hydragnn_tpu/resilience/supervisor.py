@@ -1,0 +1,173 @@
+"""Bounded restart supervisor: turn "the run crashed" into "the run
+resumed" — without looping forever on a run that can never succeed.
+
+The supervisor re-invokes a training command, classifies each exit by
+the contract in :mod:`hydragnn_tpu.resilience.preempt`, and decides:
+
+  - ``completed`` (0) — done.
+  - ``preempted`` (75) — restart promptly (bounded by
+    ``max_preemptions``; eviction is the expected steady state on
+    preemptible slices, not a failure).
+  - ``config_error`` (78) / ``rollback_exhausted`` (76) — FAIL FAST:
+    deterministic, a retry burns the backoff budget to fail
+    identically.
+  - anything else (``crash``, including signal deaths and ``hung``/79
+    from the watchdog) — retry with exponential backoff up to
+    ``max_restarts``.
+
+Every restarted child gets ``HYDRAGNN_AUTO_RESUME=1`` (the api layer
+flips the config to ``Training.continue`` when the checkpoint exists)
+and — by default — the ``HYDRAGNN_INJECT_*`` fault-injection vars
+stripped, so an injected fault fires exactly once per supervised run.
+
+``tools/supervise.py`` is the CLI; the ``runner``/``sleep`` seams exist
+so the policy is unit-testable without real processes
+(tests/test_resilience.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import subprocess
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+from hydragnn_tpu.resilience.inject import strip_injection_env
+from hydragnn_tpu.resilience.preempt import (
+    EXIT_CONFIG_ERROR,
+    EXIT_HUNG,
+    EXIT_OK,
+    EXIT_PREEMPTED,
+    EXIT_ROLLBACK_EXHAUSTED,
+)
+
+FAIL_FAST_CAUSES = frozenset({"config_error", "rollback_exhausted"})
+
+
+def classify_exit(returncode: int) -> str:
+    """Exit cause from a child's return code (negative = signal death,
+    which subprocess reports for SIGKILL etc.)."""
+    if returncode == EXIT_OK:
+        return "completed"
+    if returncode == EXIT_PREEMPTED:
+        return "preempted"
+    if returncode == EXIT_ROLLBACK_EXHAUSTED:
+        return "rollback_exhausted"
+    if returncode == EXIT_CONFIG_ERROR:
+        return "config_error"
+    if returncode == EXIT_HUNG:
+        return "hung"
+    return "crash"
+
+
+@dataclasses.dataclass
+class SupervisorPolicy:
+    max_restarts: int = 5  # crash/hung-class restarts
+    max_preemptions: int = 1000  # preemption resumes (not failures)
+    backoff_base_s: float = 1.0
+    backoff_factor: float = 2.0
+    backoff_max_s: float = 60.0
+    auto_resume: bool = True  # set HYDRAGNN_AUTO_RESUME=1 for restarts
+    strip_injection: bool = True  # drop HYDRAGNN_INJECT_* from restarts
+
+    def backoff(self, n_crashes: int) -> float:
+        """Delay before the n-th crash-class restart (n >= 1)."""
+        return min(
+            self.backoff_base_s * self.backoff_factor ** max(n_crashes - 1, 0),
+            self.backoff_max_s,
+        )
+
+
+class Supervisor:
+    """Run ``argv`` under the restart policy.
+
+    ``runner(argv, env) -> returncode`` defaults to ``subprocess.call``;
+    ``flight`` (a :class:`~hydragnn_tpu.obs.flight.FlightRecorder`)
+    receives one ``restart`` event per re-invocation and a terminal
+    ``run_end``.
+    """
+
+    def __init__(
+        self,
+        argv: Sequence[str],
+        policy: Optional[SupervisorPolicy] = None,
+        env: Optional[Dict[str, str]] = None,
+        flight=None,
+        runner: Optional[Callable[[Sequence[str], Dict[str, str]], int]] = None,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        self.argv = list(argv)
+        self.policy = policy or SupervisorPolicy()
+        self.base_env = dict(env if env is not None else os.environ)
+        self.flight = flight
+        self.runner = runner or (lambda a, e: subprocess.call(a, env=e))
+        self.sleep = sleep
+        self.history: List[dict] = []
+
+    def _child_env(self, attempt: int) -> Dict[str, str]:
+        env = dict(self.base_env)
+        if attempt > 0:
+            if self.policy.auto_resume:
+                env["HYDRAGNN_AUTO_RESUME"] = "1"
+            if self.policy.strip_injection:
+                env = strip_injection_env(env)
+        return env
+
+    def run(self) -> dict:
+        """Supervise to completion or give-up; returns a result dict
+        with ``status`` (``completed`` / ``failed_fast`` /
+        ``gave_up``), the final ``exit_code``/``cause``, and counts."""
+        crashes = 0
+        preemptions = 0
+        attempt = 0
+        while True:
+            rc = self.runner(self.argv, self._child_env(attempt))
+            cause = classify_exit(rc)
+            self.history.append({"attempt": attempt, "exit_code": rc, "cause": cause})
+            if cause == "completed":
+                return self._finish("completed", rc, cause, crashes, preemptions)
+            if cause in FAIL_FAST_CAUSES:
+                return self._finish("failed_fast", rc, cause, crashes, preemptions)
+            if cause == "preempted":
+                preemptions += 1
+                if preemptions > self.policy.max_preemptions:
+                    return self._finish("gave_up", rc, cause, crashes, preemptions)
+                delay = 0.0
+            else:  # crash / hung
+                crashes += 1
+                if crashes > self.policy.max_restarts:
+                    return self._finish("gave_up", rc, cause, crashes, preemptions)
+                delay = self.policy.backoff(crashes)
+            attempt += 1
+            if self.flight is not None:
+                self.flight.record(
+                    "restart",
+                    attempt=attempt,
+                    cause=cause,
+                    exit_code=rc,
+                    delay_s=delay,
+                )
+            if delay > 0:
+                self.sleep(delay)
+
+    def _finish(self, status, rc, cause, crashes, preemptions) -> dict:
+        result = {
+            "status": status,
+            "exit_code": rc,
+            "cause": cause,
+            "attempts": len(self.history),
+            "restarts": crashes,
+            "preemptions": preemptions,
+            "history": list(self.history),
+        }
+        if self.flight is not None:
+            self.flight.end_run(
+                status=status,
+                exit_code=rc,
+                cause=cause,
+                attempts=result["attempts"],
+                restarts=crashes,
+                preemptions=preemptions,
+            )
+        return result

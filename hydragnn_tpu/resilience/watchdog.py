@@ -1,0 +1,135 @@
+"""Hang watchdog: detect a wedged training loop and die loudly.
+
+A stuck collective, a hung device dispatch, or a deadlocked data
+producer all present the same way: the step loop stops beating while
+the process looks perfectly alive to the scheduler. The watchdog is a
+daemon thread fed a heartbeat from the hot loop (``TrainHooks.beat``,
+once per batch + at epoch boundaries); when no beat arrives for
+``stall_s`` seconds it dumps EVERY Python thread's stack into the
+flight record (``watchdog`` event + ``run_end{status:"hung"}``) and
+aborts the process with :data:`~hydragnn_tpu.resilience.preempt.EXIT_HUNG`
+— a structured corpse the restart supervisor classifies and retries,
+instead of a silent job that burns its reservation until a human
+notices.
+
+Caveat: the first train step legitimately blocks for the compile;
+size ``stall_s`` (config ``Training.watchdog_stall_s`` or env
+``HYDRAGNN_WATCHDOG_S``) above the worst expected compile time. The
+watchdog is OFF unless one of those is set.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+import traceback
+from typing import Callable, Dict, Optional
+
+from hydragnn_tpu.resilience.preempt import EXIT_HUNG
+
+
+def dump_thread_stacks() -> Dict[str, str]:
+    """Formatted stack of every live Python thread, keyed by thread
+    name (the evidence payload for the ``watchdog`` flight event)."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out: Dict[str, str] = {}
+    for ident, frame in sys._current_frames().items():
+        name = names.get(ident, f"thread-{ident}")
+        out[name] = "".join(traceback.format_stack(frame))
+    return out
+
+
+class HangWatchdog:
+    """Heartbeat-fed stall detector.
+
+    ``action`` runs once on the watchdog thread when a stall is
+    detected, AFTER the flight events are written; the default
+    hard-exits with :data:`EXIT_HUNG` (tests inject a recording action
+    instead). ``beat()`` is a single monotonic-clock store — cheap
+    enough for the per-batch hot path.
+    """
+
+    def __init__(
+        self,
+        stall_s: float,
+        flight=None,
+        action: Optional[Callable[[], None]] = None,
+        poll_s: Optional[float] = None,
+        warmup_beats: int = 2,
+    ):
+        if stall_s <= 0:
+            raise ValueError(f"stall_s must be > 0, got {stall_s}")
+        self.stall_s = float(stall_s)
+        self.flight = flight
+        self.action = action if action is not None else self._default_abort
+        self.poll_s = float(poll_s) if poll_s else max(self.stall_s / 4.0, 0.05)
+        # the watchdog ARMS only after this many beats: setup (imports,
+        # model init) and the first train step's compile legitimately
+        # block for longer than any reasonable stall threshold — the
+        # same skip-the-compile-step discipline as StepSpans.skip_first
+        self.warmup_beats = int(warmup_beats)
+        self.fired = False
+        self._beats = 0
+        self._last_beat = time.monotonic()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def beat(self) -> None:
+        self._beats += 1
+        self._last_beat = time.monotonic()
+
+    @property
+    def armed(self) -> bool:
+        return self._beats > self.warmup_beats
+
+    def start(self) -> "HangWatchdog":
+        if self._thread is None:
+            self.beat()
+            self._thread = threading.Thread(
+                target=self._run, name="hydragnn-watchdog", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+    # -- internals ---------------------------------------------------------
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            if not self.armed:
+                continue
+            stalled = time.monotonic() - self._last_beat
+            if stalled >= self.stall_s:
+                self._fire(stalled)
+                return
+
+    def _fire(self, stalled: float) -> None:
+        self.fired = True
+        stacks = dump_thread_stacks()
+        if self.flight is not None:
+            self.flight.record(
+                "watchdog", stall_s=round(stalled, 3), stacks=stacks
+            )
+            self.flight.end_run(status="hung", stall_s=round(stalled, 3))
+            self.flight.close()
+        self.action()
+
+    def _default_abort(self) -> None:
+        try:
+            os.write(
+                2,
+                (
+                    f"HangWatchdog: no heartbeat for {self.stall_s}s — "
+                    "aborting (thread stacks are in the flight record)\n"
+                ).encode(),
+            )
+        except OSError:
+            pass
+        os._exit(EXIT_HUNG)

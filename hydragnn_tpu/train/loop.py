@@ -142,6 +142,10 @@ class _MetricAccum:
         self._counts.append(n)
 
     def finalize(self) -> Tuple[float, np.ndarray]:
+        if not self._counts:
+            # zero batches ran (e.g. preemption before the first step);
+            # the caller's preempt path discards these values
+            return 0.0, np.zeros(0, np.float32)
         return _finalize_weighted(self._losses, self._tasks, self._counts)
 
 
@@ -152,21 +156,42 @@ def train_epoch(
     verbosity: int = 0,
     profiler=None,
     spans=None,
+    hooks=None,
 ) -> Tuple[TrainState, float, np.ndarray]:
     """One training epoch; returns (state, avg_loss, avg_tasks_loss[H]).
 
     ``spans`` (hydragnn_tpu/obs/spans.py:StepSpans) decomposes the
     epoch's wall time into data-wait / host-dispatch / sampled device
     time; the default disabled spans keep the loop's plain async shape
-    (identity iterator, direct step call)."""
+    (identity iterator, direct step call).
+
+    ``hooks`` (hydragnn_tpu/resilience/hooks.py:TrainHooks) adds the
+    fault-tolerance hot-loop duties at batch granularity: preemption
+    check (graceful mid-epoch stop), watchdog heartbeat, fault
+    injection, and — when its non-finite sentry is active — the
+    GUARDED step call ``train_step(state, batch, consec)`` whose
+    skipped batches contribute zero weight to the epoch metrics."""
     if spans is None:
         from hydragnn_tpu.obs import StepSpans
 
         spans = StepSpans.disabled()
+    sentry = hooks.sentry if hooks is not None else None
     acc = _MetricAccum()
     for batch in spans.timed_iter(iterate_tqdm(loader, verbosity, desc="train")):
-        state, loss, task_losses = spans.step(train_step, state, batch)
-        acc.add(loss, task_losses, batch.graph_mask.sum())
+        if hooks is not None:
+            if hooks.preempted:
+                break
+            batch = hooks.before_step(batch)
+        if sentry is not None:
+            state, loss, task_losses, consec, bad = spans.step(
+                train_step, state, batch, sentry.consec
+            )
+            sentry.observe(consec, bad)
+            n = batch.graph_mask.sum() * (1.0 - bad)
+        else:
+            state, loss, task_losses = spans.step(train_step, state, batch)
+            n = batch.graph_mask.sum()
+        acc.add(loss, task_losses, n)
         if profiler is not None:
             profiler.step()
     avg_loss, avg_tasks = acc.finalize()
@@ -364,8 +389,20 @@ def train_validate_test(
         )
         if eval_step is None:  # a caller-supplied eval_step keeps priority
             scan_eval_fn = make_scan_eval(model)
+    # Non-finite guard (hydragnn_tpu/resilience/sentry.py): folded into
+    # the default per-step jitted train step only — sharded callers pass
+    # their own step, and the scan path has no batch granularity.
+    guard_nonfinite = (
+        bool(training.get("nonfinite_guard", True))
+        and train_step is None
+        and scan_fn is None
+    )
     train_step = train_step or make_train_step(
-        model, tx, compute_dtype=compute_dtype, remat=bool(training.get("remat", False))
+        model,
+        tx,
+        compute_dtype=compute_dtype,
+        remat=bool(training.get("remat", False)),
+        guard_nonfinite=guard_nonfinite,
     )
     eval_step = eval_step or make_eval_step(model)
     eval_step_out = eval_step_out or make_eval_step(model, with_outputs=True)
@@ -396,7 +433,9 @@ def train_validate_test(
     # early-stop counters survive the restart). The TrainState itself is
     # restored by the caller via Training.continue/startfrom.
     ckpt_every = int(training.get("checkpoint_every", 0))
+    ckpt_keep_last = int(training.get("checkpoint_keep_last", 3))
     start_epoch = 0
+    resumed_from = None  # set when a continue-run actually loaded meta
     if training.get("continue") == 1:
         from hydragnn_tpu.utils.checkpoint import load_train_meta
 
@@ -456,6 +495,7 @@ def train_validate_test(
             # also supports the reference's extend-training workflow
             # (continue with a larger num_epoch)
             start_epoch = num_epoch if meta.get("early_stopped") else int(meta["epoch"])
+            resumed_from = start_epoch
             scheduler.best = float(meta["scheduler"]["best"])
             scheduler.num_bad_epochs = int(meta["scheduler"]["num_bad_epochs"])
             if stopper is not None and "stopper" in meta:
@@ -492,10 +532,52 @@ def train_validate_test(
             "profile_trace", path=path, epoch=ep
         )
 
+    # Fault tolerance (hydragnn_tpu/resilience, docs/RESILIENCE.md):
+    # preemption handler (SIGTERM/SIGINT -> graceful stop + final
+    # checkpoint within Training.preempt_grace_s), non-finite sentry
+    # over the guarded train step (single-device per-step path only —
+    # sharded callers pass their own step; the scan path is one
+    # dispatch per epoch, batch granularity does not exist there), and
+    # the opt-in hang watchdog (Training.watchdog_stall_s or
+    # HYDRAGNN_WATCHDOG_S; off by default — it must be sized above the
+    # worst expected compile time).
+    from hydragnn_tpu.resilience import (
+        HangWatchdog,
+        NonFiniteSentry,
+        PreemptionHandler,
+        TrainHooks,
+        TrainingPreempted,
+    )
+
+    sentry = (
+        NonFiniteSentry(
+            patience=int(training.get("nonfinite_patience", 16)),
+            max_rollbacks=int(training.get("nonfinite_max_rollbacks", 2)),
+            lr_factor=float(training.get("nonfinite_rollback_lr_factor", 0.5)),
+        )
+        if guard_nonfinite
+        else None
+    )
+    preempt = (
+        PreemptionHandler(
+            grace_s=float(training.get("preempt_grace_s", 30.0))
+        ).install()
+        if training.get("preempt_handler", True)
+        else None
+    )
+    stall_s = float(
+        training.get("watchdog_stall_s", 0)
+        or os.environ.get("HYDRAGNN_WATCHDOG_S", 0)
+        or 0
+    )
+    watchdog = HangWatchdog(stall_s, flight=flight).start() if stall_s > 0 else None
+    hooks = TrainHooks(preempt=preempt, sentry=sentry, watchdog=watchdog)
+
     def _abort_telemetry(exc: BaseException, epochs: int) -> None:
         """Record the failure into the flight record before unwinding —
         a crashed run must still leave a parseable artifact (the r05
         'traceback was the only evidence' failure mode)."""
+        hooks.teardown()
         flight.error(exc)
         flight.end_run(status="failed", epochs=epochs)
         if cmon is not None:
@@ -548,8 +630,16 @@ def train_validate_test(
             "mixed_precision": compute_dtype is not None,
             "scan_epoch": scan_fn is not None,
             "compile_monitor_available": bool(cmon and cmon.available),
+            "nonfinite_guard": sentry is not None,
+            "preempt_handler": bool(preempt and preempt.available),
+            "watchdog_stall_s": stall_s or None,
         }
     )
+    if resumed_from is not None:
+        # a restarted run announces where it picked up — the supervisor
+        # story ("one preempted + one resumed") is then readable from
+        # the merged flight record alone
+        flight.record("resumed", epoch=resumed_from)
 
     # Visualization (reference: Visualizer wiring, train_validate_test.py:
     # 71-97,90-96: initial-solution scatter, per-epoch histograms, final
@@ -588,7 +678,7 @@ def train_validate_test(
     def _write_checkpoint(ckpt_state, epoch_next: int, early_stopped: bool) -> None:
         from hydragnn_tpu.utils.checkpoint import save_model, save_train_meta
 
-        save_model(ckpt_state, log_name, log_dir, verbosity)
+        save_model(ckpt_state, log_name, log_dir, verbosity, keep_last=ckpt_keep_last)
         save_train_meta(
             {
                 "epoch": epoch_next,
@@ -610,11 +700,86 @@ def train_validate_test(
             log_dir,
         )
 
+    def _preempt_exit(ckpt_state, epoch: int):
+        """Graceful preemption: checkpoint + meta pair for this epoch,
+        ``preempt`` + ``run_end{status:"preempted"}`` flight events,
+        telemetry closed — all inside the grace window the handler's
+        hard-exit timer enforces — then the typed exception the driver's
+        run_guard maps to EXIT_PREEMPTED."""
+        signum = preempt.signum if preempt is not None else 0
+        _write_checkpoint(ckpt_state, epoch, early_stopped=False)
+        flight.record(
+            "preempt",
+            signal=signum,
+            epoch=epoch,
+            step=int(jax.device_get(ckpt_state.step)),
+        )
+        flight.end_run(status="preempted", epochs=epoch - start_epoch)
+        if cmon is not None:
+            cmon.stop()
+        if own_flight:
+            flight.close()
+        try:
+            writer.flush()
+            writer.close()
+        except Exception:
+            pass
+        hooks.teardown()
+        raise TrainingPreempted(signum, epoch)
+
+    def _sentry_rollback(cur_state, epoch: int, consec_end: int):
+        """K consecutive non-finite steps at the epoch's tail: restore
+        the last good checkpoint with a reduced LR instead of
+        continuing; give up (typed, fail-fast exit) when the rollback
+        budget is spent or there is nothing to roll back to."""
+        from hydragnn_tpu.resilience import NonFiniteRollbackExhausted
+        from hydragnn_tpu.utils.checkpoint import (
+            checkpoint_exists,
+            load_existing_model,
+        )
+
+        if sentry.exhausted or not checkpoint_exists(log_name, log_dir):
+            raise NonFiniteRollbackExhausted(
+                f"epoch {epoch} ended with {consec_end} consecutive "
+                f"non-finite steps; rollbacks used {sentry.rollbacks}/"
+                f"{sentry.max_rollbacks}"
+                + (
+                    ""
+                    if checkpoint_exists(log_name, log_dir)
+                    else " and no checkpoint exists to roll back to"
+                )
+            )
+        restored = load_existing_model(cur_state, log_name, log_dir)
+        lr = max(
+            current_learning_rate(restored.opt_state) * sentry.lr_factor, 1e-8
+        )
+        restored = restored.replace(
+            opt_state=set_learning_rate(restored.opt_state, lr)
+        )
+        sentry.on_rollback()
+        flight.record(
+            "rollback",
+            epoch=epoch,
+            consec=consec_end,
+            rollbacks=sentry.rollbacks,
+            lr=lr,
+        )
+        print_distributed(
+            verbosity,
+            f"non-finite sentry: epoch {epoch} ended with {consec_end} "
+            f"consecutive bad steps — rolled back to the last good "
+            f"checkpoint (lr -> {lr:g})",
+        )
+        return restored
+
     timer = Timer("train_validate_test")
     timer.start()
     epochs_done = start_epoch
     try:
       for epoch in range(start_epoch, num_epoch):
+        hooks.epoch_start(epoch)
+        if hooks.preempted:
+            _preempt_exit(state, epoch)
         for loader in (train_loader, val_loader, test_loader):
             if hasattr(loader, "set_epoch"):
                 loader.set_epoch(epoch)
@@ -639,7 +804,24 @@ def train_validate_test(
                     verbosity,
                     profiler=profiler,
                     spans=spans,
+                    hooks=hooks,
                 )
+        if hooks.preempted:
+            # mid-epoch graceful stop: this epoch is incomplete, resume
+            # re-runs it (the meta pair written here says so)
+            _preempt_exit(state, epoch)
+        nonfinite = None
+        if sentry is not None:
+            skipped, consec_end = sentry.epoch_finalize()
+            if skipped:
+                from hydragnn_tpu.obs import get_registry
+
+                get_registry().counter("train.nonfinite_skipped").inc(skipped)
+                nonfinite = {"skipped": skipped, "consec_end": consec_end}
+            if sentry.needs_rollback(consec_end):
+                state = _sentry_rollback(state, epoch, consec_end)
+                epochs_done = epoch + 1
+                continue  # the rolled-back epoch consumed its slot
         if scan_eval_fn is not None:
             val_loss, val_tasks = evaluate_epoch_scan(val_loader, state, scan_eval_fn)
         else:
@@ -733,6 +915,7 @@ def train_validate_test(
             val_tasks=val_tasks.tolist(),
             step_time=step_time,
             compiles=compiles,
+            **({"nonfinite": nonfinite} if nonfinite else {}),
         )
         if span_snap is not None:
             from hydragnn_tpu.utils.tensorboard import write_scalar_dict
@@ -747,9 +930,20 @@ def train_validate_test(
         if ckpt_every and (epoch + 1) % ckpt_every == 0:
             _write_checkpoint(state, epoch + 1, early_stopped=False)
 
+        if hooks.preempted:
+            # SIGTERM landed during val/test/plots: this epoch is
+            # complete and recorded, resume continues from the next
+            _preempt_exit(state, epoch + 1)
+
         if stop:
             print_distributed(verbosity, f"Early stopping at epoch {epoch}")
             break
+    except TrainingPreempted:
+        # _preempt_exit already wrote the checkpoint, the flight
+        # events, and tore telemetry down — only the process-global
+        # timer still needs closing before the exception unwinds
+        timer.stop_if_running()
+        raise
     except BaseException as exc:
         # the registry timer is process-global: close its interval or
         # every later train_validate_test in this process raises
@@ -778,6 +972,7 @@ def train_validate_test(
         ):
             for _ in range(2):
                 for b in train_loader:
+                    hooks.beat()  # recalibration batches count as liveness
                     state = stats_step(state, b)
 
         # Final checkpoint+meta pair AFTER BN recalibration: the model file
@@ -832,5 +1027,6 @@ def train_validate_test(
     )
     if own_flight:
         flight.close()
+    hooks.teardown()
 
     return state, history

@@ -130,12 +130,89 @@ def _train_step_body(
     return step
 
 
+def _guarded_step_body(
+    model: HydraModel,
+    tx: optax.GradientTransformation,
+    compute_dtype=None,
+    remat: bool = False,
+):
+    """Non-finite-guarded training body (the device half of
+    ``hydragnn_tpu/resilience/sentry.py``): runs the normal step, then
+    a cheap on-device ``isfinite(loss) & isfinite(global_norm(grads))``
+    check decides whether the update LANDS. A bad batch leaves params,
+    optimizer state, BatchNorm statistics and the step counter at their
+    previous values — one fused ``where`` over the state, no host sync.
+
+    Signature: ``(state, batch, consec) -> (state, loss, tasks, consec,
+    bad)`` where ``consec`` is the consecutive-bad-step counter
+    (int32 device scalar, threaded by the caller across steps) and
+    ``bad`` is this step's flag as float32 (0.0/1.0) — reported loss
+    and task losses are zeroed on bad steps so the epoch's weighted
+    metrics (which also zero the batch's count) stay clean.
+    """
+
+    def step(state: TrainState, batch: GraphBatch, consec: jnp.ndarray):
+        rng, dropout_rng = jax.random.split(state.rng)
+
+        def loss_fn(params):
+            if compute_dtype is not None:
+                apply_params = _cast_floats(params, compute_dtype)
+                apply_batch = _cast_floats(batch, compute_dtype)
+            else:
+                apply_params, apply_batch = params, batch
+            outputs, mutated = model.apply(
+                {"params": apply_params, "batch_stats": state.batch_stats},
+                apply_batch,
+                train=True,
+                mutable=["batch_stats"],
+                rngs={"dropout": dropout_rng},
+            )
+            outputs = [o.astype(jnp.float32) for o in outputs]
+            total, tasks = model_loss(model.cfg, outputs, batch)
+            return total, (jnp.stack(tasks), mutated)
+
+        lf = jax.checkpoint(loss_fn) if remat else loss_fn
+        (loss, (tasks, mutated)), grads = jax.value_and_grad(lf, has_aux=True)(
+            state.params
+        )
+        bad = jnp.logical_not(
+            jnp.isfinite(loss) & jnp.isfinite(optax.global_norm(grads))
+        )
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+
+        def keep(new, old):
+            return jax.tree_util.tree_map(
+                lambda a, b: jnp.where(bad, b, a), new, old
+            )
+
+        new_state = state.replace(
+            step=state.step + jnp.where(bad, 0, 1).astype(state.step.dtype),
+            params=keep(params, state.params),
+            batch_stats=keep(mutated["batch_stats"], state.batch_stats),
+            opt_state=keep(opt_state, state.opt_state),
+            rng=rng,
+        )
+        badf = bad.astype(jnp.float32)
+        new_consec = jnp.where(bad, consec + 1, 0).astype(jnp.int32)
+        return (
+            new_state,
+            jnp.where(bad, 0.0, loss),
+            jnp.where(bad, jnp.zeros_like(tasks), tasks),
+            new_consec,
+            badf,
+        )
+
+    return step
+
+
 def make_train_step(
     model: HydraModel,
     tx: optax.GradientTransformation,
     compute_dtype=None,
     remat: bool = False,
-) -> Callable[[TrainState, GraphBatch], Tuple[TrainState, jnp.ndarray, jnp.ndarray]]:
+    guard_nonfinite: bool = False,
+) -> Callable[..., Tuple]:
     """Returns jitted ``(state, batch) -> (state, loss, tasks_loss)``.
 
     ``compute_dtype=jnp.bfloat16`` enables mixed precision: params and
@@ -147,11 +224,21 @@ def make_train_step(
     activations are recomputed during the backward pass instead of held in
     HBM — the standard FLOPs-for-memory trade for deep conv stacks or
     large padded graphs. No reference analog (torch would use
-    ``torch.utils.checkpoint``; the reference never does)."""
-    return jax.jit(
-        _train_step_body(model, tx, compute_dtype=compute_dtype, remat=remat),
-        donate_argnums=(0,),
+    ``torch.utils.checkpoint``; the reference never does).
+
+    ``guard_nonfinite=True`` (config ``Training.nonfinite_guard``)
+    returns the GUARDED step instead — signature ``(state, batch,
+    consec) -> (state, loss, tasks_loss, consec, bad)`` — which skips
+    any batch producing a non-finite loss or gradient norm (see
+    :func:`_guarded_step_body`; the host policy lives in
+    ``hydragnn_tpu/resilience/sentry.py``). With all-finite inputs it
+    computes exactly what the unguarded step computes."""
+    body = (
+        _guarded_step_body(model, tx, compute_dtype=compute_dtype, remat=remat)
+        if guard_nonfinite
+        else _train_step_body(model, tx, compute_dtype=compute_dtype, remat=remat)
     )
+    return jax.jit(body, donate_argnums=(0,))
 
 
 def make_scan_epoch(

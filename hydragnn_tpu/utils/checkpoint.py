@@ -50,16 +50,92 @@ def _orbax_dir(log_name: str, path: str) -> str:
     return os.path.abspath(os.path.join(path, log_name, f"{log_name}.orbax"))
 
 
+def _sha256_hex(data: bytes) -> str:
+    import hashlib
+
+    return hashlib.sha256(data).hexdigest()
+
+
+def _versioned_path(log_name: str, path: str, step: int) -> str:
+    return os.path.join(path, log_name, f"{log_name}.step{step:010d}.mp")
+
+
+def list_versioned_checkpoints(log_name: str, path: str = "./logs/"):
+    """Retained keep-last-K checkpoint versions, NEWEST first, as
+    ``[(step, path)]``."""
+    import glob
+    import re
+
+    out = []
+    pat = re.compile(re.escape(log_name) + r"\.step(\d+)\.mp$")
+    for p in glob.glob(os.path.join(path, log_name, f"{log_name}.step*.mp")):
+        m = pat.search(os.path.basename(p))
+        if m:
+            out.append((int(m.group(1)), p))
+    return sorted(out, reverse=True)
+
+
+def validate_checkpoint_file(ckpt_path: str) -> bool:
+    """Integrity check for one msgpack checkpoint file: the sha256
+    sidecar when present (bit-rot), else parse-validation (a truncated
+    msgpack stream — torn write, SIGKILL mid-checkpoint — fails to
+    restore). Missing file -> False."""
+    if not os.path.isfile(ckpt_path):
+        return False
+    try:
+        with open(ckpt_path, "rb") as f:
+            data = f.read()
+    except OSError:
+        return False
+    sidecar = ckpt_path + ".sha256"
+    if os.path.exists(sidecar):
+        try:
+            with open(sidecar) as f:
+                want = f.read().strip()
+            return _sha256_hex(data) == want
+        except OSError:
+            return False
+    try:
+        serialization.msgpack_restore(data)
+        return True
+    except Exception:
+        return False
+
+
+def _atomic_write(final_path: str, data: bytes) -> None:
+    tmp = final_path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+    os.replace(tmp, final_path)
+
+
+def _prune_versions(log_name: str, path: str, keep_last: int) -> None:
+    for _, p in list_versioned_checkpoints(log_name, path)[keep_last:]:
+        for victim in (p, p + ".sha256"):
+            try:
+                os.remove(victim)
+            except OSError:
+                pass
+
+
 def save_model(
     state: Any,
     log_name: str,
     path: str = "./logs/",
     verbosity: int = 0,
     backend: str = "auto",
+    keep_last: Optional[int] = None,
 ) -> str:
     """Write the TrainState under ``<path>/<log_name>/`` (reference:
     rank-0 save, model.py:41-54). ``backend``: "msgpack", "orbax", or
-    "auto" (orbax when multi-process — parallel sharded writes)."""
+    "auto" (orbax when multi-process — parallel sharded writes).
+
+    ``keep_last=K`` (msgpack backend; config
+    ``Training.checkpoint_keep_last``) additionally retains the K most
+    recent step-versioned copies (``<log_name>.step<N>.mp`` + sha256
+    sidecar, pruned beyond K). Restore validates integrity and falls
+    back down the retained set (:func:`load_existing_model`), so a
+    checkpoint torn by a crash mid-write never strands the run."""
     if backend == "auto":
         backend = "orbax" if jax.process_count() > 1 else "msgpack"
     if backend == "orbax":
@@ -73,32 +149,27 @@ def save_model(
     host_state = jax.tree_util.tree_map(_to_host, state)
     if jax.process_index() == 0:
         os.makedirs(os.path.dirname(ckpt_path), exist_ok=True)
+        data = serialization.to_bytes(host_state)
+        if keep_last:
+            step = int(np.asarray(host_state.step)) if hasattr(host_state, "step") else 0
+            vp = _versioned_path(log_name, path, step)
+            _atomic_write(vp, data)
+            _atomic_write((vp + ".sha256"), _sha256_hex(data).encode())
+            _prune_versions(log_name, path, int(keep_last))
+        # deterministic torn-write fault injection (docs/RESILIENCE.md):
+        # under HYDRAGNN_INJECT_KILL_CHECKPOINT the K-th save leaves the
+        # latest-pointer file truncated and SIGKILLs the process — the
+        # scenario the validation + versioned fallback above recovers
+        from hydragnn_tpu.resilience.inject import maybe_kill_checkpoint
+
+        maybe_kill_checkpoint(ckpt_path, data)
         # atomic replace: a crash mid-write (the exact scenario per-epoch
         # checkpointing exists for) must not destroy the previous good file
-        tmp = ckpt_path + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(serialization.to_bytes(host_state))
-        os.replace(tmp, ckpt_path)
+        _atomic_write(ckpt_path, data)
     return ckpt_path
 
 
-def load_existing_model(
-    state: Any, log_name: str, path: str = "./logs/"
-) -> Any:
-    """Restore a TrainState from the run's checkpoint. ``state`` is the
-    freshly-constructed target (its pytree structure = the schema; with
-    sharded leaves, orbax restores shards onto their shardings directly).
-    The backend that wrote the run is auto-detected."""
-    orbax_dir = _orbax_dir(log_name, path)
-    if os.path.isdir(orbax_dir):
-        import orbax.checkpoint as ocp
-
-        with ocp.StandardCheckpointer() as ckptr:
-            target = jax.tree_util.tree_map(ocp.utils.to_shape_dtype_struct, state)
-            return ckptr.restore(orbax_dir, target)
-    ckpt_path = _checkpoint_path(log_name, path)
-    with open(ckpt_path, "rb") as f:
-        data = f.read()
+def _restore_bytes_into(state: Any, data: bytes) -> Any:
     restored = serialization.from_bytes(state, data)
 
     # preserve the target's placement: leaves restored as host arrays go
@@ -110,6 +181,63 @@ def load_existing_model(
         return val
 
     return jax.tree_util.tree_map(_place, state, restored)
+
+
+def load_existing_model(
+    state: Any, log_name: str, path: str = "./logs/"
+) -> Any:
+    """Restore a TrainState from the run's checkpoint. ``state`` is the
+    freshly-constructed target (its pytree structure = the schema; with
+    sharded leaves, orbax restores shards onto their shardings directly).
+    The backend that wrote the run is auto-detected.
+
+    msgpack restores validate integrity first and FALL BACK down the
+    retained version set (``save_model(keep_last=...)``): the latest
+    pointer file is preferred; if it is truncated/corrupt (torn write —
+    e.g. SIGKILL mid-checkpoint), the newest valid ``.step<N>.mp``
+    version is restored instead, with a loud warning naming what was
+    rejected. Only when every candidate fails does the restore raise."""
+    orbax_dir = _orbax_dir(log_name, path)
+    if os.path.isdir(orbax_dir):
+        import orbax.checkpoint as ocp
+
+        with ocp.StandardCheckpointer() as ckptr:
+            target = jax.tree_util.tree_map(ocp.utils.to_shape_dtype_struct, state)
+            return ckptr.restore(orbax_dir, target)
+    ckpt_path = _checkpoint_path(log_name, path)
+    versioned = [p for _, p in list_versioned_checkpoints(log_name, path)]
+    if not versioned:
+        # no retained versions: the historical single-file path, raising
+        # naturally (FileNotFoundError / parse error) on a bad file
+        with open(ckpt_path, "rb") as f:
+            return _restore_bytes_into(state, f.read())
+    rejected = []
+    candidates = [ckpt_path] + [p for p in versioned if p != ckpt_path]
+    for p in candidates:
+        if not validate_checkpoint_file(p):
+            rejected.append(p)
+            continue
+        with open(p, "rb") as f:
+            data = f.read()
+        try:
+            restored = _restore_bytes_into(state, data)
+        except Exception:
+            rejected.append(p)
+            continue
+        if rejected:
+            import warnings
+
+            warnings.warn(
+                f"checkpoint integrity: rejected {rejected} (truncated/"
+                f"corrupt); restored the previous valid checkpoint {p}",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+        return restored
+    raise ValueError(
+        f"no valid checkpoint for run {log_name!r} under {path!r}: "
+        f"all candidates failed integrity validation: {rejected}"
+    )
 
 
 def save_train_meta(meta: dict, log_name: str, path: str = "./logs/") -> None:
@@ -153,6 +281,8 @@ def load_existing_model_config(
 
 
 def checkpoint_exists(log_name: str, path: str = "./logs/") -> bool:
-    return os.path.exists(_checkpoint_path(log_name, path)) or os.path.isdir(
-        _orbax_dir(log_name, path)
+    return (
+        os.path.exists(_checkpoint_path(log_name, path))
+        or os.path.isdir(_orbax_dir(log_name, path))
+        or bool(list_versioned_checkpoints(log_name, path))
     )

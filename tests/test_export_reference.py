@@ -1,0 +1,128 @@
+"""tools/export_to_reference_pickle.py: the HGC -> reference
+sharded-pickle exporter must round-trip through the importer
+(data/import_reference.py) — the committed proof of the two-way
+migration story (docs/MIGRATION.md)."""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir, "tools"))
+
+from export_to_reference_pickle import (  # noqa: E402
+    export_container,
+    export_samples_to_pickles,
+    sample_to_reference_dict,
+)
+
+from hydragnn_tpu.data.container import ContainerDataset, ContainerWriter
+from hydragnn_tpu.data.dataset import GraphSample
+from hydragnn_tpu.data.import_reference import (
+    ReferencePickleReader,
+    import_pickle_dataset,
+)
+
+
+def _samples(n=6, seed=0):
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        nn = 4 + i % 3
+        src = np.arange(nn - 1, dtype=np.int64)
+        ei = np.stack(
+            [np.concatenate([src, src + 1]), np.concatenate([src + 1, src])]
+        )
+        out.append(
+            GraphSample(
+                x=rng.normal(size=(nn, 3)).astype(np.float32),
+                pos=rng.normal(size=(nn, 3)).astype(np.float32),
+                edge_index=ei.astype(np.int32),
+                edge_attr=np.ones((ei.shape[1], 2), np.float32) * i,
+                graph_targets={"energy": np.asarray([float(i)], np.float32)},
+                node_targets={"charge": rng.normal(size=(nn, 2)).astype(np.float32)},
+            )
+        )
+    return out
+
+
+def _assert_sample_equal(a: GraphSample, b: GraphSample):
+    np.testing.assert_allclose(a.x, b.x)
+    np.testing.assert_allclose(a.pos, b.pos)
+    np.testing.assert_array_equal(a.edge_index, b.edge_index)
+    np.testing.assert_allclose(a.edge_attr, b.edge_attr)
+    assert sorted(a.graph_targets) == sorted(b.graph_targets)
+    for k in a.graph_targets:
+        np.testing.assert_allclose(
+            np.asarray(a.graph_targets[k]).reshape(-1),
+            np.asarray(b.graph_targets[k]).reshape(-1),
+        )
+    assert sorted(a.node_targets) == sorted(b.node_targets)
+    for k in a.node_targets:
+        np.testing.assert_allclose(a.node_targets[k], b.node_targets[k])
+
+
+def pytest_packed_y_layout_matches_reference_contract():
+    s = _samples(1)[0]
+    d = sample_to_reference_dict(s)
+    # graph heads first (sorted), then node heads: y_loc marks the rows
+    assert d["y_loc"].tolist() == [0, 1, 1 + s.x.shape[0] * 2]
+    np.testing.assert_allclose(d["y"][:1], s.graph_targets["energy"])
+    np.testing.assert_allclose(
+        d["y"][1:].reshape(s.x.shape[0], 2), s.node_targets["charge"]
+    )
+    assert d["edge_index"].shape[0] == 2
+
+
+def pytest_container_export_import_round_trip(tmp_path):
+    samples = _samples()
+    src = str(tmp_path / "src.hgc")
+    w = ContainerWriter(src)
+    w.add(samples)
+    w.add_global("minmax_node_feature", [[0.0, 1.0]])
+    w.add_global("minmax_graph_feature", [[0.0, 2.0]])
+    w.save()
+
+    outdir = str(tmp_path / "pickles")
+    n, names, types = export_container(src, outdir, "trainset")
+    assert n == len(samples)
+    assert names == ["energy", "charge"] and types == ["graph", "node"]
+
+    # the reference reader sees the layout it expects
+    reader = ReferencePickleReader(outdir, "trainset")
+    assert len(reader) == len(samples)
+    np.testing.assert_allclose(
+        np.asarray(reader.minmax_graph_feature), [[0.0, 2.0]]
+    )
+
+    # full round trip back through the importer into a second container
+    back = str(tmp_path / "back.hgc")
+    count = import_pickle_dataset(
+        outdir, "trainset", back, head_types=types, head_names=names
+    )
+    assert count == len(samples)
+    ds = ContainerDataset(back)
+    try:
+        assert len(ds) == len(samples)
+        for i, s in enumerate(samples):
+            _assert_sample_equal(s, ds.get(i))
+        mm_g, mm_n = ds.minmax()
+        np.testing.assert_allclose(mm_g, [[0.0, 2.0]])
+        np.testing.assert_allclose(mm_n, [[0.0, 1.0]])
+    finally:
+        ds.close()
+
+
+def pytest_subdir_layout_round_trips(tmp_path):
+    samples = _samples(5, seed=1)
+    outdir = str(tmp_path / "pickles")
+    n, names, types = export_samples_to_pickles(
+        samples, outdir, "total", nmax_persubdir=2
+    )
+    assert n == 5
+    assert os.path.isdir(os.path.join(outdir, "0"))  # samples 0-1
+    assert os.path.isdir(os.path.join(outdir, "2"))  # sample 4
+    reader = ReferencePickleReader(outdir, "total")
+    got = reader.samples(head_types=types, head_names=names)
+    for s, g in zip(samples, got):
+        _assert_sample_equal(s, g)

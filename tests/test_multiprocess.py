@@ -213,6 +213,178 @@ print(f"rank {rank}: TRAIN-OK rmse={rmse:.4f} mae={mae:.4f} rep-step={float(loss
 """
 
 
+_COMPOSED_WORKER = r"""
+import os, sys
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+
+import numpy as np
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+rank = int(sys.argv[1])
+nproc = int(sys.argv[2])
+port = sys.argv[3]
+workdir = sys.argv[4]
+repo = sys.argv[5]
+
+jax.distributed.initialize(
+    coordinator_address=f"127.0.0.1:{port}", num_processes=nproc, process_id=rank
+)
+assert jax.local_device_count() == 4
+assert len(jax.devices()) == 4 * nproc
+
+sys.path.insert(0, repo)
+sys.path.insert(0, os.path.join(repo, "tests"))
+import dataclasses
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from hydragnn_tpu.data.synthetic import deterministic_graph_data
+from hydragnn_tpu.data.ingest import prepare_dataset
+from hydragnn_tpu.data.loader import GraphLoader
+from hydragnn_tpu.models.create import create_model_config
+from hydragnn_tpu.parallel.edge_sharded import make_dp_edge_train_step
+from hydragnn_tpu.parallel.sharded import place_state
+from hydragnn_tpu.train import create_train_state, select_optimizer
+from hydragnn_tpu.utils.config import update_config
+from test_data_pipeline import base_config
+
+d_data, d_edge = nproc, 4  # one data row per process, its 4 devices as edge axis
+
+cfg = base_config(multihead=False)
+cfg["NeuralNetwork"]["Architecture"]["model_type"] = "GIN"
+cfg["NeuralNetwork"]["Training"]["batch_size"] = 8
+samples = deterministic_graph_data(number_configurations=32, seed=5)
+train, _, _, _, _ = prepare_dataset(samples, cfg)
+cfg = update_config(cfg, train, train, train)
+# every process builds the SAME full stacked batches (no sharding), then
+# contributes its data row to the global mesh
+loader = GraphLoader(
+    train, 8, shuffle=False,
+    device_stack=d_data if d_data > 1 else 1, edge_multiple=d_edge * 2,
+)
+
+def stack_one(batch):
+    # nproc=1 sanity mode: the loader emits no device axis at
+    # device_stack=1; the composed step still wants [D_data=1, ...]
+    if d_data > 1:
+        return batch
+    return jax.tree_util.tree_map(lambda x: np.asarray(x)[None], batch)
+
+example_one = jax.tree_util.tree_map(
+    lambda x: x[0], stack_one(next(iter(loader)))
+)
+model, variables = create_model_config(cfg["NeuralNetwork"], example_one)
+tx = select_optimizer({"Optimizer": {"type": "SGD", "learning_rate": 0.05}})
+
+# single-process reference: the SAME composed step on a local mesh
+# over this process's devices — identical math, no collectives
+mesh_local = Mesh(
+    np.array(jax.local_devices()[:4]).reshape(d_data, 4 // d_data),
+    ("data", "edge"),
+)
+state_ref = place_state(mesh_local, create_train_state(variables, tx, seed=0))
+step_ref = make_dp_edge_train_step(model, tx, mesh_local)
+
+# composed global mesh: jax.devices() orders by (process, id), so
+# reshape(nproc, 4) puts process p's devices in data row p
+mesh_g = Mesh(np.array(jax.devices()).reshape(d_data, d_edge), ("data", "edge"))
+state_g = place_state(mesh_g, create_train_state(variables, tx, seed=0))
+step_g = make_dp_edge_train_step(model, tx, mesh_g)
+
+EDGE_FIELDS = {"senders", "receivers", "edge_mask", "edge_attr", "sender_perm"}
+
+def globalize_dp_edge(batch):
+    # each process feeds its OWN data row (full edge axis — the edge
+    # shards of a row are all local to its process)
+    vals = {}
+    for f in dataclasses.fields(batch):
+        v = getattr(batch, f.name)
+        if f.metadata.get("static"):
+            vals[f.name] = v
+            continue
+        spec = P("data", "edge") if f.name in EDGE_FIELDS else P("data")
+        sh = NamedSharding(mesh_g, spec)
+        vals[f.name] = jax.tree_util.tree_map(
+            lambda x: jax.make_array_from_process_local_data(
+                sh, np.asarray(x)[rank : rank + 1]
+            ),
+            v,
+        )
+    return type(batch)(**vals)
+
+from hydragnn_tpu.parallel.edge_sharded import place_dp_edge_batch
+
+losses = []
+for batch in loader:
+    batch = stack_one(batch)
+    placed_ref = place_dp_edge_batch(mesh_local, batch)
+    state_ref, loss_ref, _ = step_ref(state_ref, placed_ref)
+    placed_g = globalize_dp_edge(batch)
+    assert placed_g.senders.sharding.spec == P("data", "edge")
+    state_g, loss_g, _ = step_g(state_g, placed_g)
+    la, lb = float(loss_ref), float(loss_g)
+    losses.append((la, lb))
+    np.testing.assert_allclose(la, lb, rtol=1e-4)
+
+# final params: replicated across the global mesh, equal to the local
+# reference on every process
+for a, b in zip(
+    jax.tree_util.tree_leaves(jax.device_get(state_ref.params)),
+    jax.tree_util.tree_leaves(jax.device_get(state_g.params)),
+):
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6)
+print(f"rank {rank}: COMPOSED-OK losses={losses}")
+"""
+
+
+@requires_cpu_collectives
+def pytest_two_process_composed_data_edge_mesh(tmp_path):
+    """2-process composed (data x edge) mesh train step: each process
+    owns one data row of a global (2, 4) mesh whose edge axis shards
+    over its 4 local devices — the multi-process analog of the
+    single-process composed coverage in ``dryrun_multichip`` and
+    ``test_edge_sharded.pytest_dp_edge_composed_matches_data_parallel``.
+    Losses and updated params must match a single-process composed
+    reference on every rank."""
+    port = _free_port()
+    script = tmp_path / "composed_worker.py"
+    script.write_text(_COMPOSED_WORKER)
+    nproc = 2
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    procs = [
+        subprocess.Popen(
+            [
+                sys.executable, str(script), str(r), str(nproc), str(port),
+                str(tmp_path), _REPO,
+            ],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for r in range(nproc)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            try:
+                out, _ = p.communicate(timeout=600)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                out, _ = p.communicate()
+            outs.append(out)
+    finally:
+        for p in procs:  # never orphan a hung peer rank
+            if p.poll() is None:
+                p.kill()
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {r} failed:\n{out}"
+        assert f"rank {r}: COMPOSED-OK" in out
+
+
 @requires_cpu_collectives
 def pytest_two_process_train_e2e(tmp_path):
     """True multi-host training: 2 OS processes × 2 CPU devices each, one

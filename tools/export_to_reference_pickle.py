@@ -1,0 +1,197 @@
+"""Export an HGC container to the reference sharded-pickle layout —
+the INVERSE of ``hydragnn_tpu/data/import_reference.py``, completing
+docs/MIGRATION.md's two-way story (native runs can hand datasets back
+to reference deployments, and round-trip conversions are testable).
+
+    python tools/export_to_reference_pickle.py data.hgc outdir [label]
+
+Layout written (reference: hydragnn/utils/pickledataset.py
+SimplePickleWriter):
+
+    <outdir>/<label>-meta.pkl   5 sequential pickles: minmax_node_feature,
+                                minmax_graph_feature, ntotal, use_subdir,
+                                nmax_persubdir
+    <outdir>/<label>-<k>.pkl    one pickle per sample (under
+                                ``<k // nmax_persubdir>/`` subdirs when
+                                --subdir-max is set)
+
+Each sample pickle is a plain ``{field: numpy array}`` dict carrying
+the reference field names (``x``, ``pos``, ``edge_index`` [2, e],
+``edge_attr``, and the packed ``y`` + ``y_loc`` head layout written by
+the reference's update_predicted_values — graph heads flat, node heads
+num_nodes x dim row-major). The importer's tolerant unpickler consumes
+dicts and torch ``Data`` objects identically (``_tensor_mapping``), so
+``import_reference`` round-trips this layout without torch installed;
+a reference-side consumer reads it with ``pickle.load`` + attribute
+assembly (no foreign classes are pickled, by design — nothing to
+import at load time).
+
+Head packing order is deterministic — graph targets sorted by name,
+then node targets sorted by name — and the CLI prints the
+``--head-type``/``--head-name`` flags that re-import the container
+unambiguously (``y``/``y_loc`` alone cannot distinguish a node head
+from a graph head whose dim divides num_nodes).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import sys
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_REPO = __file__.rsplit("/", 2)[0]
+if _REPO not in sys.path:  # runnable as `python tools/export_to_reference_pickle.py`
+    sys.path.insert(0, _REPO)
+
+from hydragnn_tpu.data.dataset import GraphSample  # noqa: E402
+
+
+def head_order(sample: GraphSample) -> Tuple[List[str], List[str]]:
+    """Deterministic packed-head order for one sample: (names, types),
+    graph targets first then node targets, each sorted by name."""
+    names: List[str] = []
+    types: List[str] = []
+    for name in sorted(sample.graph_targets):
+        names.append(name)
+        types.append("graph")
+    for name in sorted(sample.node_targets):
+        names.append(name)
+        types.append("node")
+    return names, types
+
+
+def sample_to_reference_dict(sample: GraphSample) -> dict:
+    """GraphSample -> reference-layout field dict (the inverse of
+    ``import_reference.data_object_to_sample``)."""
+    out = {"x": np.asarray(sample.x, dtype=np.float32)}
+    if sample.pos is not None:
+        out["pos"] = np.asarray(sample.pos, dtype=np.float32)
+    if sample.edge_index is not None:
+        out["edge_index"] = np.asarray(sample.edge_index, dtype=np.int64)
+    if sample.edge_attr is not None:
+        out["edge_attr"] = np.asarray(sample.edge_attr, dtype=np.float32)
+    names, types = head_order(sample)
+    if names:
+        segs = []
+        for name, htype in zip(names, types):
+            v = (
+                sample.graph_targets[name]
+                if htype == "graph"
+                else sample.node_targets[name]
+            )
+            # node heads: [num_nodes, dim] row-major flatten — the
+            # update_predicted_values packing the importer unpacks
+            segs.append(np.asarray(v, dtype=np.float32).reshape(-1))
+        out["y"] = np.concatenate(segs) if segs else np.zeros(0, np.float32)
+        out["y_loc"] = np.concatenate(
+            [[0], np.cumsum([s.shape[0] for s in segs])]
+        ).astype(np.int64)
+    elif sample.graph_y is not None:
+        out["y"] = np.asarray(sample.graph_y, dtype=np.float32).reshape(-1)
+    return out
+
+
+def export_samples_to_pickles(
+    samples: Sequence[GraphSample],
+    outdir: str,
+    label: str = "total",
+    minmax_node_feature=None,
+    minmax_graph_feature=None,
+    nmax_persubdir: int = 0,
+) -> Tuple[int, List[str], List[str]]:
+    """Write the sharded-pickle layout; returns
+    (n_samples, head_names, head_types) — the import flags that make
+    the round trip unambiguous. Heads must be homogeneous across
+    samples (they are, for any prepared dataset)."""
+    os.makedirs(outdir, exist_ok=True)
+    use_subdir = bool(nmax_persubdir and nmax_persubdir > 0)
+    names, types = head_order(samples[0]) if len(samples) else ([], [])
+    for s in samples:
+        if head_order(s) != (names, types):
+            raise ValueError(
+                "samples carry heterogeneous target heads; the packed "
+                "y/y_loc layout requires one schema for the whole set"
+            )
+    meta_path = os.path.join(outdir, f"{label}-meta.pkl")
+    with open(meta_path, "wb") as f:
+        for obj in (
+            None if minmax_node_feature is None else np.asarray(minmax_node_feature),
+            None if minmax_graph_feature is None else np.asarray(minmax_graph_feature),
+            int(len(samples)),
+            use_subdir,
+            int(nmax_persubdir) if use_subdir else 0,
+        ):
+            pickle.dump(obj, f)
+    for k, s in enumerate(samples):
+        d = outdir
+        if use_subdir:
+            d = os.path.join(outdir, str(k // nmax_persubdir))
+            os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, f"{label}-{k}.pkl"), "wb") as f:
+            pickle.dump(sample_to_reference_dict(s), f)
+    return len(samples), names, types
+
+
+def export_container(
+    container_path: str,
+    outdir: str,
+    label: str = "total",
+    nmax_persubdir: int = 0,
+) -> Tuple[int, List[str], List[str]]:
+    """HGC container -> sharded-pickle layout (minmax globals ride
+    along into the meta pickle, as the importer expects)."""
+    from hydragnn_tpu.data.container import ContainerDataset
+
+    ds = ContainerDataset(container_path)
+    try:
+        mm_graph, mm_node = ds.minmax()
+        return export_samples_to_pickles(
+            ds.samples(),
+            outdir,
+            label,
+            minmax_node_feature=mm_node,
+            minmax_graph_feature=mm_graph,
+            nmax_persubdir=nmax_persubdir,
+        )
+    finally:
+        ds.close()
+
+
+def main(argv: Optional[Sequence[str]] = None) -> None:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        description="Export an HGC container to the reference "
+        "sharded-pickle layout (inverse of data/import_reference.py)."
+    )
+    p.add_argument("container", help="input .hgc container path")
+    p.add_argument("outdir", help="output directory for the pickle set")
+    p.add_argument("label", nargs="?", default="total", help="dataset label")
+    p.add_argument(
+        "--subdir-max",
+        type=int,
+        default=0,
+        help="write at most N sample pickles per numbered subdirectory "
+        "(the reference's use_subdir mode; 0 = flat layout)",
+    )
+    args = p.parse_args(argv)
+    n, names, types = export_container(
+        args.container, args.outdir, args.label, args.subdir_max
+    )
+    flags = " ".join(
+        f"--head-type {t} --head-name {nm}" for nm, t in zip(names, types)
+    )
+    print(f"exported {n} samples -> {args.outdir} (label {args.label!r})")
+    if flags:
+        print(
+            "re-import unambiguously with:\n"
+            f"  python -m hydragnn_tpu.data.import_reference {args.outdir} "
+            f"{args.label} out.hgc {flags}"
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -25,6 +25,7 @@ if _REPO not in sys.path:  # runnable as `python tools/obs_report.py`
     sys.path.insert(0, _REPO)
 
 from hydragnn_tpu.obs.flight import (  # noqa: E402
+    FAULT_KINDS,
     read_flight_record,
     validate_flight_record,
 )
@@ -137,6 +138,95 @@ def render_report(events: List[dict]) -> str:
     return "\n".join(lines)
 
 
+def render_faults(events: List[dict]) -> str:
+    """A run's fault history: chronological preemption / rollback /
+    watchdog / restart / retry / error timeline plus non-completed
+    run_end statuses — the view a supervisor post-mortem starts from.
+    Handles MERGED records (several run_start..run_end segments in one
+    file, the append-mode artifact of a supervised run)."""
+    t0 = events[0].get("t") if events and isinstance(events[0].get("t"), (int, float)) else None
+
+    def _rel(e) -> str:
+        t = e.get("t")
+        if t0 is None or not isinstance(t, (int, float)):
+            return "     ?"
+        return f"{t - t0:+9.2f}s"
+
+    interesting = [
+        e
+        for e in events
+        if e.get("kind") in FAULT_KINDS
+        or e.get("kind") == "_unparseable"
+        or (e.get("kind") == "run_end" and e.get("status") != "completed")
+    ]
+    counts = {
+        "runs": sum(1 for e in events if e.get("kind") == "run_start"),
+        "completed": sum(
+            1
+            for e in events
+            if e.get("kind") == "run_end" and e.get("status") == "completed"
+        ),
+        "preempted": sum(
+            1
+            for e in events
+            if e.get("kind") == "run_end" and e.get("status") == "preempted"
+        ),
+        "resumed": sum(1 for e in events if e.get("kind") == "resumed"),
+        "rollbacks": sum(1 for e in events if e.get("kind") == "rollback"),
+        "watchdog": sum(1 for e in events if e.get("kind") == "watchdog"),
+        "restarts": sum(1 for e in events if e.get("kind") == "restart"),
+        "errors": sum(1 for e in events if e.get("kind") == "error"),
+        "nonfinite_skipped": sum(
+            (e.get("nonfinite") or {}).get("skipped", 0)
+            for e in events
+            if e.get("kind") == "epoch"
+        ),
+    }
+    lines = ["== fault summary =="]
+    lines.append("  " + " ".join(f"{k}={v}" for k, v in counts.items()))
+    if not interesting:
+        lines.append("  (no fault events — a clean run)")
+        return "\n".join(lines)
+    lines.append("== fault timeline (t relative to first event) ==")
+    for e in interesting:
+        kind = e.get("kind")
+        if kind == "preempt":
+            detail = f"signal={e.get('signal')} epoch={e.get('epoch')} step={e.get('step')}"
+        elif kind == "resumed":
+            detail = f"epoch={e.get('epoch')}"
+        elif kind == "rollback":
+            detail = (
+                f"epoch={e.get('epoch')} consec={e.get('consec')} "
+                f"rollbacks={e.get('rollbacks')} lr={_fmt(e.get('lr'))}"
+            )
+        elif kind == "watchdog":
+            stacks = e.get("stacks") or {}
+            detail = f"stall_s={e.get('stall_s')} threads={sorted(stacks)}"
+        elif kind == "restart":
+            detail = (
+                f"attempt={e.get('attempt')} cause={e.get('cause')} "
+                f"exit_code={e.get('exit_code')} delay_s={e.get('delay_s')}"
+            )
+        elif kind == "run_end":
+            detail = f"status={e.get('status')}"
+        else:
+            detail = str(e.get("error") or e.get("line") or "")[:160]
+        lines.append(f"  {_rel(e)} [{kind}] {detail}")
+    return "\n".join(lines)
+
+
+def fault_schema_problems(events: List[dict]) -> List[str]:
+    """Schema problems affecting the fault-history subset (what
+    ``--faults`` gates on: a fault event that cannot be parsed is
+    evidence lost exactly when it matters)."""
+    watched = set(FAULT_KINDS) | {"run_end"}
+    out = []
+    for p in validate_flight_record(events):
+        if "unparseable" in p or any(f"({k})" in p for k in watched):
+            out.append(p)
+    return out
+
+
 def render_diff(a_events: List[dict], b_events: List[dict]) -> str:
     """What changed between two runs: manifest drift + per-epoch and
     summary deltas."""
@@ -210,7 +300,27 @@ def main(argv=None) -> int:
         action="store_true",
         help="diff exactly two records (A B)",
     )
+    p.add_argument(
+        "--faults",
+        action="store_true",
+        help="fault-history view: preemption / rollback / watchdog / "
+        "restart timeline (handles merged multi-run records); exits 1 "
+        "when any fault event fails its schema",
+    )
     args = p.parse_args(argv)
+
+    if args.faults:
+        rc = 0
+        for path in args.records:
+            events = read_flight_record(path)
+            if len(args.records) > 1:
+                print(f"===== {path} =====")
+            print(render_faults(events))
+            problems = fault_schema_problems(events)
+            for prob in problems:
+                rc = 1
+                print(f"  SCHEMA: {prob}")
+        return rc
 
     if args.diff:
         if len(args.records) != 2:

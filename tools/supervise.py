@@ -1,0 +1,106 @@
+"""Bounded restart supervisor CLI — keep a training command alive
+through preemptions and crashes, without looping on a run that can
+never succeed (hydragnn_tpu/resilience/supervisor.py,
+docs/RESILIENCE.md):
+
+    python tools/supervise.py [options] -- python my_train_driver.py ...
+
+The child should wrap its ``run_training`` call in
+``hydragnn_tpu.resilience.run_guard()`` so its exits follow the code
+contract the supervisor classifies:
+
+    0   completed            done
+    75  preempted            restart promptly (HYDRAGNN_AUTO_RESUME=1)
+    76  rollback exhausted   FAIL FAST (deterministic non-finite run)
+    78  config error         FAIL FAST
+    79  hung (watchdog)      retry with backoff
+    *   crash / signal       retry with exponential backoff
+
+Restarted children get ``HYDRAGNN_AUTO_RESUME=1`` and (by default) the
+``HYDRAGNN_INJECT_*`` fault-injection vars stripped. ``--flight`` writes
+the supervisor's own flight record (one ``restart`` event per
+re-invocation + a terminal ``run_end``) next to the run's.
+
+The supervisor's own exit code is the FINAL child exit code (0 when the
+run completed), so wrapping scripts compose.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+_REPO = __file__.rsplit("/", 2)[0]
+if _REPO not in sys.path:  # runnable as `python tools/supervise.py`
+    sys.path.insert(0, _REPO)
+
+from hydragnn_tpu.obs.flight import FlightRecorder  # noqa: E402
+from hydragnn_tpu.resilience.supervisor import (  # noqa: E402
+    Supervisor,
+    SupervisorPolicy,
+)
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "--" not in argv:
+        print("usage: supervise.py [options] -- <command> [args...]", file=sys.stderr)
+        return 2
+    split = argv.index("--")
+    opts, child = argv[:split], argv[split + 1 :]
+    if not child:
+        print("supervise.py: empty child command", file=sys.stderr)
+        return 2
+
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--max-restarts", type=int, default=5)
+    p.add_argument("--max-preemptions", type=int, default=1000)
+    p.add_argument("--backoff-base", type=float, default=1.0)
+    p.add_argument("--backoff-factor", type=float, default=2.0)
+    p.add_argument("--backoff-max", type=float, default=60.0)
+    p.add_argument(
+        "--no-auto-resume",
+        action="store_true",
+        help="do not set HYDRAGNN_AUTO_RESUME=1 for restarted children",
+    )
+    p.add_argument(
+        "--keep-injection",
+        action="store_true",
+        help="keep HYDRAGNN_INJECT_* env vars across restarts (default: "
+        "stripped so an injected fault fires exactly once)",
+    )
+    p.add_argument(
+        "--flight",
+        default=None,
+        help="write the supervisor's flight record (restart events + "
+        "final summary) to this JSONL path",
+    )
+    args = p.parse_args(opts)
+
+    policy = SupervisorPolicy(
+        max_restarts=args.max_restarts,
+        max_preemptions=args.max_preemptions,
+        backoff_base_s=args.backoff_base,
+        backoff_factor=args.backoff_factor,
+        backoff_max_s=args.backoff_max,
+        auto_resume=not args.no_auto_resume,
+        strip_injection=not args.keep_injection,
+    )
+    flight = FlightRecorder(args.flight, enabled=args.flight is not None)
+    flight.start_run({"supervisor": True, "argv": child, "policy": vars(args)})
+    sup = Supervisor(child, policy=policy, env=dict(os.environ), flight=flight)
+    result = sup.run()
+    flight.close()
+    print(
+        "supervise.py: "
+        + json.dumps({k: v for k, v in result.items() if k != "history"}),
+        file=sys.stderr,
+    )
+    return int(result["exit_code"]) if result["status"] != "completed" else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
