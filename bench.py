@@ -43,36 +43,14 @@ import sys
 import time
 
 
-# bf16 MXU peak per chip, by device_kind substring (public specs).
-_PEAK_BF16_TFLOPS = [
-    ("v5 lite", 197.0),
-    ("v5e", 197.0),
-    ("v5p", 459.0),
-    ("v4", 275.0),
-    ("v6", 918.0),
-    ("trillium", 918.0),
-]
-
-
-def _peak_flops(device) -> float | None:
-    kind = getattr(device, "device_kind", "").lower()
-    for sub, tf in _PEAK_BF16_TFLOPS:
-        if sub in kind:
-            return tf * 1e12
-    return None
-
-
-def _cost_analysis(compiled):
-    """(flops, bytes) per execution from XLA's cost model, or Nones."""
-    try:
-        c = compiled.cost_analysis()
-        if isinstance(c, (list, tuple)):
-            c = c[0]
-        flops = float(c.get("flops", 0.0)) or None
-        nbytes = float(c.get("bytes accessed", 0.0)) or None
-        return flops, nbytes
-    except Exception:
-        return None, None
+# The chip-peak table and the XLA cost-model reader now live in
+# hydragnn_tpu/obs/introspect.py: the training loop's per-run
+# hardware-efficiency ledger and this bench must price FLOPs/MFU from
+# the SAME source or their numbers silently diverge.
+from hydragnn_tpu.obs.introspect import (  # noqa: E402
+    cost_analysis as _cost_analysis,
+    peak_flops as _peak_flops,
+)
 
 
 def _measure_dispatch_ms() -> float:

@@ -66,7 +66,7 @@ python tools/chip_hygiene.py || true
 echo "== [3/7] serial suite (virtual 8-device CPU mesh, incl. 2-process pass) =="
 python -m pytest tests/ -q
 
-echo "== [4/7] telemetry smoke (tiny training -> schema-valid flight record) =="
+echo "== [4/7] telemetry smoke (tiny 2-head training -> schema-valid v2 flight record with head diagnostics + MFU ledger) =="
 SMOKE_DIR="$(mktemp -d)"
 JAX_PLATFORMS=cpu python - "$SMOKE_DIR" <<'EOF'
 import sys
@@ -75,7 +75,15 @@ from hydragnn_tpu.api import run_training
 from hydragnn_tpu.data.synthetic import deterministic_graph_data
 from hydragnn_tpu.flagship import flagship_config
 
+# trimmed to TWO heads (graph energy + one node head): the introspection
+# smoke must exercise a genuinely multi-head record without the full
+# flagship's 4-head cost
 cfg = flagship_config(hidden_dim=8, num_conv_layers=2, batch_size=5, num_epoch=2)
+voi = cfg["NeuralNetwork"]["Variables_of_interest"]
+voi["output_names"] = ["sum_x_x2_x3", "x"]
+voi["output_index"] = [0, 0]
+voi["type"] = ["graph", "node"]
+cfg["NeuralNetwork"]["Architecture"]["task_weights"] = [1.0, 1.0]
 samples = deterministic_graph_data(
     number_configurations=20,
     unit_cell_x_range=(2, 3),
@@ -88,6 +96,34 @@ EOF
 FLIGHT="$(ls "$SMOKE_DIR"/logs/*/flight.jsonl)"
 python tools/obs_report.py --validate --require-complete "$FLIGHT"
 python tools/obs_report.py "$FLIGHT"
+# the --heads view must render the diagnosis non-empty
+HEADS_OUT="$(python tools/obs_report.py --heads "$FLIGHT")"
+echo "$HEADS_OUT"
+echo "$HEADS_OUT" | grep -q "task-conflict matrix" || {
+    echo "FAIL: --heads view did not render the conflict matrix"; exit 1; }
+python - "$FLIGHT" <<'EOF'
+import sys
+
+from hydragnn_tpu.obs.flight import read_flight_record
+
+ev = read_flight_record(sys.argv[1])
+eps = [e for e in ev if e.get("kind") == "epoch"]
+assert eps and all(e.get("v") == 2 for e in eps), "epoch events must be schema v2"
+names = ["sum_x_x2_x3", "x"]
+for e in eps:
+    heads, hw = e["heads"], e["hw"]
+    assert heads["available"] and sorted(heads["grad_norm"]) == sorted(names)
+    assert len(heads["cosine"]) == 2 and len(heads["cosine"][0]) == 2
+    assert sorted(heads["mae"]) == sorted(names) and sorted(heads["rmse"]) == sorted(names)
+    assert sorted(e["train_tasks"]) == sorted(names), "per-task losses must be name-keyed"
+    # MFU ledger: achieved TFLOP/s + an MFU slot (None off-TPU) or an
+    # explicit available:false; memory watermark always explicit
+    assert "available" in hw and "available" in hw["memory"]
+    if hw["available"]:
+        assert hw["achieved_tflops"] > 0 and "mfu" in hw
+assert eps[-1]["compiles"]["unexpected"] is False, "diagnostics caused a recompile"
+print("introspection smoke: OK (v2 record, head diagnostics + MFU ledger present)")
+EOF
 rm -rf "$SMOKE_DIR"
 
 echo "== [5/7] fault-injection smoke (SIGTERM mid-epoch -> supervisor resume) =="

@@ -301,7 +301,16 @@ def run_training(
     samples: Optional[List] = None,
     log_dir: str = "./logs/",
 ):
-    """Full training pipeline; returns (model, state, history, config)."""
+    """Full training pipeline; returns (model, state, history, config).
+
+    Telemetry knobs (``NeuralNetwork.Training``, docs/OBSERVABILITY.md):
+    ``diagnostics`` (default true) samples per-head gradient norms, the
+    inter-task conflict matrix, per-head eval MAE/RMSE and the
+    hardware-efficiency ledger (MFU + memory watermark) into the run's
+    flight record every ``diag_every`` steps (0 = once per epoch);
+    ``prometheus_dir`` additionally writes an atomic ``train.prom``
+    textfile snapshot per epoch for a node-exporter textfile collector.
+    All of it is inert under ``HYDRAGNN_TELEMETRY=0``."""
     config = load_config(config_file_or_dict)
     verbosity = config.get("Verbosity", {}).get("level", 0)
 

@@ -31,9 +31,22 @@ from hydragnn_tpu.obs.registry import (
 from hydragnn_tpu.obs.flight import (
     FAULT_KINDS,
     SCHEMA_VERSION,
+    SUPPORTED_SCHEMA_VERSIONS,
     FlightRecorder,
+    flight_record_warnings,
     read_flight_record,
     validate_flight_record,
+)
+from hydragnn_tpu.obs.introspect import (
+    HardwareLedger,
+    HeadDiagnostics,
+    collect_head_series,
+    cost_analysis,
+    device_memory_stats,
+    flag_anomalies,
+    make_diagnostics_step,
+    peak_flops,
+    per_head_error_metrics,
 )
 from hydragnn_tpu.obs.spans import StepSpans
 from hydragnn_tpu.obs.compile_monitor import (
@@ -58,9 +71,20 @@ __all__ = [
     "telemetry_enabled",
     "FAULT_KINDS",
     "SCHEMA_VERSION",
+    "SUPPORTED_SCHEMA_VERSIONS",
     "FlightRecorder",
+    "flight_record_warnings",
     "read_flight_record",
     "validate_flight_record",
+    "HardwareLedger",
+    "HeadDiagnostics",
+    "collect_head_series",
+    "cost_analysis",
+    "device_memory_stats",
+    "flag_anomalies",
+    "make_diagnostics_step",
+    "peak_flops",
+    "per_head_error_metrics",
     "StepSpans",
     "BACKEND_COMPILE_EVENT",
     "CompileMonitor",

@@ -27,7 +27,16 @@ import os
 import time
 from typing import Any, Dict, List, Optional, Union
 
-SCHEMA_VERSION = 1
+# v2 (model-introspection PR): epoch events gain name-keyed per-task
+# losses (``train_tasks``/``val_tasks`` as dicts), a ``heads`` block
+# (per-head grad norms, conflict matrix, MAE/RMSE) and a ``hw`` block
+# (achieved TFLOP/s, MFU, memory watermark); run_start manifests gain
+# ``hw_cost`` (compiled-step FLOPs/bytes + chip peak) and
+# ``diagnostics``. All new fields are OPTIONAL: the validator accepts
+# every version in SUPPORTED_SCHEMA_VERSIONS, so v1 records (and v1
+# writers) keep validating unchanged.
+SCHEMA_VERSION = 2
+SUPPORTED_SCHEMA_VERSIONS = (1, 2)
 
 # kind -> fields every event of that kind must carry (beyond the
 # envelope v/kind/t/rank). Unknown kinds are allowed (forward compat);
@@ -251,10 +260,15 @@ def validate_flight_record(
         for field in ("v", "kind", "t", "rank"):
             if field not in ev:
                 problems.append(f"{where}: missing envelope field {field!r}")
-        if ev.get("v") not in (None, SCHEMA_VERSION):
-            problems.append(
-                f"{where}: schema version {ev['v']} != {SCHEMA_VERSION}"
-            )
+        v = ev.get("v")
+        if v is not None and v not in SUPPORTED_SCHEMA_VERSIONS:
+            if isinstance(v, int) and v > SCHEMA_VERSION:
+                pass  # newer writer: forward-compat, surfaced as a warning
+            else:
+                problems.append(
+                    f"{where}: schema version {v!r} not in "
+                    f"{SUPPORTED_SCHEMA_VERSIONS}"
+                )
         kind = ev.get("kind")
         for field in _REQUIRED.get(kind, ()):
             if field not in ev:
@@ -282,3 +296,25 @@ def validate_flight_record(
         if kinds[-1] != "run_end":
             problems.append(f"last event is {kinds[-1]!r}, expected run_end")
     return problems
+
+
+def flight_record_warnings(record: Union[str, List[dict]]) -> List[str]:
+    """Forward-compat advisories that must NOT fail validation: event
+    kinds this reader does not know (a newer writer's events — still
+    structurally fine) and events stamped with a schema version newer
+    than this reader supports. ``tools/obs_report.py --validate/--diff``
+    print these as warnings and exit 0."""
+    events = read_flight_record(record) if isinstance(record, str) else record
+    warnings: List[str] = []
+    for i, ev in enumerate(events):
+        kind = ev.get("kind")
+        if kind is not None and kind != "_unparseable" and kind not in _REQUIRED:
+            warnings.append(f"event[{i}]: unknown event kind {kind!r}")
+        v = ev.get("v")
+        if isinstance(v, int) and v > SCHEMA_VERSION:
+            warnings.append(
+                f"event[{i}]: schema version {v} is newer than this "
+                f"reader (supports {SUPPORTED_SCHEMA_VERSIONS}) — fields "
+                "may be missing from views"
+            )
+    return warnings

@@ -126,6 +126,12 @@ def _finalize_weighted(
     return avg_loss, avg_tasks
 
 
+def _named_tasks(names: Sequence[str], values) -> Dict[str, float]:
+    """Per-task loss array -> {head_name: loss}. Zip-truncating: a
+    zero-length array (preempted epoch finalize) yields {}."""
+    return {n: float(v) for n, v in zip(names, np.asarray(values).reshape(-1))}
+
+
 class _MetricAccum:
     """Accumulates per-batch (loss, tasks) weighted by the real graph count
     as device scalars (no per-batch D2H sync); ``finalize`` materializes
@@ -157,6 +163,7 @@ def train_epoch(
     profiler=None,
     spans=None,
     hooks=None,
+    diag=None,
 ) -> Tuple[TrainState, float, np.ndarray]:
     """One training epoch; returns (state, avg_loss, avg_tasks_loss[H]).
 
@@ -170,7 +177,16 @@ def train_epoch(
     check (graceful mid-epoch stop), watchdog heartbeat, fault
     injection, and — when its non-finite sentry is active — the
     GUARDED step call ``train_step(state, batch, consec)`` whose
-    skipped batches contribute zero weight to the epoch metrics."""
+    skipped batches contribute zero weight to the epoch metrics.
+
+    ``diag`` (hydragnn_tpu/obs/introspect.py:HeadDiagnostics) samples
+    the per-head gradient diagnostics every K steps. It must run
+    BEFORE the train step consumes the state: the jitted step donates
+    the state's buffers, so the sampled step is the last moment this
+    state is usable from Python (the runtime serializes the in-flight
+    diagnostics read against the donating write). Non-sampled steps pay
+    one counter increment; no host sync happens until the epoch
+    boundary."""
     if spans is None:
         from hydragnn_tpu.obs import StepSpans
 
@@ -182,6 +198,8 @@ def train_epoch(
             if hooks.preempted:
                 break
             batch = hooks.before_step(batch)
+        if diag is not None:
+            diag.maybe_sample(state, batch)
         if sentry is not None:
             state, loss, task_losses, consec, bad = spans.step(
                 train_step, state, batch, sentry.consec
@@ -392,11 +410,12 @@ def train_validate_test(
     # Non-finite guard (hydragnn_tpu/resilience/sentry.py): folded into
     # the default per-step jitted train step only — sharded callers pass
     # their own step, and the scan path has no batch granularity.
-    guard_nonfinite = (
-        bool(training.get("nonfinite_guard", True))
-        and train_step is None
-        and scan_fn is None
-    )
+    # own_step: the loop built the default single-device per-step train
+    # step (vs a caller-supplied sharded step or the scan path) — the
+    # only mode where the per-head diagnostics sampler can observe
+    # per-batch (state, batch) pairs.
+    own_step = train_step is None and scan_fn is None
+    guard_nonfinite = bool(training.get("nonfinite_guard", True)) and own_step
     train_step = train_step or make_train_step(
         model,
         tx,
@@ -532,6 +551,63 @@ def train_validate_test(
             "profile_trace", path=path, epoch=ep
         )
 
+    # Model-level introspection (hydragnn_tpu/obs/introspect.py,
+    # docs/OBSERVABILITY.md "Model-level diagnostics"): per-head
+    # gradient diagnostics sampled every Training.diag_every steps
+    # (default: once per epoch), per-head eval MAE/RMSE off the
+    # test_epoch gather path, and the hardware-efficiency ledger
+    # (compiled-step FLOPs from the LOWERED module — no second compile
+    # — turned into per-epoch achieved TFLOP/s + MFU + memory
+    # watermark). All inert when HYDRAGNN_TELEMETRY=0 or
+    # Training.diagnostics=false; the gradient sampler additionally
+    # requires the loop-owned per-step path (sharded callers and the
+    # scan path degrade to heads.available=false, never fail).
+    # HYDRAGNN_DIAGNOSTICS=0 force-disables introspection regardless of
+    # config (the tier-1 suite sets it: dozens of tiny training tests
+    # would each pay the diagnostics executable's compile + the ledger
+    # lowering; the dedicated introspection tests and the ci.sh smoke
+    # opt back in). Production default stays ON.
+    introspect_on = (
+        telemetry_on
+        and bool(training.get("diagnostics", True))
+        and os.environ.get("HYDRAGNN_DIAGNOSTICS", "1").lower()
+        not in ("0", "false", "off")
+    )
+    head_names = list(cfg.output_names)
+    diag = None
+    ledger = None
+    if introspect_on:
+        from hydragnn_tpu.obs.introspect import (
+            HardwareLedger,
+            HeadDiagnostics,
+            make_diagnostics_step,
+        )
+
+        if own_step:
+            diag = HeadDiagnostics(
+                make_diagnostics_step(
+                    model,
+                    tx,
+                    compute_dtype=compute_dtype,
+                    remat=bool(training.get("remat", False)),
+                ),
+                head_names=head_names,
+                every=int(training.get("diag_every", 0))
+                or max(len(train_loader), 1),
+            )
+        try:
+            example = next(iter(train_loader))
+            lower_args = (
+                (state, example, jnp.zeros((), jnp.int32))
+                if guard_nonfinite
+                else (state, example)
+            )
+            # the scan path runs the SAME step body nb times per
+            # dispatch, so the per-step lowered cost prices it too
+            ledger = HardwareLedger.from_step(train_step, lower_args)
+        except Exception:
+            ledger = HardwareLedger.disabled(reason="example_batch_unavailable")
+
     # Fault tolerance (hydragnn_tpu/resilience, docs/RESILIENCE.md):
     # preemption handler (SIGTERM/SIGINT -> graceful stop + final
     # checkpoint within Training.preempt_grace_s), non-finite sentry
@@ -633,6 +709,14 @@ def train_validate_test(
             "nonfinite_guard": sentry is not None,
             "preempt_handler": bool(preempt and preempt.available),
             "watchdog_stall_s": stall_s or None,
+            "head_names": head_names,
+            "diagnostics": {
+                "enabled": diag is not None,
+                "diag_every": diag.every if diag is not None else None,
+            },
+            # the hardware-efficiency ledger's run-constant half: what
+            # one compiled train step costs and what the chip could do
+            "hw_cost": ledger.manifest() if ledger is not None else {"available": False},
         }
     )
     if resumed_from is not None:
@@ -791,6 +875,7 @@ def train_validate_test(
 
         # the profiler context closes an in-flight trace at epoch end even
         # when the epoch has fewer steps than its schedule expects
+        t_train0 = time.perf_counter()
         with (profiler if profiler is not None else contextlib.nullcontext()):
             if scan_fn is not None:
                 state, train_loss, train_tasks = train_epoch_scan(
@@ -805,7 +890,12 @@ def train_validate_test(
                     profiler=profiler,
                     spans=spans,
                     hooks=hooks,
+                    diag=diag,
                 )
+        # the epoch metrics above already synced at finalize, so this
+        # wall time covers every dispatched train step's execution —
+        # the denominator of the epoch's achieved-TFLOP/s and MFU
+        train_wall_s = time.perf_counter() - t_train0
         if hooks.preempted:
             # mid-epoch graceful stop: this epoch is incomplete, resume
             # re-runs it (the meta pair written here says so)
@@ -827,14 +917,23 @@ def train_validate_test(
         else:
             val_loss, val_tasks = evaluate_epoch(val_loader, state, eval_step, verbosity)
         collect = plot_hist_solution and visualizer is not None
+        # introspection reuses the test() gather path for per-head
+        # MAE/RMSE — same eval executable, extra host-side gathering
         test_loss, test_tasks, true_values, predicted_values = test_epoch(
             test_loader,
             state,
             eval_step_out,
             cfg,
             verbosity,
-            return_samples=collect,
+            return_samples=collect or introspect_on,
         )
+        head_quality = None
+        if introspect_on and true_values:
+            from hydragnn_tpu.obs.introspect import per_head_error_metrics
+
+            head_quality = per_head_error_metrics(
+                true_values, predicted_values, head_names
+            )
         if collect:
             visualizer.create_error_histograms(
                 true_values, predicted_values, iepoch=epoch
@@ -862,13 +961,31 @@ def train_validate_test(
             from hydragnn_tpu.utils.print_utils import print_peak_memory
 
             print_peak_memory(verbosity, prefix=f"epoch {epoch}")
+        # per-task metrics are keyed by head name everywhere (flight,
+        # tensorboard, metrics.jsonl) — a multi-head record is readable
+        # without cross-referencing the config's output order
+        train_tasks_named = _named_tasks(head_names, train_tasks)
+        val_tasks_named = _named_tasks(head_names, val_tasks)
+        test_tasks_named = _named_tasks(head_names, test_tasks)
+        diag_snap = diag.epoch_snapshot() if diag is not None else None
+        hw = (
+            ledger.epoch_record(steps=len(train_loader), wall_s=train_wall_s)
+            if ledger is not None
+            else None
+        )
+
         writer.add_scalar("train error", train_loss, epoch)
         writer.add_scalar("validate error", val_loss, epoch)
         writer.add_scalar("test error", test_loss, epoch)
-        for ivar in range(len(train_tasks)):
-            writer.add_scalar(
-                f"train error of task{ivar}", float(train_tasks[ivar]), epoch
-            )
+        for name in head_names:
+            if name in train_tasks_named:
+                writer.add_scalar(
+                    f"heads/{name}/train_loss", train_tasks_named[name], epoch
+                )
+            if name in val_tasks_named:
+                writer.add_scalar(
+                    f"heads/{name}/val_loss", val_tasks_named[name], epoch
+                )
         if metrics_path is not None:
             with open(metrics_path, "a") as f:
                 f.write(
@@ -879,8 +996,8 @@ def train_validate_test(
                             "val_loss": val_loss,
                             "test_loss": test_loss,
                             "lr": lr,
-                            "train_tasks": train_tasks.tolist(),
-                            "val_tasks": val_tasks.tolist(),
+                            "train_tasks": train_tasks_named,
+                            "val_tasks": val_tasks_named,
                         }
                     )
                     + "\n"
@@ -905,24 +1022,83 @@ def train_validate_test(
             compiles["unexpected"] = bool(
                 cmon.available and epoch > start_epoch and n_compiles > 0
             )
+        # heads: the model-level half of the epoch record — per-head
+        # losses always; sampled gradient diagnostics and eval MAE/RMSE
+        # when introspection produced them this epoch
+        heads: Dict[str, Any] = {"names": head_names, "available": False}
+        if diag_snap is not None:
+            heads.update(diag_snap)
+        if head_quality is not None:
+            heads["available"] = True
+            heads["mae"] = {n: m["mae"] for n, m in head_quality.items()}
+            heads["rmse"] = {n: m["rmse"] for n, m in head_quality.items()}
+        extra: Dict[str, Any] = {}
+        if nonfinite:
+            extra["nonfinite"] = nonfinite
+        if introspect_on:
+            extra["heads"] = heads
+            extra["hw"] = hw if hw is not None else {"available": False}
         flight.epoch(
             epoch,
             train_loss=train_loss,
             val_loss=val_loss,
             test_loss=test_loss,
             lr=lr,
-            train_tasks=train_tasks.tolist(),
-            val_tasks=val_tasks.tolist(),
+            train_tasks=train_tasks_named,
+            val_tasks=val_tasks_named,
+            test_tasks=test_tasks_named,
             step_time=step_time,
             compiles=compiles,
-            **({"nonfinite": nonfinite} if nonfinite else {}),
+            **extra,
         )
-        if span_snap is not None:
-            from hydragnn_tpu.utils.tensorboard import write_scalar_dict
+        from hydragnn_tpu.utils.tensorboard import write_scalar_dict
 
+        if span_snap is not None:
             write_scalar_dict(writer, span_snap, epoch, prefix="obs/step_time")
             if compiles.get("count") is not None:
                 writer.add_scalar("obs/compiles", compiles["count"], epoch)
+        if diag_snap is not None:
+            for name in head_names:
+                if name in diag_snap.get("grad_norm", {}):
+                    writer.add_scalar(
+                        f"heads/{name}/grad_norm",
+                        diag_snap["grad_norm"][name],
+                        epoch,
+                    )
+            writer.add_scalar("obs/update_ratio", diag_snap["update_ratio"], epoch)
+        if head_quality is not None:
+            for name, m in head_quality.items():
+                if m["mae"] is not None:
+                    writer.add_scalar(f"heads/{name}/mae", m["mae"], epoch)
+                    writer.add_scalar(f"heads/{name}/rmse", m["rmse"], epoch)
+        if hw is not None and hw.get("mfu") is not None:
+            writer.add_scalar("obs/hw/mfu", hw["mfu"], epoch)
+        if hw is not None and hw.get("achieved_tflops") is not None:
+            writer.add_scalar(
+                "obs/hw/achieved_tflops", hw["achieved_tflops"], epoch
+            )
+
+        # Prometheus textfile export for training (serve already has
+        # one): one atomic train.prom snapshot per epoch, gated by
+        # Training.prometheus_dir (docs/OBSERVABILITY.md)
+        prom_dir = training.get("prometheus_dir")
+        if prom_dir and telemetry_on and jax.process_index() == 0:
+            from hydragnn_tpu.obs import get_registry
+            from hydragnn_tpu.obs.export import registry_to_prometheus
+
+            reg = get_registry()
+            reg.gauge("train.epoch").set(epoch)
+            reg.gauge("train.loss").set(train_loss)
+            reg.gauge("train.val_loss").set(val_loss)
+            reg.gauge("train.lr").set(lr)
+            for name, v in train_tasks_named.items():
+                reg.gauge(f"train.head.{name}.loss").set(v)
+            if diag_snap is not None:
+                for name, v in diag_snap.get("grad_norm", {}).items():
+                    reg.gauge(f"train.head.{name}.grad_norm").set(v)
+            if hw is not None and hw.get("mfu") is not None:
+                reg.gauge("train.mfu").set(hw["mfu"])
+            registry_to_prometheus(reg, os.path.join(prom_dir, "train.prom"))
 
         stop = stopper is not None and stopper(val_loss)
         epochs_done = epoch + 1
@@ -1024,6 +1200,9 @@ def train_validate_test(
         compiles=cmon.snapshot() if cmon is not None else None,
         timers=timers_snapshot(),
         metrics=get_registry().snapshot(),
+        # hardware-efficiency rollup: mean/max MFU across epochs and
+        # the run's device-memory high-water mark
+        hw=ledger.run_summary() if ledger is not None else None,
     )
     if own_flight:
         flight.close()

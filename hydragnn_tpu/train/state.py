@@ -212,6 +212,7 @@ def make_train_step(
     compute_dtype=None,
     remat: bool = False,
     guard_nonfinite: bool = False,
+    diagnostics: bool = False,
 ) -> Callable[..., Tuple]:
     """Returns jitted ``(state, batch) -> (state, loss, tasks_loss)``.
 
@@ -232,13 +233,28 @@ def make_train_step(
     any batch producing a non-finite loss or gradient norm (see
     :func:`_guarded_step_body`; the host policy lives in
     ``hydragnn_tpu/resilience/sentry.py``). With all-finite inputs it
-    computes exactly what the unguarded step computes."""
+    computes exactly what the unguarded step computes.
+
+    ``diagnostics=True`` (config ``Training.diagnostics``) additionally
+    returns the jitted per-head diagnostics step — ``(train_step,
+    diag_step)`` — a SEPARATE executable over the same loss (per-head
+    gradient norms, inter-task cosine conflict matrix, update-to-param
+    ratio; see ``hydragnn_tpu/obs/introspect.py``) that the train loop
+    dispatches only on sampled steps, so the hot path's executable and
+    sync discipline are untouched."""
     body = (
         _guarded_step_body(model, tx, compute_dtype=compute_dtype, remat=remat)
         if guard_nonfinite
         else _train_step_body(model, tx, compute_dtype=compute_dtype, remat=remat)
     )
-    return jax.jit(body, donate_argnums=(0,))
+    step = jax.jit(body, donate_argnums=(0,))
+    if diagnostics:
+        from hydragnn_tpu.obs.introspect import make_diagnostics_step
+
+        return step, make_diagnostics_step(
+            model, tx, compute_dtype=compute_dtype, remat=remat
+        )
+    return step
 
 
 def make_scan_epoch(

@@ -163,6 +163,13 @@ def update_config(
     nn["Training"].setdefault("Optimizer", {"type": "AdamW", "learning_rate": 1e-3})
     nn["Training"].setdefault("loss_function_type", "mse")
     arch.setdefault("SyncBatchNorm", False)
+    # model-level introspection knobs (hydragnn_tpu/obs/introspect.py,
+    # docs/OBSERVABILITY.md "Model-level diagnostics"): per-head
+    # gradient diagnostics + hardware-efficiency ledger, sampled every
+    # diag_every steps (0 = once per epoch); prometheus_dir enables the
+    # per-epoch train.prom textfile export when set
+    nn["Training"].setdefault("diagnostics", True)
+    nn["Training"].setdefault("diag_every", 0)
 
     config = normalize_output_config(config)
     return config

@@ -12,6 +12,14 @@ import os
 import sys
 
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+# Model-level introspection OFF for the suite (production default is
+# ON): every tiny training test would otherwise compile the separate
+# per-head diagnostics executable and lower the train step for the
+# hardware ledger — measured ~2+ minutes across the suite's dozens of
+# training runs, which blows the tier-1 time budget. The dedicated
+# introspection tests (tests/test_introspect.py, the flight-record e2e
+# in test_obs.py) and the ci.sh stage-4 smoke opt back in explicitly.
+os.environ.setdefault("HYDRAGNN_DIAGNOSTICS", "0")
 # Persistent compilation cache: repeated test runs skip recompilation.
 # Gated OFF on jax < 0.5: the 0.4.x persistent cache round-trips jitted
 # executables without their input-output aliasing (donation) metadata, so

@@ -589,11 +589,14 @@ def test_obs_report_diff(tmp_path):
 # ---------------------------------------------------------------------------
 
 
-def test_run_training_emits_valid_flight_record(tmp_path):
+def test_run_training_emits_valid_flight_record(tmp_path, monkeypatch):
     from hydragnn_tpu.api import run_training
     from hydragnn_tpu.data.synthetic import deterministic_graph_data
     from hydragnn_tpu.flagship import flagship_config
 
+    # introspection is conftest-disabled for the suite's many tiny
+    # trainings; THIS test asserts the production default-on record
+    monkeypatch.setenv("HYDRAGNN_DIAGNOSTICS", "1")
     log_dir = str(tmp_path / "logs") + "/"
     cfg = flagship_config(hidden_dim=8, num_conv_layers=2, batch_size=5, num_epoch=2)
     samples = deterministic_graph_data(
@@ -618,6 +621,13 @@ def test_run_training_emits_valid_flight_record(tmp_path):
     assert man["pad_plans"]["train"]["pad_nodes"] > 0
     assert man["mesh"]["process_count"] >= 1
 
+    # v2 manifest: the introspection identity card
+    assert man["head_names"] == ["sum_x_x2_x3", "x", "x2", "x3"]
+    assert man["diagnostics"]["enabled"] is True
+    assert "available" in man["hw_cost"]
+    if man["hw_cost"]["available"]:
+        assert man["hw_cost"]["flops_per_step"] > 0
+
     epochs = [e for e in events if e["kind"] == "epoch"]
     assert len(epochs) == 2
     for ep in epochs:
@@ -627,7 +637,26 @@ def test_run_training_emits_valid_flight_record(tmp_path):
         assert st["data_wait_s"] >= 0 and st["dispatch_s"] > 0
         assert st["sampled_steps"] >= 1 and st["device_wait_ms_mean"] is not None
         assert "count" in ep["compiles"] and ep["compiles"]["available"]
-    # steady state: epoch 1 must not have recompiled the train step
+        # per-task losses keyed by head name, not positional index
+        assert set(ep["train_tasks"]) == set(man["head_names"])
+        assert set(ep["val_tasks"]) == set(man["head_names"])
+        # model-level introspection: per-head grad norms, the conflict
+        # matrix, per-head MAE/RMSE, and the hardware ledger
+        heads = ep["heads"]
+        assert heads["available"]
+        assert set(heads["grad_norm"]) == set(man["head_names"])
+        cos = heads["cosine"]
+        assert len(cos) == 4 and all(len(row) == 4 for row in cos)
+        assert all(abs(cos[i][i] - 1.0) < 1e-5 for i in range(4))
+        assert set(heads["mae"]) == set(man["head_names"])
+        assert set(heads["rmse"]) == set(man["head_names"])
+        hw = ep["hw"]
+        assert "available" in hw and "available" in hw["memory"]
+        if hw["available"]:
+            assert hw["achieved_tflops"] > 0 and "mfu" in hw
+    # steady state: epoch 1 must not have recompiled the train step —
+    # including the separate diagnostics executable (compiled in epoch
+    # 0, cache-hit thereafter)
     assert epochs[1]["compiles"]["unexpected"] is False
     assert epochs[1]["compiles"]["count"] == 0
 
@@ -656,7 +685,10 @@ def test_crashed_training_leaves_failed_flight_record(tmp_path):
 
     class Boom:
         """Crashes on the THIRD iteration: 1 = model-init example,
-        2 = epoch 0 training, 3 = epoch 1 -> a genuine mid-run crash."""
+        2 = epoch 0 training, 3 = epoch 1 -> a genuine mid-run crash.
+        (With introspection enabled — HYDRAGNN_DIAGNOSTICS=1, off in
+        this suite — the hardware ledger consumes one extra example
+        iteration before epoch 0.)"""
 
         def __init__(self, inner):
             self.inner = inner

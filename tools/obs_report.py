@@ -26,8 +26,13 @@ if _REPO not in sys.path:  # runnable as `python tools/obs_report.py`
 
 from hydragnn_tpu.obs.flight import (  # noqa: E402
     FAULT_KINDS,
+    flight_record_warnings,
     read_flight_record,
     validate_flight_record,
+)
+from hydragnn_tpu.obs.introspect import (  # noqa: E402
+    collect_head_series,
+    flag_anomalies,
 )
 
 
@@ -135,6 +140,88 @@ def render_report(events: List[dict]) -> str:
             lines.append(f"  {k}: {_fmt(v)}")
         for k, t in (end.get("timers") or {}).items():
             lines.append(f"  timer {k}: {t}")
+    return "\n".join(lines)
+
+
+def render_heads(events: List[dict]) -> str:
+    """The multi-task health view (``--heads``): per-head loss /
+    grad-norm / MAE trajectories, the mean task-conflict matrix, the
+    hardware-efficiency ledger, and the anomaly flags
+    (``hydragnn_tpu/obs/introspect.py:flag_anomalies``) — the diagnosis
+    a human or CI reads, not just the data."""
+    series = collect_head_series(events)
+    names = series["names"]
+    lines: List[str] = []
+    if not names:
+        return "== heads: no per-head data in this record =="
+    lines.append(f"== heads ({len(names)}): {', '.join(names)} ==")
+
+    lines.append("== per-head trajectories ==")
+    for n in names:
+        lines.append(f"  head {n!r}:")
+        lines.append(
+            "      ep   train_loss    grad_norm          mae         rmse"
+        )
+        for i, ep in enumerate(series["epochs"]):
+            row = [
+                _fmt(series[key][n][i] if series[key][n][i] is not None else "-", 5)
+                for key in ("train_loss", "grad_norm", "mae", "rmse")
+            ]
+            lines.append(
+                f"    {ep!s:>4} {row[0]:>12} {row[1]:>12} {row[2]:>12} {row[3]:>12}"
+            )
+
+    mats = [m for m in series["cosine"] if m is not None]
+    if mats:
+        import numpy as np
+
+        h = len(names)
+        good = [np.asarray(m, float) for m in mats]
+        good = [m for m in good if m.shape == (h, h)]
+        if good:
+            mean = np.mean(good, axis=0)
+            lines.append(
+                f"== task-conflict matrix (mean gradient cosine over "
+                f"{len(good)} sampled epoch(s)) =="
+            )
+            short = [n[:12] for n in names]
+            lines.append("  " + " " * 14 + " ".join(f"{s:>12}" for s in short))
+            for i, s in enumerate(short):
+                lines.append(
+                    f"  {s:>14}"
+                    + " ".join(f"{mean[i, j]:>+12.3f}" for j in range(h))
+                )
+    ratios = [r for r in series["update_ratio"] if r is not None]
+    if ratios:
+        lines.append(
+            "== update/param norm ratio (sampled): "
+            + ", ".join(f"{r:.3g}" for r in ratios)
+            + " =="
+        )
+
+    hw_rows = [
+        (e.get("epoch"), e.get("hw"))
+        for e in events
+        if e.get("kind") == "epoch" and isinstance(e.get("hw"), dict)
+    ]
+    if hw_rows:
+        lines.append("== hardware-efficiency ledger ==")
+        lines.append("      ep        mfu   achieved_tflops   mem_peak_bytes")
+        for ep, hw in hw_rows:
+            mem = (hw.get("memory") or {}).get("peak_bytes_in_use", "-")
+            mfu = hw.get("mfu")
+            tfl = hw.get("achieved_tflops")
+            lines.append(
+                f"    {ep!s:>4} {_fmt(mfu if mfu is not None else '-', 4):>10} "
+                f"{_fmt(tfl if tfl is not None else '-', 6):>17} {mem!s:>16}"
+            )
+
+    flags = flag_anomalies(series)
+    lines.append(f"== anomalies ({len(flags)}) ==")
+    if flags:
+        lines.extend(f"  ! {f}" for f in flags)
+    else:
+        lines.append("  (none — multi-task optimization looks healthy)")
     return "\n".join(lines)
 
 
@@ -307,7 +394,30 @@ def main(argv=None) -> int:
         "restart timeline (handles merged multi-run records); exits 1 "
         "when any fault event fails its schema",
     )
+    p.add_argument(
+        "--heads",
+        action="store_true",
+        help="multi-task health view: per-head loss/grad-norm/MAE "
+        "trajectories, the gradient-cosine conflict matrix, the "
+        "hardware-efficiency ledger, and anomaly flags "
+        "(docs/OBSERVABILITY.md 'Model-level diagnostics')",
+    )
     args = p.parse_args(argv)
+
+    def _print_warnings(events) -> None:
+        # forward-compat advisories (unknown kinds, newer schema
+        # versions): surfaced, never fatal
+        for w in flight_record_warnings(events):
+            print(f"  WARNING: {w}")
+
+    if args.heads:
+        for path in args.records:
+            events = read_flight_record(path)
+            if len(args.records) > 1:
+                print(f"===== {path} =====")
+            print(render_heads(events))
+            _print_warnings(events)
+        return 0
 
     if args.faults:
         rc = 0
@@ -327,6 +437,8 @@ def main(argv=None) -> int:
             p.error("--diff needs exactly two records")
         a, b = (read_flight_record(r) for r in args.records)
         print(render_diff(a, b))
+        _print_warnings(a)
+        _print_warnings(b)
         return 0
 
     rc = 0
@@ -343,6 +455,7 @@ def main(argv=None) -> int:
                     print(f"  - {prob}")
             else:
                 print(f"{path}: OK ({len(events)} events)")
+            _print_warnings(events)
         else:
             if len(args.records) > 1:
                 print(f"===== {path} =====")
