@@ -19,6 +19,18 @@ CPU mode (``JAX_PLATFORMS=cpu python bench_serve.py``) runs a smoke-
 sized model; the same knobs scale it to a real chip. Knobs:
 SERVE_REQUESTS, SERVE_THREADS, SERVE_MAX_BATCH, SERVE_DELAY_MS,
 SERVE_BUCKETS, SERVE_SAMPLES, SERVE_HIDDEN, SERVE_LAYERS.
+
+Chaos mode (``python bench_serve.py --chaos``, or SERVE_CHAOS=1): the
+committed self-healing acceptance run (docs/RESILIENCE.md "Serving
+resilience"). Against live traffic it injects a raise-in-forward poison
+request, a wedged dispatch (forward sleeps past the watchdog
+threshold), a dispatch-thread death, and performs one hot reload —
+then asserts the server ends the run READY, every submitted request
+resolved (result or typed RequestFailed: ZERO lost/hanging futures),
+the quarantine/restart/reload counts match the injection plan in both
+the metrics and the flight record, and post-recovery traffic paid 0
+new compile misses. The headline value is the worst not-ready gap
+(recovery time); exit 1 on any violated invariant.
 """
 
 from __future__ import annotations
@@ -163,5 +175,193 @@ def main() -> None:
         raise SystemExit(1)
 
 
+def chaos() -> None:
+    """The serving-resilience acceptance run (see module docstring)."""
+    from bench import init_device_with_flight, open_bench_flight
+
+    metric = "serve_chaos_recovery"
+    flight = open_bench_flight("BENCH_SERVE_CHAOS_FLIGHT.jsonl")
+    device, init_retries = init_device_with_flight(metric, flight)
+
+    import numpy as np
+
+    from hydragnn_tpu.flagship import build_flagship
+    from hydragnn_tpu.serve import (
+        ModelRegistry,
+        ModelServer,
+        RequestFailed,
+        ServeConfig,
+    )
+
+    n_requests = int(os.environ.get("SERVE_REQUESTS", 96))
+    max_batch = int(os.environ.get("SERVE_MAX_BATCH", 8))
+    n_samples = int(os.environ.get("SERVE_SAMPLES", 64))
+    hidden = int(os.environ.get("SERVE_HIDDEN", 16))
+    layers = int(os.environ.get("SERVE_LAYERS", 2))
+
+    # the injection plan: one poison raise, one wedged forward past the
+    # watchdog threshold, one dispatch-thread death, one hot reload
+    seq_raise = n_requests // 4
+    seq_wedge = (2 * n_requests) // 3
+    kill_batch = 3
+    wedge_s = 1
+    os.environ["HYDRAGNN_INJECT_SERVE_RAISE"] = str(seq_raise)
+    os.environ["HYDRAGNN_INJECT_SERVE_WEDGE"] = f"{seq_wedge}:{wedge_s}"
+    os.environ["HYDRAGNN_INJECT_SERVE_KILL_DISPATCH"] = str(kill_batch)
+
+    _, model, variables, loader = build_flagship(
+        n_samples=n_samples,
+        hidden_dim=hidden,
+        num_conv_layers=layers,
+        batch_size=max(max_batch, 2),
+        unit_cells=(2, 4),
+    )
+    registry = ModelRegistry()
+    served = registry.register("bench_serve_chaos", model, variables)
+    requests = list(loader.all_samples)
+    server = ModelServer(
+        served,
+        requests,
+        ServeConfig(
+            max_batch=max_batch,
+            max_delay_ms=3.0,
+            max_pending=max(8 * n_requests, 256),
+            dispatch_stall_s=0.25,
+            dispatch_backoff_base_s=0.2,
+        ),
+        flight=flight,
+    )
+    server.start()
+
+    # readiness sampler: the recovery-time measurement
+    ready_samples: list = []
+    sampling = threading.Event()
+
+    def sampler() -> None:
+        while not sampling.wait(0.01):
+            ready_samples.append((time.perf_counter(), server.health()["ready"]))
+
+    sampler_t = threading.Thread(target=sampler, daemon=True)
+    sampler_t.start()
+
+    rng = np.random.default_rng(0)
+    order = rng.integers(0, len(requests), size=n_requests)
+    futures = []
+    t0 = time.perf_counter()
+    reload_info = None
+    for i, idx in enumerate(order):
+        futures.append(server.submit(requests[int(idx)]))
+        time.sleep(0.002)  # paced open-loop: faults land mid-traffic
+        if i == n_requests // 2:
+            # hot reload mid-traffic (fresh copy of the same weights:
+            # the canary + atomic-swap path, architecture unchanged)
+            reload_info = server.reload(variables=dict(variables))
+    results, typed_failures, lost = 0, 0, 0
+    for f in futures:
+        try:
+            f.result(timeout=120)
+            results += 1
+        except RequestFailed:
+            typed_failures += 1
+        except BaseException:
+            lost += 1  # an UNtyped failure is a lost contract
+    wall = time.perf_counter() - t0
+
+    # settle, then measure the not-ready gaps out of the sampler trace
+    deadline = time.perf_counter() + 10.0
+    while not server.health()["ready"] and time.perf_counter() < deadline:
+        time.sleep(0.01)
+    sampling.set()
+    sampler_t.join(timeout=2.0)
+    gaps, gap_start = [], None
+    for t, ready in ready_samples:
+        if not ready and gap_start is None:
+            gap_start = t
+        elif ready and gap_start is not None:
+            gaps.append(t - gap_start)
+            gap_start = None
+    if gap_start is not None:
+        gaps.append(ready_samples[-1][0] - gap_start)
+
+    health = server.health()
+    snap = server.metrics_snapshot()
+    server.stop()
+    for k in list(os.environ):
+        if k.startswith("HYDRAGNN_INJECT_SERVE_"):
+            del os.environ[k]
+
+    from hydragnn_tpu.obs.flight import read_flight_record
+
+    events = read_flight_record(flight.path)
+    fcounts = {
+        kind: sum(1 for e in events if e.get("kind") == kind)
+        for kind in ("quarantine", "dispatch_restart", "watchdog", "reload", "reload_failed")
+    }
+
+    plan = {"quarantined": 1, "dispatch_restarts": 1, "reloads": 1}
+    failures = []
+    if lost:
+        failures.append(f"{lost} futures failed UNtyped (lost contract)")
+    if results + typed_failures != n_requests:
+        failures.append(
+            f"resolved {results}+{typed_failures} != submitted {n_requests}"
+        )
+    if not health["ready"]:
+        failures.append(f"server not ready at end: {health['reasons']}")
+    for key, want in plan.items():
+        if snap[key] != want:
+            failures.append(f"metrics {key}={snap[key]} != plan {want}")
+    if fcounts["quarantine"] != plan["quarantined"]:
+        failures.append(f"flight quarantine={fcounts['quarantine']} != 1")
+    if fcounts["dispatch_restart"] != plan["dispatch_restarts"]:
+        failures.append(f"flight dispatch_restart={fcounts['dispatch_restart']} != 1")
+    if fcounts["reload"] != plan["reloads"] or fcounts["reload_failed"]:
+        failures.append(
+            f"flight reload={fcounts['reload']}/failed={fcounts['reload_failed']}"
+        )
+    if fcounts["watchdog"] < 1:
+        failures.append("wedged dispatch never tripped the watchdog")
+    if snap["compile_misses"] != 0:
+        failures.append(
+            f"{snap['compile_misses']} compile misses — recovery recompiled"
+        )
+
+    record = {
+        "metric": metric,
+        "value": round(max(gaps), 3) if gaps else 0.0,
+        "unit": "s_worst_not_ready_gap",
+        "init_retries": init_retries,
+        "requests": n_requests,
+        "wall_s": round(wall, 2),
+        "results": results,
+        "typed_failures": typed_failures,
+        "lost_futures": lost,
+        "injection_plan": {
+            "raise_at_seq": seq_raise,
+            "wedge_at_seq": [seq_wedge, wedge_s],
+            "kill_dispatch_at_batch": kill_batch,
+            "reload_at_request": n_requests // 2,
+        },
+        "not_ready_gaps_s": [round(g, 3) for g in gaps],
+        "reload": reload_info,
+        "metrics": {k: snap[k] for k in (
+            "quarantined", "poison_retries", "dispatch_restarts", "reloads",
+            "reload_failed", "errors", "compile_misses",
+        )},
+        "flight_counts": fcounts,
+        "failures": failures,
+    }
+    flight.record("bench_result", record=record, passed=not failures)
+    flight.close()
+    print(json.dumps(record))
+    if failures:
+        for f in failures:
+            print(f"FAIL: {f}", file=sys.stderr)
+        raise SystemExit(1)
+
+
 if __name__ == "__main__":
-    main()
+    if "--chaos" in sys.argv or os.environ.get("SERVE_CHAOS") == "1":
+        chaos()
+    else:
+        main()

@@ -36,21 +36,30 @@
 #                         completion, and the merged flight record must
 #                         validate with exactly one preempted run_end +
 #                         one resumed event (docs/RESILIENCE.md).
-#   6. full matrix      — opt-in (CI_FULL=1): all 7 models x head configs
+#   6. serve-chaos      — a tiny trained run is served; a poison request
+#      smoke               is injected (raise-in-forward), then the
+#                         checkpoint is HOT-reloaded into the running
+#                         server; the server must answer identically
+#                         afterwards, the serve flight record must
+#                         validate (quarantine/reload event kinds), and
+#                         tools/serve_probe.py must exit 0 on the
+#                         exported Prometheus textfile
+#                         (docs/RESILIENCE.md "Serving resilience").
+#   7. full matrix      — opt-in (CI_FULL=1): all 7 models x head configs
 #                         trained to the reference accuracy thresholds
 #                         (HYDRAGNN_FULL_MATRIX=1, ~15 min).
-#   7. TPU kernel suite — opt-in (CI_TPU=1, needs a real TPU):
+#   8. TPU kernel suite — opt-in (CI_TPU=1, needs a real TPU):
 #                         HYDRAGNN_TPU_TESTS=1 on-chip kernel-vs-XLA
 #                         checks, budgeted under the tunnel's dispatch
 #                         throttle (tests/test_tpu_chip.py).
 #
-# Usage: ./ci.sh            # stages 1-5 (the default CI gate)
+# Usage: ./ci.sh            # stages 1-6 (the default CI gate)
 #        CI_FULL=1 ./ci.sh  # + acceptance matrix
 #        CI_TPU=1  ./ci.sh  # + real-chip kernel suite
 set -euo pipefail
 cd "$(dirname "$0")"
 
-echo "== [1/7] format gate =="
+echo "== [1/8] format gate =="
 if python -m black --version >/dev/null 2>&1; then
     python -m black --check .
 elif command -v black >/dev/null 2>&1; then
@@ -60,13 +69,13 @@ else
     python -m compileall -q hydragnn_tpu tests examples tools bench.py bench_scaling.py bench_serve.py __graft_entry__.py
 fi
 
-echo "== [2/7] chip hygiene report =="
+echo "== [2/8] chip hygiene report =="
 python tools/chip_hygiene.py || true
 
-echo "== [3/7] serial suite (virtual 8-device CPU mesh, incl. 2-process pass) =="
+echo "== [3/8] serial suite (virtual 8-device CPU mesh, incl. 2-process pass) =="
 python -m pytest tests/ -q
 
-echo "== [4/7] telemetry smoke (tiny 2-head training -> schema-valid v2 flight record with head diagnostics + MFU ledger) =="
+echo "== [4/8] telemetry smoke (tiny 2-head training -> schema-valid v2 flight record with head diagnostics + MFU ledger) =="
 SMOKE_DIR="$(mktemp -d)"
 JAX_PLATFORMS=cpu python - "$SMOKE_DIR" <<'EOF'
 import sys
@@ -126,7 +135,7 @@ print("introspection smoke: OK (v2 record, head diagnostics + MFU ledger present
 EOF
 rm -rf "$SMOKE_DIR"
 
-echo "== [5/7] fault-injection smoke (SIGTERM mid-epoch -> supervisor resume) =="
+echo "== [5/8] fault-injection smoke (SIGTERM mid-epoch -> supervisor resume) =="
 FAULT_DIR="$(mktemp -d)"
 cat > "$FAULT_DIR/child.py" <<'EOF'
 import sys
@@ -172,18 +181,106 @@ print("fault-injection smoke: OK (one preempted + one resumed, run completed)")
 EOF
 rm -rf "$FAULT_DIR"
 
+echo "== [6/8] serve-chaos smoke (poison request -> quarantine; hot reload from the saved checkpoint; health probe) =="
+SERVE_DIR="$(mktemp -d)"
+JAX_PLATFORMS=cpu python - "$SERVE_DIR" <<'EOF'
+import glob
+import os
+import sys
+
+import numpy as np
+
+out = sys.argv[1]
+# poison injection: the request admitted with sequence number 2 raises
+# inside the forward; only ITS future may fail
+os.environ["HYDRAGNN_INJECT_SERVE_RAISE"] = "2"
+
+from hydragnn_tpu.api import prepare_loaders_and_config, run_training, serve_model
+from hydragnn_tpu.data.synthetic import deterministic_graph_data
+from hydragnn_tpu.flagship import flagship_config
+from hydragnn_tpu.obs import FlightRecorder
+from hydragnn_tpu.serve import RequestFailed, ServeConfig
+
+
+def cfg():
+    return flagship_config(hidden_dim=8, num_conv_layers=2, batch_size=5, num_epoch=1)
+
+
+def data():
+    return deterministic_graph_data(
+        number_configurations=20,
+        unit_cell_x_range=(2, 3),
+        unit_cell_y_range=(2, 3),
+        unit_cell_z_range=(2, 3),
+        seed=0,
+    )
+
+
+run_training(cfg(), samples=data(), log_dir=out + "/logs/")
+log_name = os.path.basename(os.path.dirname(glob.glob(out + "/logs/*/flight.jsonl")[0]))
+
+flight = FlightRecorder(out + "/serve_flight.jsonl")
+server = serve_model(
+    cfg(),
+    samples=data(),
+    log_dir=out + "/logs/",
+    serve_config=ServeConfig(max_batch=4, max_delay_ms=5.0),
+    flight=flight,
+)
+_, _, test_loader, _ = prepare_loaders_and_config(cfg(), data())
+# the tiny run's test split is small; cycle it so the poison request
+# (admission seq 2) exists and is co-batched with innocents
+test = (list(test_loader.all_samples) * 6)[:6]
+
+futs = [server.submit(s) for s in test]
+results, quarantined = {}, 0
+for i, f in enumerate(futs):
+    try:
+        results[i] = f.result(timeout=120)
+    except RequestFailed as exc:
+        assert exc.seq == 2, exc
+        quarantined += 1
+assert quarantined == 1, f"expected exactly the poison request to fail, got {quarantined}"
+assert len(results) == 5, "co-batched requests must survive the poison"
+
+# hot reload from the freshly saved checkpoint (validating loader path);
+# same weights -> answers must be bit-identical afterwards
+os.environ.pop("HYDRAGNN_INJECT_SERVE_RAISE")
+before = server.predict(test[0], timeout=120)
+info = server.reload(log_name)
+after = server.predict(test[0], timeout=120)
+for k in before:
+    np.testing.assert_allclose(after[k], before[k], rtol=0, atol=0)
+
+health = server.health()
+assert health["ready"] and health["live"], health
+snap = server.metrics_snapshot()
+assert snap["quarantined"] == 1 and snap["reloads"] == 1, snap
+assert snap["compile_misses"] == 0, "chaos/reload recompiled on the serving path"
+server.export_prometheus(out + "/serve.prom")
+server.stop()
+print(f"serve-chaos smoke: OK (quarantined=1, reload {info['swap_s']}s, answers identical)")
+EOF
+python tools/obs_report.py --validate "$SERVE_DIR/serve_flight.jsonl" | tee "$SERVE_DIR/validate.out"
+if grep -q "WARNING" "$SERVE_DIR/validate.out"; then
+    echo "FAIL: serve flight kinds not schema-known"; exit 1
+fi
+python tools/obs_report.py --faults "$SERVE_DIR/serve_flight.jsonl"
+python tools/serve_probe.py --prom "$SERVE_DIR/serve.prom" --verbose
+rm -rf "$SERVE_DIR"
+
 if [ "${CI_FULL:-0}" = "1" ]; then
-    echo "== [6/7] full acceptance matrix (reference thresholds) =="
+    echo "== [7/8] full acceptance matrix (reference thresholds) =="
     HYDRAGNN_FULL_MATRIX=1 python -m pytest tests/test_train_matrix.py -q
 else
-    echo "== [6/7] full acceptance matrix: skipped (set CI_FULL=1) =="
+    echo "== [7/8] full acceptance matrix: skipped (set CI_FULL=1) =="
 fi
 
 if [ "${CI_TPU:-0}" = "1" ]; then
-    echo "== [7/7] real-chip TPU kernel suite =="
+    echo "== [8/8] real-chip TPU kernel suite =="
     HYDRAGNN_TPU_TESTS=1 python -m pytest tests/test_tpu_chip.py -q
 else
-    echo "== [7/7] real-chip TPU kernel suite: skipped (set CI_TPU=1, needs a TPU) =="
+    echo "== [8/8] real-chip TPU kernel suite: skipped (set CI_TPU=1, needs a TPU) =="
 fi
 
 echo "CI protocol complete."

@@ -344,6 +344,7 @@ def serve_model(
     log_dir: str = "./logs/",
     serve_config=None,
     start: bool = True,
+    flight=None,
 ):
     """Stand up a batched online-inference server over a trained run.
 
@@ -382,7 +383,10 @@ def serve_model(
     served = registry.load(
         log_name, config["NeuralNetwork"], example_graph=reference[0]
     )
-    server = ModelServer(served, reference, serve_config or ServeConfig())
+    server = ModelServer(served, reference, serve_config or ServeConfig(), flight=flight)
+    # reload("run_name") without an explicit log_dir restores from the
+    # same checkpoint root this server was stood up from
+    server.log_dir = log_dir
     if start:
         server.start()
     return server

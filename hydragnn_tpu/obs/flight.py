@@ -55,10 +55,29 @@ _REQUIRED: Dict[str, tuple] = {
     "rollback": ("epoch", "consec"),
     "watchdog": ("stall_s", "stacks"),
     "restart": ("attempt", "cause"),
+    # serving-resilience events (hydragnn_tpu/serve, docs/RESILIENCE.md
+    # "Serving resilience"): a quarantined poison request, an in-process
+    # dispatch-thread restart, and hot-reload outcomes
+    "quarantine": ("seq", "reason"),
+    "dispatch_restart": ("attempt", "cause"),
+    "reload": ("source",),
+    "reload_failed": ("source", "error"),
 }
 
 # the fault-history subset tools/obs_report.py --faults narrates
-FAULT_KINDS = ("preempt", "resumed", "rollback", "watchdog", "restart", "retry", "error")
+FAULT_KINDS = (
+    "preempt",
+    "resumed",
+    "rollback",
+    "watchdog",
+    "restart",
+    "retry",
+    "error",
+    "quarantine",
+    "dispatch_restart",
+    "reload",
+    "reload_failed",
+)
 
 _MANIFEST_REQUIRED = ("jax_version", "backend", "num_processes")
 

@@ -24,6 +24,31 @@ per supervised run.
                                      (drives the hang watchdog)
   =================================  ==========================================
 
+Serving-side faults (docs/RESILIENCE.md "Serving resilience"; request
+numbers are the server's admission sequence, 0-based, so an injection
+follows its request through batch coalescing AND the retry-as-singles
+poison hunt):
+
+  =====================================  ======================================
+  HYDRAGNN_INJECT_SERVE_RAISE=N          the forward raises for any batch
+                                         containing request N (poison request)
+  HYDRAGNN_INJECT_SERVE_NAN=N            the forward's outputs are replaced
+                                         with NaN for any batch containing
+                                         request N (silent-corruption poison)
+  HYDRAGNN_INJECT_SERVE_WEDGE=N:S        the dispatch thread sleeps S seconds
+                                         (default 5) inside the forward of the
+                                         batch containing request N (wedged
+                                         dispatch — drives the serve watchdog)
+  HYDRAGNN_INJECT_SERVE_KILL_DISPATCH=K  the K-th (1-indexed) dispatched batch
+                                         raises OUTSIDE request isolation,
+                                         killing the dispatch thread (drives
+                                         the dispatch supervisor restart)
+  HYDRAGNN_INJECT_SERVE_TORN_RELOAD=1    ModelServer.reload corrupts the
+                                         candidate weights to NaN before the
+                                         canary (the canary must fail and the
+                                         old weights must keep serving)
+  =====================================  ======================================
+
 Step numbers are process-local dispatch counts (0-based, counted by
 ``TrainHooks``), so injections are deterministic regardless of resume
 state.
@@ -109,6 +134,62 @@ def maybe_stall_loader(batch_index: int) -> None:
     b, seconds = _two_ints(spec, 3600)
     if batch_index == b:
         time.sleep(seconds)
+
+
+def maybe_serve_raise(seqs) -> None:
+    """Raise inside the serving forward when the batch holds the
+    injected request number — the poison the retry-as-singles hunt must
+    localize (the fault follows request N into its retry single)."""
+    spec = _spec("HYDRAGNN_INJECT_SERVE_RAISE")
+    if spec is not None and int(spec) in seqs:
+        raise RuntimeError(
+            f"injected serve fault: raise-in-forward at request {int(spec)}"
+        )
+
+
+def maybe_serve_nan(outputs, seqs):
+    """Replace the forward's outputs with NaN when the batch holds the
+    injected request number (silent corruption: no exception, just
+    non-finite results the finite-output check must catch)."""
+    spec = _spec("HYDRAGNN_INJECT_SERVE_NAN")
+    if spec is None or int(spec) not in seqs:
+        return outputs
+    import numpy as np
+
+    return [np.full_like(np.asarray(o), np.nan) for o in outputs]
+
+
+_SERVE_WEDGED = False
+
+
+def maybe_serve_wedge(seqs) -> None:
+    """Sleep inside the serving forward (wedged dispatch) for the batch
+    holding the injected request number. Fires once per process."""
+    spec = _spec("HYDRAGNN_INJECT_SERVE_WEDGE")
+    if spec is None:
+        return
+    n, seconds = _two_ints(spec, 5)
+    global _SERVE_WEDGED
+    if n in seqs and not _SERVE_WEDGED:
+        _SERVE_WEDGED = True
+        time.sleep(seconds)
+
+
+def maybe_serve_kill_dispatch(batch_count: int) -> None:
+    """Raise OUTSIDE the per-request isolation at the K-th (1-indexed)
+    dispatched batch — the dispatch thread dies and the in-process
+    supervisor must restart it."""
+    spec = _spec("HYDRAGNN_INJECT_SERVE_KILL_DISPATCH")
+    if spec is not None and batch_count == int(spec):
+        raise RuntimeError(
+            f"injected serve fault: dispatch thread killed at batch {batch_count}"
+        )
+
+
+def serve_torn_reload() -> bool:
+    """Whether ModelServer.reload should corrupt the candidate weights
+    before the canary (torn-reload injection)."""
+    return _spec("HYDRAGNN_INJECT_SERVE_TORN_RELOAD") is not None
 
 
 def strip_injection_env(env: dict) -> dict:

@@ -23,6 +23,12 @@ stripped, so an injected fault fires exactly once per supervised run.
 ``tools/supervise.py`` is the CLI; the ``runner``/``sleep`` seams exist
 so the policy is unit-testable without real processes
 (tests/test_resilience.py).
+
+:class:`SupervisorPolicy` is also the restart policy of the serving
+path's IN-PROCESS supervisor (``serve/supervise.py``): same backoff
+arithmetic and give-up bound, scoped to the dispatch thread instead of
+a child process, with serving-scale defaults (requests are waiting, so
+backoff starts at milliseconds).
 """
 
 from __future__ import annotations

@@ -49,6 +49,16 @@ class HangWatchdog:
     hard-exits with :data:`EXIT_HUNG` (tests inject a recording action
     instead). ``beat()`` is a single monotonic-clock store — cheap
     enough for the per-batch hot path.
+
+    The serving path (``serve/supervise.py``) embeds the same detector
+    against a wedged forward, with three departures from the training
+    defaults: ``gate`` (a stall only counts while the gate callable
+    returns True — an idle server blocked waiting for traffic is not
+    hung), ``rearm=True`` (after firing, the detector keeps polling; a
+    recovered heartbeat clears ``fired`` and re-arms it for the next
+    stall — serving survives a wedge, training dies from one), and
+    ``end_run_on_fire=False`` (record the ``watchdog`` flight event but
+    leave the run open: the serving flight record outlives a stall).
     """
 
     def __init__(
@@ -58,6 +68,9 @@ class HangWatchdog:
         action: Optional[Callable[[], None]] = None,
         poll_s: Optional[float] = None,
         warmup_beats: int = 2,
+        gate: Optional[Callable[[], bool]] = None,
+        rearm: bool = False,
+        end_run_on_fire: bool = True,
     ):
         if stall_s <= 0:
             raise ValueError(f"stall_s must be > 0, got {stall_s}")
@@ -65,6 +78,10 @@ class HangWatchdog:
         self.flight = flight
         self.action = action if action is not None else self._default_abort
         self.poll_s = float(poll_s) if poll_s else max(self.stall_s / 4.0, 0.05)
+        self.gate = gate
+        self.rearm = bool(rearm)
+        self.end_run_on_fire = bool(end_run_on_fire)
+        self.fire_count = 0
         # the watchdog ARMS only after this many beats: setup (imports,
         # model init) and the first train step's compile legitimately
         # block for longer than any reasonable stall threshold — the
@@ -79,6 +96,10 @@ class HangWatchdog:
     def beat(self) -> None:
         self._beats += 1
         self._last_beat = time.monotonic()
+
+    def heartbeat_age(self) -> float:
+        """Seconds since the last beat — the serving liveness signal."""
+        return time.monotonic() - self._last_beat
 
     @property
     def armed(self) -> bool:
@@ -106,19 +127,28 @@ class HangWatchdog:
             if not self.armed:
                 continue
             stalled = time.monotonic() - self._last_beat
-            if stalled >= self.stall_s:
+            if self.fired:
+                # rearm mode only reaches here: a fresh beat clears the
+                # stall and re-arms the detector for the next one
+                if stalled < self.stall_s:
+                    self.fired = False
+                continue
+            if stalled >= self.stall_s and (self.gate is None or self.gate()):
                 self._fire(stalled)
-                return
+                if not self.rearm:
+                    return
 
     def _fire(self, stalled: float) -> None:
         self.fired = True
+        self.fire_count += 1
         stacks = dump_thread_stacks()
         if self.flight is not None:
             self.flight.record(
                 "watchdog", stall_s=round(stalled, 3), stacks=stacks
             )
-            self.flight.end_run(status="hung", stall_s=round(stalled, 3))
-            self.flight.close()
+            if self.end_run_on_fire:
+                self.flight.end_run(status="hung", stall_s=round(stalled, 3))
+                self.flight.close()
         self.action()
 
     def _default_abort(self) -> None:

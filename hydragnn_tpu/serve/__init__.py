@@ -13,14 +13,24 @@ with four pieces:
   - :mod:`~hydragnn_tpu.serve.batcher` — a bounded deadline queue that
     coalesces single-graph requests into bucket batches;
   - :mod:`~hydragnn_tpu.serve.metrics` — the operator surface (per-
-    bucket traffic, occupancy, latency percentiles, compile hits/misses).
+    bucket traffic, occupancy, latency percentiles, compile hits/misses);
+  - :mod:`~hydragnn_tpu.serve.supervise` — the in-process dispatch
+    supervisor (bounded restart + re-armed hang watchdog) behind the
+    self-healing guarantees in docs/RESILIENCE.md "Serving resilience":
+    poison isolation (:class:`RequestFailed`), health/readiness probes
+    (``ModelServer.health``, ``tools/serve_probe.py``), and
+    zero-downtime reload (``ModelServer.reload``).
 
 Entry points: ``hydragnn_tpu.api.serve_model`` stands a server up from a
 trained run; :class:`ModelServer` composes the pieces for in-memory
 models (benches, tests).
 """
 
-from hydragnn_tpu.serve.batcher import MicroBatchQueue, Overloaded  # noqa: F401
+from hydragnn_tpu.serve.batcher import (  # noqa: F401
+    MicroBatchQueue,
+    Overloaded,
+    ServerClosed,
+)
 from hydragnn_tpu.serve.buckets import (  # noqa: F401
     Bucket,
     BucketCompileCache,
@@ -28,10 +38,17 @@ from hydragnn_tpu.serve.buckets import (  # noqa: F401
     route,
 )
 from hydragnn_tpu.serve.metrics import ServeMetrics, latency_percentiles  # noqa: F401
-from hydragnn_tpu.serve.registry import ModelRegistry, ServedModel  # noqa: F401
+from hydragnn_tpu.serve.registry import (  # noqa: F401
+    ModelRegistry,
+    ServedModel,
+    load_served_variables,
+)
 from hydragnn_tpu.serve.server import (  # noqa: F401
     ModelServer,
     Oversize,
+    ReloadFailed,
+    RequestFailed,
     ServeConfig,
     request_to_dict,
 )
+from hydragnn_tpu.serve.supervise import DispatchSupervisor  # noqa: F401

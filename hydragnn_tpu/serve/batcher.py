@@ -32,12 +32,18 @@ class Overloaded(RuntimeError):
     """The request queue is full — explicit load-shedding signal."""
 
 
+class ServerClosed(RuntimeError):
+    """Submission after close()/stop(): the typed immediate rejection —
+    never an enqueued future that can no longer resolve."""
+
+
 @dataclasses.dataclass
 class PendingRequest:
     item: Any
     future: Future
     t_enqueue: float  # time.monotonic() at admission
     bucket: int
+    seq: int = -1  # server-wide admission sequence number
 
 
 class MicroBatchQueue:
@@ -65,20 +71,22 @@ class MicroBatchQueue:
         self._count = 0
         self._closed = False
 
-    def put(self, bucket: int, item: Any) -> Future:
+    def put(self, bucket: int, item: Any, seq: int = -1) -> Future:
         """Admit one request into ``bucket``'s lane; returns its Future.
         Raises :class:`Overloaded` when the queue is at capacity and
-        RuntimeError after :meth:`close`."""
+        :class:`ServerClosed` after :meth:`close` — a closed queue must
+        reject immediately, never mint a future no consumer will ever
+        resolve."""
         fut: Future = Future()
         with self._cv:
             if self._closed:
-                raise RuntimeError("queue is closed")
+                raise ServerClosed("serving queue is closed")
             if self._count >= self._max_pending:
                 raise Overloaded(
                     f"serving queue full ({self._count}/{self._max_pending} pending)"
                 )
             self._pending[bucket].append(
-                PendingRequest(item, fut, time.monotonic(), bucket)
+                PendingRequest(item, fut, time.monotonic(), bucket, seq)
             )
             self._count += 1
             self._cv.notify_all()

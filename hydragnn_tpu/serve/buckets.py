@@ -131,6 +131,12 @@ class BucketCompileCache:
             if self._metrics is not None:
                 self._metrics.record_compile(hit=False, warmup=True)
 
+    def rebind(self, variables) -> None:
+        """Point future on-demand compiles at new weights (hot reload).
+        Existing executables are shape-specialized, not value-
+        specialized — they serve the new variables unchanged."""
+        self._variables = variables
+
     def executable(self, bucket: Bucket):
         """The pre-built executable for ``bucket``; compiles on demand
         (recorded as a MISS — this only happens if warmup was skipped)."""

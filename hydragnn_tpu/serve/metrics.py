@@ -87,6 +87,23 @@ class ServeMetrics:
         self._compile_warmup = r.counter(f"{p}.compile_warmup")
         self._compile_hits = r.counter(f"{p}.compile_hits")
         self._compile_misses = r.counter(f"{p}.compile_misses")
+        # resilience surface (docs/RESILIENCE.md "Serving resilience"):
+        # quarantined = requests failed with the typed RequestFailed
+        # (poison isolation), poison_retries = multi-request batches
+        # re-run as singles to localize a poison, dispatch_restarts =
+        # supervisor restarts of a dead dispatch thread, reloads /
+        # reload_failed = hot weight swaps (rolled back on failure)
+        self._quarantined = r.counter(f"{p}.quarantined")
+        self._poison_retries = r.counter(f"{p}.poison_retries")
+        self._dispatch_restarts = r.counter(f"{p}.dispatch_restarts")
+        self._reloads = r.counter(f"{p}.reloads")
+        self._reload_failed = r.counter(f"{p}.reload_failed")
+        # health gauges, refreshed by ModelServer.health() so the
+        # Prometheus textfile carries the probe signals
+        self._live = r.gauge(f"{p}.live")
+        self._ready = r.gauge(f"{p}.ready")
+        self._heartbeat_age = r.gauge(f"{p}.heartbeat_age_s")
+        self._warm_buckets = r.gauge(f"{p}.warm_buckets")
         self._latency = r.histogram(f"{p}.latency_s", window=latency_window)
         self._buckets = []
         for i in range(num_buckets):
@@ -148,6 +165,31 @@ class ServeMetrics:
     def record_error(self, n: int = 1) -> None:
         self._errors.inc(n)
 
+    def record_quarantine(self, n: int = 1) -> None:
+        self._quarantined.inc(n)
+
+    def record_poison_retry(self, n: int = 1) -> None:
+        self._poison_retries.inc(n)
+
+    def record_dispatch_restart(self) -> None:
+        self._dispatch_restarts.inc()
+
+    def record_reload(self, ok: bool) -> None:
+        (self._reloads if ok else self._reload_failed).inc()
+
+    def set_health(
+        self,
+        live: bool,
+        ready: bool,
+        heartbeat_age_s: Optional[float],
+        warm_buckets: int,
+    ) -> None:
+        self._live.set(1.0 if live else 0.0)
+        self._ready.set(1.0 if ready else 0.0)
+        if heartbeat_age_s is not None:
+            self._heartbeat_age.set(round(float(heartbeat_age_s), 3))
+        self._warm_buckets.set(warm_buckets)
+
     def observe_latency(self, seconds: float, n_results: int = 1) -> None:
         self._latency.observe(seconds)
         self._results.inc(n_results)
@@ -183,6 +225,13 @@ class ServeMetrics:
             "oversize_largest_bucket": self._oversize_largest.snapshot(),
             "oversize_eager": self._oversize_eager.snapshot(),
             "errors": self._errors.snapshot(),
+            "quarantined": self._quarantined.snapshot(),
+            "poison_retries": self._poison_retries.snapshot(),
+            "dispatch_restarts": self._dispatch_restarts.snapshot(),
+            "reloads": self._reloads.snapshot(),
+            "reload_failed": self._reload_failed.snapshot(),
+            "live": self._live.snapshot(),
+            "ready": self._ready.snapshot(),
             "queue_depth": self._queue_depth.snapshot(),
             "queue_depth_peak": int(self._queue_depth.peak),
             "compile_warmup": self._compile_warmup.snapshot(),

@@ -100,7 +100,14 @@ class ModelRegistry:
         ``<log_dir>/<log_name>/``. ``example_graph`` is one prepared
         sample (GraphSample or graph dict) — init only needs its feature
         shapes, not the serving pad plan. Idempotent per name: a second
-        load replaces the entry (checkpoint refresh)."""
+        load replaces the entry (checkpoint refresh).
+
+        The restore goes through the VALIDATING loader
+        (``load_existing_model``: sha256 sidecars, parse validation,
+        fallback down the retained ``.step<N>.mp`` versions with a loud
+        warning) — a torn/corrupt checkpoint pointer serves the newest
+        intact version instead of deserializing garbage into a warm
+        forward (pinned by tests/test_serve_resilience.py)."""
         from hydragnn_tpu.graph.batch import batch_graphs
         from hydragnn_tpu.models.create import create_model_config
         from hydragnn_tpu.serve.server import request_to_dict
@@ -139,3 +146,32 @@ class ModelRegistry:
     def names(self) -> List[str]:
         with self._lock:
             return sorted(self._models)
+
+
+def load_served_variables(
+    served: ServedModel, log_name: str, log_dir: str = "./logs/"
+) -> Dict[str, Any]:
+    """Fresh ``{'params', 'batch_stats'}`` for an ALREADY-served model,
+    restored through the validating checkpoint loader
+    (``utils/checkpoint.py:load_existing_model``: sha256 sidecars,
+    torn-pointer fallback down the retained versions, loud rejection
+    warnings) — the path :meth:`ModelServer.reload` uses so a corrupt
+    checkpoint pointer can never deserialize garbage into a warm
+    forward. The served model supplies the schema (its current
+    variables' pytree) and the optimizer chain (``nn_config``)."""
+    from hydragnn_tpu.train import create_eval_state, select_optimizer
+    from hydragnn_tpu.utils.checkpoint import load_existing_model
+
+    nn_config = served.nn_config
+    if nn_config is None:
+        raise ValueError(
+            f"served model {served.name!r} has no nn_config (registered "
+            "in-memory); reload it with explicit variables= instead"
+        )
+    tx = select_optimizer(
+        nn_config["Training"],
+        freeze_conv=bool(nn_config["Architecture"].get("freeze_conv_layers")),
+    )
+    state = create_eval_state(served.variables, tx)
+    state = load_existing_model(state, log_name, log_dir)
+    return {"params": state.params, "batch_stats": state.batch_stats}

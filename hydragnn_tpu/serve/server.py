@@ -18,11 +18,24 @@ of the padded outputs. Degradation is graceful by construction:
 Requests carry NO targets (there is nothing to supervise at inference
 time); the builder strips them so request batches and warmup batches
 share one pytree structure — an AOT executable is shape-exact.
+
+Resilience (docs/RESILIENCE.md "Serving resilience"): a request whose
+forward raises or returns non-finite values fails ONLY its own future
+with the typed :class:`RequestFailed` (multi-request batches are
+re-run once as singles to localize the poison; confirmed poisons are
+quarantined); the dispatch thread runs under an in-process restart
+supervisor with a re-armed hang watchdog (``serve/supervise.py``);
+:meth:`ModelServer.health` is the liveness/readiness probe surface
+(exported to the Prometheus textfile, read by ``tools/serve_probe.py``);
+and :meth:`ModelServer.reload` swaps in new weights with zero downtime
+— canary-validated against the existing bucket executables, rolled
+back on any failure.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import threading
 import time
 from concurrent.futures import Future
@@ -30,7 +43,13 @@ from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 
-from hydragnn_tpu.serve.batcher import MicroBatchQueue, Overloaded, PendingRequest
+from hydragnn_tpu.resilience import inject
+from hydragnn_tpu.serve.batcher import (
+    MicroBatchQueue,
+    Overloaded,
+    PendingRequest,
+    ServerClosed,
+)
 from hydragnn_tpu.serve.buckets import Bucket, BucketCompileCache, build_bucket_ladder, route
 from hydragnn_tpu.serve.metrics import ServeMetrics
 from hydragnn_tpu.serve.registry import ServedModel
@@ -38,6 +57,44 @@ from hydragnn_tpu.serve.registry import ServedModel
 
 class Oversize(RuntimeError):
     """Request exceeds every bucket and the eager fallback is disabled."""
+
+
+class RequestFailed(RuntimeError):
+    """One request's forward raised or produced non-finite outputs.
+
+    Only the offending request's future carries this — co-batched
+    requests and the dispatch loop are unaffected. ``seq`` is the
+    request's admission sequence number, ``reason`` is ``"exception"``
+    or ``"nonfinite"`` (``"dispatch"`` when the dispatch thread itself
+    died with the batch in hand)."""
+
+    def __init__(self, message: str, seq: int = -1, reason: str = "exception"):
+        super().__init__(message)
+        self.seq = seq
+        self.reason = reason
+
+
+class ReloadFailed(RuntimeError):
+    """A hot reload's candidate weights failed to load or failed the
+    canary; the previous weights are still serving (rollback)."""
+
+
+def _result_finite(result: Dict[str, np.ndarray]) -> bool:
+    return all(np.all(np.isfinite(v)) for v in result.values())
+
+
+def _corrupt_variables(variables: Dict[str, Any]) -> Dict[str, Any]:
+    """Torn-reload injection: NaN every float leaf — the canary must
+    reject this candidate and the old weights must keep serving."""
+    import jax
+
+    def nan_like(a):
+        arr = np.asarray(a)
+        if np.issubdtype(arr.dtype, np.floating):
+            return np.full_like(arr, np.nan)
+        return a
+
+    return jax.tree_util.tree_map(nan_like, variables)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -52,6 +109,22 @@ class ServeConfig:
       raises Overloaded (explicit backpressure).
     eager_fallback: compile-on-demand natural-pad path for graphs larger
       than every bucket plan; off -> such requests raise Oversize.
+    check_finite: scan each request's sliced outputs on the host and
+      fail non-finite ones with RequestFailed (poison isolation) — a
+      NaN answer is a corruption served as truth; off only if the
+      output-scan cost ever matters more than that.
+    dispatch_stall_s: watchdog threshold for a wedged forward (liveness
+      flips false after this long with a batch in flight and no beat).
+    max_dispatch_restarts / dispatch_backoff_*: the in-process restart
+      policy for a dead dispatch thread (SupervisorPolicy semantics,
+      serving-scale defaults — requests are waiting, so backoff starts
+      at 50 ms, not seconds).
+    ready_queue_highwater: readiness flips false when the queue holds
+      more than this fraction of max_pending (the orchestrator should
+      steer traffic away BEFORE submit starts raising Overloaded).
+    prometheus_path: when set, the supervisor's monitor thread writes
+      the health + metrics textfile there every prometheus_every_s —
+      the file ``tools/serve_probe.py`` probes.
     """
 
     max_batch: int = 8
@@ -62,6 +135,15 @@ class ServeConfig:
     edge_multiple: int = 8
     eager_fallback: bool = True
     latency_window: int = 2048
+    check_finite: bool = True
+    dispatch_stall_s: float = 30.0
+    max_dispatch_restarts: int = 5
+    dispatch_backoff_base_s: float = 0.05
+    dispatch_backoff_factor: float = 2.0
+    dispatch_backoff_max_s: float = 2.0
+    ready_queue_highwater: float = 0.9
+    prometheus_path: Optional[str] = None
+    prometheus_every_s: float = 5.0
 
 
 def request_to_dict(sample: Any) -> Dict[str, Any]:
@@ -152,8 +234,13 @@ class ModelServer:
         )
         self._eager_shapes: set = set()
         self._eager_lock = threading.Lock()
-        self._worker: Optional[threading.Thread] = None
         self._started = False
+        self._stopped = False
+        self._seq = itertools.count()  # admission sequence (injection anchor)
+        self._dispatched_batches = 0
+        self._reload_lock = threading.Lock()
+        self._supervisor = None  # built in start()
+        self.log_dir = "./logs/"  # reload()'s default checkpoint root
         # optional run flight recorder (hydragnn_tpu/obs/flight.py):
         # start() logs a serving manifest (bucket ladder, request spec),
         # stop() the final metrics snapshot — bench_serve.py passes one
@@ -169,9 +256,12 @@ class ModelServer:
 
     def start(self) -> "ModelServer":
         """AOT-compile the whole bucket ladder, then start the executor
-        thread. Returns self (``serve_model(...).start()`` chains)."""
+        thread under its supervisor. Returns self
+        (``serve_model(...).start()`` chains)."""
         if self._started:
             return self
+        if self._stopped:
+            raise ServerClosed("server was stopped; build a new one")
         t0 = time.monotonic()
         self._cache.warmup(self.buckets)
         self.flight.start_run(
@@ -192,23 +282,53 @@ class ModelServer:
                 "warmup_compile_s": round(time.monotonic() - t0, 3),
             }
         )
-        self._worker = threading.Thread(
-            target=self._run, name="hydragnn-serve-executor", daemon=True
+        from hydragnn_tpu.resilience.supervisor import SupervisorPolicy
+        from hydragnn_tpu.serve.supervise import DispatchSupervisor
+
+        cfg = self.config
+        self._supervisor = DispatchSupervisor(
+            self._run,
+            policy=SupervisorPolicy(
+                max_restarts=cfg.max_dispatch_restarts,
+                backoff_base_s=cfg.dispatch_backoff_base_s,
+                backoff_factor=cfg.dispatch_backoff_factor,
+                backoff_max_s=cfg.dispatch_backoff_max_s,
+            ),
+            stall_s=cfg.dispatch_stall_s,
+            flight=self.flight,
+            metrics=self.metrics,
+            on_giveup=self._on_dispatch_giveup,
+            on_tick=self._export_tick if cfg.prometheus_path else None,
+            tick_every_s=cfg.prometheus_every_s,
         )
-        self._worker.start()
         self._started = True
+        self._supervisor.start()
         return self
 
     def stop(self, timeout: Optional[float] = 10.0) -> None:
         """Stop admitting, drain what is queued, join the executor."""
         was_started = self._started
+        self._stopped = True
         self._queue.close()
-        if self._worker is not None:
-            self._worker.join(timeout)
-            self._worker = None
+        if self._supervisor is not None:
+            self._supervisor.stop(timeout)
         self._started = False
         if was_started:
             self.flight.end_run(status="stopped", metrics=self.metrics_snapshot())
+
+    def _on_dispatch_giveup(self, exc: BaseException) -> None:
+        """Restart budget exhausted: a loudly dead server. Close
+        admission (submit raises ServerClosed) and fail everything
+        queued with the typed error — zero silently wedged futures."""
+        self._queue.close()
+        self._queue.cancel_pending(
+            RequestFailed(
+                f"dispatch supervisor gave up after "
+                f"{self.config.max_dispatch_restarts} restarts: {exc!r}",
+                reason="dispatch",
+            )
+        )
+        self.flight.error(exc, where="dispatch_giveup")
 
     def __enter__(self) -> "ModelServer":
         return self.start()
@@ -222,22 +342,27 @@ class ModelServer:
         """Admit one graph; returns a Future resolving to
         ``{head_name: np.ndarray}`` (graph heads: [d]; node heads:
         [n_nodes, d], this graph's rows only). Raises Overloaded on
-        backpressure and Oversize when nothing can take the graph."""
+        backpressure, Oversize when nothing can take the graph, and
+        ServerClosed after stop() — typed and immediate, never a future
+        that can no longer resolve."""
+        if self._stopped or (self._supervisor is not None and self._supervisor.failed):
+            raise ServerClosed("server is stopped; submissions are rejected")
         if not self._started:
             raise RuntimeError("server not started (call start())")
         g = self._validated(request_to_dict(sample))
         n, e = _dict_sizes(g)
+        seq = next(self._seq)
         bucket = route(self.buckets, n, e)
         if bucket is not None:
             self.metrics.record_request(bucket.index)
             try:
-                fut = self._queue.put(bucket.index, g)
+                fut = self._queue.put(bucket.index, g, seq=seq)
             except Overloaded:
                 self.metrics.record_reject()
                 raise
             self.metrics.set_queue_depth(self._queue.depth())
             return fut
-        return self._submit_oversize(g, n, e)
+        return self._submit_oversize(g, n, e, seq)
 
     def predict(self, sample: Any, timeout: Optional[float] = None) -> Dict[str, np.ndarray]:
         """Blocking single-request convenience around :meth:`submit`."""
@@ -252,17 +377,160 @@ class ModelServer:
     def metrics_snapshot(self) -> dict:
         return self.metrics.snapshot()
 
+    # -- health / probes ---------------------------------------------------
+
+    def health(self) -> Dict[str, Any]:
+        """Liveness/readiness probe surface (exported to Prometheus as
+        ``serve.live`` / ``serve.ready`` gauges; ``tools/serve_probe.py``
+        turns the textfile into an exit code for an orchestrator).
+
+        Liveness = the dispatch loop exists and is beating (a wedged
+        forward past ``dispatch_stall_s`` flips it false; a supervisor
+        give-up keeps it false). Readiness = live AND every bucket's
+        executable is warm AND the queue is below the high-water mark —
+        "send me traffic", not just "don't kill me"."""
+        sup = self._supervisor
+        started = self._started and not self._stopped
+        alive = bool(sup is not None and sup.alive)
+        stalled = bool(sup is not None and sup.stalled)
+        failed = bool(sup is not None and sup.failed)
+        hb_age = sup.heartbeat_age() if sup is not None else None
+        live = started and alive and not stalled and not failed
+        warm = len(self._cache)
+        depth = self._queue.depth()
+        highwater = max(1, int(self.config.ready_queue_highwater * self.config.max_pending))
+        ready = live and warm >= len(self.buckets) and depth < highwater
+        reasons = []
+        if not started:
+            reasons.append("not started" if not self._stopped else "stopped")
+        if started and not alive:
+            reasons.append("dispatch thread down")
+        if stalled:
+            reasons.append(f"dispatch stalled (heartbeat {hb_age:.1f}s)")
+        if failed:
+            reasons.append("dispatch supervisor gave up")
+        if warm < len(self.buckets):
+            reasons.append(f"buckets warming ({warm}/{len(self.buckets)})")
+        if depth >= highwater:
+            reasons.append(f"queue over high-water ({depth}/{highwater})")
+        self.metrics.set_health(live, ready, hb_age, warm)
+        return {
+            "live": live,
+            "ready": ready,
+            "dispatch_alive": alive,
+            "dispatch_stalled": stalled,
+            "dispatch_failed": failed,
+            "heartbeat_age_s": round(hb_age, 3) if hb_age is not None else None,
+            "warm_buckets": warm,
+            "num_buckets": len(self.buckets),
+            "queue_depth": depth,
+            "queue_highwater": highwater,
+            "dispatch_restarts": sup.restarts if sup is not None else 0,
+            "reasons": reasons,
+        }
+
     def export_prometheus(self, path: str) -> None:
         """Write this server's metrics as a Prometheus textfile snapshot
         (atomic rename; point a node-exporter textfile collector at it
-        and scrape — no HTTP server in-process)."""
+        and scrape — no HTTP server in-process). Refreshes the health
+        gauges first so the probe signals are current."""
         from hydragnn_tpu.obs.export import registry_to_prometheus
 
+        self.health()
         registry_to_prometheus(self.metrics.registry, path)
+
+    def _export_tick(self) -> None:
+        """Periodic textfile export from the supervisor's monitor thread
+        (``ServeConfig.prometheus_path`` / ``prometheus_every_s``)."""
+        self.export_prometheus(self.config.prometheus_path)
+
+    # -- zero-downtime reload ----------------------------------------------
+
+    def reload(
+        self,
+        checkpoint: Optional[str] = None,
+        *,
+        variables: Optional[Dict[str, Any]] = None,
+        log_dir: Optional[str] = None,
+    ) -> Dict[str, Any]:
+        """Swap in new weights without dropping traffic or recompiling.
+
+        ``checkpoint`` is a run name restored through the VALIDATING
+        loader (sha256 sidecars, torn-pointer fallback — the PR 3
+        integrity path) under ``log_dir`` (default: the server's
+        ``log_dir``, set by ``api.serve_model``); or pass ``variables``
+        directly (same pytree/shapes — benches, tests, an in-process
+        trainer). The candidate runs a CANARY first: every bucket's
+        already-compiled executable is invoked with the new weights on
+        its warmup batch and must return all-finite outputs — a shape
+        mismatch or NaN weights fail HERE, never on live traffic. Only
+        then is the forward swapped (one atomic reference store; the old
+        weights keep answering everything dispatched before the swap).
+        Any failure rolls back: the old weights are untouched,
+        ``reload_failed`` is recorded, and :class:`ReloadFailed` raises.
+
+        Zero-downtime by construction: the server stays READY throughout
+        — no queue pause, no executable rebuild (an AOT executable is
+        specialized to shapes, not values, so same-architecture weights
+        reuse the whole warm ladder: 0 compile misses)."""
+        if (checkpoint is None) == (variables is None):
+            raise ValueError("pass exactly one of checkpoint= or variables=")
+        source = checkpoint if checkpoint is not None else "<variables>"
+        with self._reload_lock:
+            t0 = time.monotonic()
+            try:
+                if checkpoint is not None:
+                    from hydragnn_tpu.serve.registry import load_served_variables
+
+                    new_vars = load_served_variables(
+                        self.served, checkpoint, log_dir or self.log_dir
+                    )
+                else:
+                    new_vars = dict(variables)
+                if inject.serve_torn_reload():
+                    new_vars = _corrupt_variables(new_vars)
+                self._canary(new_vars)
+            except Exception as exc:
+                self.metrics.record_reload(ok=False)
+                self.flight.record(
+                    "reload_failed",
+                    source=source,
+                    error=repr(exc)[-300:],
+                    rolled_back=True,
+                )
+                raise ReloadFailed(
+                    f"reload from {source!r} failed ({exc!r}); previous "
+                    "weights still serving"
+                ) from exc
+            # the swap: one reference store the dispatch thread picks up
+            # on its next batch (in-flight batches finish on old weights)
+            self.served.variables = new_vars
+            self._cache.rebind(new_vars)
+            self.metrics.record_reload(ok=True)
+            info = {
+                "source": source,
+                "canary_buckets": len(self.buckets),
+                "swap_s": round(time.monotonic() - t0, 3),
+            }
+            self.flight.record("reload", **info)
+            return info
+
+    def _canary(self, new_vars: Dict[str, Any]) -> None:
+        """Candidate-weight gate: every bucket's compiled executable on
+        its warmup batch, all outputs finite, or the reload fails."""
+        for b in self.buckets:
+            exe = self._cache.executable(b)
+            outs = exe(new_vars, self._build_warm_batch(b))
+            for i, o in enumerate(outs):
+                if not np.all(np.isfinite(np.asarray(o))):
+                    raise ReloadFailed(
+                        f"canary produced non-finite outputs (bucket "
+                        f"{b.index}, head {i}) — candidate weights rejected"
+                    )
 
     # -- oversize fallbacks ------------------------------------------------
 
-    def _submit_oversize(self, g: Dict[str, Any], n: int, e: int) -> Future:
+    def _submit_oversize(self, g: Dict[str, Any], n: int, e: int, seq: int) -> Future:
         self.metrics.record_request(None)
         fut: Future = Future()
         largest = self.buckets[-1]
@@ -272,13 +540,8 @@ class ModelServer:
             # unbatched on the ALREADY-COMPILED largest bucket
             self.metrics.record_oversize("largest_bucket")
             t0 = time.monotonic()
-            reqs = [PendingRequest(g, fut, t0, largest.index)]
-            try:
-                self._execute_bucket(largest.index, reqs, reason="oversize")
-            except BaseException as exc:
-                self.metrics.record_error()
-                if not fut.done():
-                    fut.set_exception(exc)
+            reqs = [PendingRequest(g, fut, t0, largest.index, seq)]
+            self._execute_bucket(largest.index, reqs, reason="oversize")
             return fut
         if not self.config.eager_fallback:
             self.metrics.record_error()
@@ -293,20 +556,28 @@ class ModelServer:
         self.metrics.record_oversize("eager")
         t0 = time.monotonic()
         try:
-            result = self._execute_eager(g)
+            result = self._execute_eager(g, seq)
+            if not _result_finite(result) and self.config.check_finite:
+                self._quarantine(
+                    PendingRequest(g, fut, t0, -1, seq), None, "nonfinite", None
+                )
+                return fut
             fut.set_result(result)
             self.metrics.observe_latency(time.monotonic() - t0)
-        except BaseException as exc:
+        except Oversize as exc:
             self.metrics.record_error()
             fut.set_exception(exc)
+        except BaseException as exc:
+            self._quarantine(PendingRequest(g, fut, t0, -1, seq), None, "exception", exc)
         return fut
 
-    def _execute_eager(self, g: Dict[str, Any]) -> Dict[str, np.ndarray]:
+    def _execute_eager(self, g: Dict[str, Any], seq: int) -> Dict[str, np.ndarray]:
         """Natural-pad unbatched call through the plain jit cache. Each
         NEW padded shape is a fresh XLA compile — recorded as a
         compile-cache miss; repeats of a shape hit jit's own cache."""
         from hydragnn_tpu.graph.batch import batch_graphs
 
+        inject.maybe_serve_raise([seq])
         batch = batch_graphs(
             [g],
             node_multiple=self.config.node_multiple,
@@ -318,54 +589,154 @@ class ModelServer:
             self._eager_shapes.add(shape_key)
         self.metrics.record_compile(hit=seen)
         outputs = self.served.forward(self.served.variables, batch)
+        outputs = inject.maybe_serve_nan([np.asarray(o) for o in outputs], [seq])
         n, _ = _dict_sizes(g)
         return self._slice_result(outputs, graph_index=0, node_offset=0, num_nodes=n)
 
     # -- executor ----------------------------------------------------------
 
     def _run(self) -> None:
+        sup = self._supervisor
         while True:
+            sup.beat()
             got = self._queue.take_batch()
             if got is None:
                 return
             bucket_index, requests, reason = got
             self.metrics.set_queue_depth(self._queue.depth())
+            self._dispatched_batches += 1
+            sup.busy(True)
+            sup.beat()
             try:
+                # thread-death injection fires OUTSIDE request isolation
+                inject.maybe_serve_kill_dispatch(self._dispatched_batches)
                 self._execute_bucket(bucket_index, requests, reason)
-            except BaseException as exc:  # surface to every caller, keep serving
+            except BaseException as exc:
+                # anything escaping here is dispatch-level (request
+                # failures were isolated below): resolve the in-hand
+                # futures with the typed error, then die loudly so the
+                # supervisor restarts the loop
                 self.metrics.record_error(len(requests))
                 for r in requests:
                     if not r.future.done():
-                        r.future.set_exception(exc)
+                        r.future.set_exception(
+                            RequestFailed(
+                                f"dispatch thread died with this batch in "
+                                f"hand: {exc!r}",
+                                seq=r.seq,
+                                reason="dispatch",
+                            )
+                        )
+                raise
+            finally:
+                sup.busy(False)
+                sup.beat()
 
     def _execute_bucket(
-        self, bucket_index: int, requests: List[PendingRequest], reason: str
+        self,
+        bucket_index: int,
+        requests: List[PendingRequest],
+        reason: str,
+        singles_retry: bool = True,
     ) -> None:
+        """Run one coalesced batch with poison isolation: a failure
+        (exception or non-finite outputs) fails only the offending
+        requests' futures, never the caller. Multi-request batches are
+        re-run once as singles to localize the poison; confirmed
+        single-request failures are quarantined."""
         from hydragnn_tpu.graph.batch import batch_graphs
 
         bucket = self.buckets[bucket_index]
-        dicts = [r.item for r in requests]
-        batch = batch_graphs(
-            dicts,
-            n_node_pad=bucket.node_pad,
-            n_edge_pad=bucket.edge_pad,
-            n_graph_pad=bucket.graph_pad,
-        )
-        exe = self._cache.executable(bucket)
-        outputs = [np.asarray(o) for o in exe(self.served.variables, batch)]
+        seqs = [r.seq for r in requests]
+        try:
+            inject.maybe_serve_wedge(seqs)
+            inject.maybe_serve_raise(seqs)
+            batch = batch_graphs(
+                [r.item for r in requests],
+                n_node_pad=bucket.node_pad,
+                n_edge_pad=bucket.edge_pad,
+                n_graph_pad=bucket.graph_pad,
+            )
+            exe = self._cache.executable(bucket)
+            outputs = [np.asarray(o) for o in exe(self.served.variables, batch)]
+            outputs = inject.maybe_serve_nan(outputs, seqs)
+        except Exception as exc:
+            self._isolate_failure(
+                bucket_index, requests, "exception", exc, singles_retry
+            )
+            return
         self.metrics.record_batch(
             bucket_index, len(requests), bucket.max_batch, reason
         )
         t_done = time.monotonic()
         node_offset = 0
+        poisoned: List[PendingRequest] = []
         for gi, r in enumerate(requests):
             n, _ = _dict_sizes(r.item)
             result = self._slice_result(
                 outputs, graph_index=gi, node_offset=node_offset, num_nodes=n
             )
             node_offset += n
-            r.future.set_result(result)
-            self.metrics.observe_latency(t_done - r.t_enqueue)
+            if self.config.check_finite and not _result_finite(result):
+                poisoned.append(r)
+                continue
+            if not r.future.done():
+                r.future.set_result(result)
+                self.metrics.observe_latency(t_done - r.t_enqueue)
+        if poisoned:
+            self._isolate_failure(
+                bucket_index, poisoned, "nonfinite", None, singles_retry
+            )
+
+    def _isolate_failure(
+        self,
+        bucket_index: int,
+        requests: List[PendingRequest],
+        kind: str,
+        exc: Optional[BaseException],
+        singles_retry: bool,
+    ) -> None:
+        if len(requests) > 1 and singles_retry:
+            # a co-batched failure cannot be attributed: re-run each
+            # request alone on the same (already compiled) bucket — the
+            # poison fails again and is quarantined, innocents succeed
+            self.metrics.record_poison_retry(len(requests))
+            for r in requests:
+                self._execute_bucket(
+                    bucket_index, [r], "retry_single", singles_retry=False
+                )
+            return
+        for r in requests:
+            self._quarantine(r, bucket_index, kind, exc)
+
+    def _quarantine(
+        self,
+        r: PendingRequest,
+        bucket_index: Optional[int],
+        kind: str,
+        exc: Optional[BaseException],
+    ) -> None:
+        """Fail ONE request's future with the typed error + evidence:
+        the ``serve.quarantined`` counter and a ``quarantine`` flight
+        event (docs/RESILIENCE.md failure matrix)."""
+        self.metrics.record_quarantine()
+        self.metrics.record_error()
+        detail = repr(exc) if exc is not None else "non-finite outputs"
+        self.flight.record(
+            "quarantine",
+            seq=r.seq,
+            reason=kind,
+            bucket=bucket_index,
+            error=detail[-300:],
+        )
+        if not r.future.done():
+            r.future.set_exception(
+                RequestFailed(
+                    f"request seq={r.seq} quarantined ({kind}): {detail}",
+                    seq=r.seq,
+                    reason=kind,
+                )
+            )
 
     def _slice_result(
         self, outputs, graph_index: int, node_offset: int, num_nodes: int

@@ -1,0 +1,219 @@
+"""In-process dispatch supervisor: the serving analog of the restart
+supervisor that keeps training alive (``resilience/supervisor.py``).
+
+One thread executes every batch the micro-batcher coalesces; if that
+thread dies, every queued future wedges silently and the server looks
+healthy from the outside — the exact failure PR 3 taught training to
+survive. The supervisor closes that gap with the same two mechanisms,
+scoped to a thread instead of a process:
+
+  - **bounded restart with backoff** — the dispatch target runs under a
+    wrapper that captures any escaping exception; a monitor thread
+    notices the death, records a ``dispatch_restart`` flight event,
+    waits out the :class:`~hydragnn_tpu.resilience.supervisor.
+    SupervisorPolicy` backoff (the training policy's arithmetic,
+    serving-scale defaults), and starts a fresh thread. Past
+    ``max_restarts`` it gives up: the ``on_giveup`` callback fails every
+    pending future with a typed error and closes admission — a loudly
+    dead server, not a silently wedged one.
+  - **re-armed hang watchdog** — the PR 3
+    :class:`~hydragnn_tpu.resilience.watchdog.HangWatchdog` fed a
+    heartbeat from the dispatch loop, gated on the loop being BUSY (an
+    idle server blocked on the queue is not hung) and re-arming after a
+    stall clears (a wedged forward that eventually returns resumes
+    service; the stall is evidence in the flight record, not a death
+    sentence). While stalled, liveness is False — the orchestrator's
+    probe sees a wedged server even though the process is fine.
+
+The monitor doubles as the health-export ticker: ``on_tick`` runs every
+``tick_every_s`` (ModelServer points it at the Prometheus textfile
+writer so ``tools/serve_probe.py`` always reads a fresh snapshot).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+from hydragnn_tpu.resilience.supervisor import SupervisorPolicy
+from hydragnn_tpu.resilience.watchdog import HangWatchdog
+
+
+class DispatchSupervisor:
+    """Supervise one dispatch-loop thread.
+
+    ``target`` is the dispatch loop; a normal return is a clean
+    shutdown (queue closed + drained) and is never restarted. The loop
+    must call :meth:`beat` once per iteration and bracket device work
+    with ``busy(True)`` / ``busy(False)`` so the watchdog only counts a
+    stall while a forward is actually in flight.
+    """
+
+    def __init__(
+        self,
+        target: Callable[[], None],
+        policy: Optional[SupervisorPolicy] = None,
+        stall_s: float = 30.0,
+        flight=None,
+        metrics=None,
+        on_giveup: Optional[Callable[[BaseException], None]] = None,
+        on_stall_change: Optional[Callable[[bool], None]] = None,
+        on_tick: Optional[Callable[[], None]] = None,
+        tick_every_s: float = 5.0,
+        poll_s: float = 0.05,
+        thread_name: str = "hydragnn-serve-executor",
+    ):
+        self._target = target
+        self.policy = policy or SupervisorPolicy()
+        self.flight = flight
+        self.metrics = metrics
+        self.on_giveup = on_giveup
+        self.on_stall_change = on_stall_change
+        self.on_tick = on_tick
+        self.tick_every_s = float(tick_every_s)
+        self.poll_s = float(poll_s)
+        self.thread_name = thread_name
+        self.restarts = 0
+        self.failed = False
+        self.last_error: Optional[BaseException] = None
+        self._busy = False
+        self._was_stalled = False
+        self._clean_exit = False
+        self._stopping = False
+        self._worker: Optional[threading.Thread] = None
+        self._monitor: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.watchdog = HangWatchdog(
+            stall_s,
+            flight=flight,
+            action=lambda: None,  # fired state IS the signal; health reads it
+            gate=lambda: self._busy,
+            rearm=True,
+            end_run_on_fire=False,
+            warmup_beats=0,
+        )
+
+    # -- signals from the dispatch loop ------------------------------------
+
+    def beat(self) -> None:
+        self.watchdog.beat()
+
+    def busy(self, flag: bool) -> None:
+        self._busy = bool(flag)
+
+    # -- health surface ----------------------------------------------------
+
+    @property
+    def alive(self) -> bool:
+        return self._worker is not None and self._worker.is_alive()
+
+    @property
+    def stalled(self) -> bool:
+        return bool(self.watchdog.fired)
+
+    def heartbeat_age(self) -> float:
+        return self.watchdog.heartbeat_age()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "DispatchSupervisor":
+        if self._monitor is not None:
+            return self
+        self.watchdog.beat()
+        self._spawn_worker()
+        self.watchdog.start()
+        self._monitor = threading.Thread(
+            target=self._run_monitor, name=f"{self.thread_name}-supervisor",
+            daemon=True,
+        )
+        self._monitor.start()
+        return self
+
+    def stop(self, timeout: Optional[float] = 10.0) -> None:
+        """Join the worker (the caller closes the queue first so it
+        exits its loop), then stop the monitor and watchdog."""
+        self._stopping = True
+        if self._worker is not None:
+            self._worker.join(timeout)
+        self._stop.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout=2.0)
+            self._monitor = None
+        self.watchdog.stop()
+        self._worker = None
+
+    # -- internals ---------------------------------------------------------
+
+    def _spawn_worker(self) -> None:
+        self._clean_exit = False
+        self._worker = threading.Thread(
+            target=self._wrapped, name=self.thread_name, daemon=True
+        )
+        self._worker.start()
+
+    def _wrapped(self) -> None:
+        try:
+            self._target()
+            self._clean_exit = True
+        except BaseException as exc:  # noqa: BLE001 - monitor classifies
+            self.last_error = exc
+        finally:
+            self._busy = False
+
+    def _run_monitor(self) -> None:
+        last_tick = time.monotonic()
+        while not self._stop.wait(self.poll_s):
+            now = time.monotonic()
+            if self.on_tick is not None and now - last_tick >= self.tick_every_s:
+                last_tick = now
+                try:
+                    self.on_tick()
+                except Exception:
+                    pass  # an export failure must never stop supervision
+            stalled = self.stalled
+            if stalled != self._was_stalled:
+                self._was_stalled = stalled
+                if self.on_stall_change is not None:
+                    self.on_stall_change(stalled)
+            if self._stopping or self.failed:
+                continue
+            worker = self._worker
+            if worker is not None and not worker.is_alive() and not self._clean_exit:
+                self._handle_crash()
+
+    def _handle_crash(self) -> None:
+        exc = self.last_error or RuntimeError("dispatch thread died")
+        self.restarts += 1
+        if self.metrics is not None:
+            self.metrics.record_dispatch_restart()
+        if self.restarts > self.policy.max_restarts:
+            self.failed = True
+            if self.flight is not None:
+                self.flight.record(
+                    "dispatch_restart",
+                    attempt=self.restarts,
+                    cause="gave_up",
+                    error=str(exc)[-300:],
+                )
+            if self.on_giveup is not None:
+                self.on_giveup(exc)
+            return
+        delay = self.policy.backoff(self.restarts)
+        if self.flight is not None:
+            self.flight.record(
+                "dispatch_restart",
+                attempt=self.restarts,
+                cause="crash",
+                error=str(exc)[-300:],
+                delay_s=delay,
+            )
+        # bounded backoff sleep, abandoned promptly if the server stops
+        deadline = time.monotonic() + delay
+        while time.monotonic() < deadline:
+            if self._stop.wait(min(self.poll_s, 0.05)):
+                return
+            if self._stopping:
+                return
+        self.watchdog.beat()  # a fresh thread starts with a fresh heartbeat
+        self._spawn_worker()

@@ -1,0 +1,524 @@
+"""Serving-resilience tests (docs/RESILIENCE.md "Serving resilience"):
+poison-request isolation (typed RequestFailed, retry-as-singles,
+quarantine), supervised dispatch (thread death -> bounded restart;
+wedged forward -> re-armed watchdog), health/readiness probes +
+serve_probe exit codes, typed ServerClosed after stop (incl. the
+submit-vs-stop race), zero-downtime reload with canary + rollback, and
+the registry's torn-checkpoint fallback.
+
+Every fault here is driven deterministically through
+``HYDRAGNN_INJECT_SERVE_*`` (hydragnn_tpu/resilience/inject.py); the
+chaos composition of all of them lives in ``bench_serve.py --chaos``.
+"""
+
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from hydragnn_tpu.obs import FlightRecorder
+from hydragnn_tpu.obs.flight import (
+    flight_record_warnings,
+    read_flight_record,
+    validate_flight_record,
+)
+from hydragnn_tpu.serve import (
+    MicroBatchQueue,
+    ModelRegistry,
+    ModelServer,
+    Overloaded,
+    ReloadFailed,
+    RequestFailed,
+    ServeConfig,
+    ServerClosed,
+)
+
+REPO = __file__.rsplit("/", 2)[0]
+
+
+@pytest.fixture(scope="module")
+def served_setup():
+    """Smoke-sized PNA multihead (+ completed config for the registry
+    tests), registered once for the module."""
+    from hydragnn_tpu.flagship import build_flagship
+
+    config, model, variables, loader = build_flagship(
+        n_samples=24,
+        hidden_dim=8,
+        num_conv_layers=2,
+        batch_size=4,
+        unit_cells=(2, 3),
+    )
+    registry = ModelRegistry()
+    served = registry.register("resilience_smoke", model, variables)
+    return config, served, list(loader.all_samples)
+
+
+def _direct_forward(served, sample):
+    from hydragnn_tpu.graph.batch import batch_graphs
+    from hydragnn_tpu.serve import request_to_dict
+
+    g = request_to_dict(sample)
+    batch = batch_graphs([g])
+    outputs = served.forward(served.variables, batch)
+    cfg = served.cfg
+    n = int(np.asarray(g["x"]).shape[0])
+    out = {}
+    for ihead in range(cfg.num_heads):
+        o = np.asarray(outputs[ihead])
+        if cfg.output_type[ihead] == "graph":
+            out[cfg.output_names[ihead]] = o[0]
+        else:
+            out[cfg.output_names[ihead]] = o[:n]
+    return out
+
+
+def _assert_result_close(got, want):
+    assert sorted(got) == sorted(want)
+    for k in want:
+        np.testing.assert_allclose(got[k], want[k], rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# poison-request isolation
+# ---------------------------------------------------------------------------
+
+
+def test_poison_raise_fails_only_its_future(served_setup, monkeypatch, tmp_path):
+    _, served, samples = served_setup
+    monkeypatch.setenv("HYDRAGNN_INJECT_SERVE_RAISE", "1")
+    flight = FlightRecorder(str(tmp_path / "flight.jsonl"))
+    with ModelServer(
+        served,
+        samples,
+        # long deadline: all four requests coalesce into ONE batch, so
+        # the poison must be localized by the retry-as-singles hunt
+        ServeConfig(max_batch=4, max_delay_ms=200.0),
+        flight=flight,
+    ) as server:
+        futs = [server.submit(s) for s in samples[:4]]
+        outcomes = []
+        for f in futs:
+            try:
+                outcomes.append(("ok", f.result(timeout=120)))
+            except RequestFailed as exc:
+                outcomes.append(("failed", exc))
+        snap = server.metrics_snapshot()
+        # the server keeps serving after the poison
+        monkeypatch.delenv("HYDRAGNN_INJECT_SERVE_RAISE")
+        _assert_result_close(
+            server.predict(samples[0], timeout=120),
+            _direct_forward(served, samples[0]),
+        )
+        assert server.health()["ready"]
+    kinds = [o[0] for o in outcomes]
+    assert kinds.count("failed") == 1 and kinds[1] == "failed"
+    exc = outcomes[1][1]
+    assert exc.seq == 1 and exc.reason == "exception"
+    for i in (0, 2, 3):
+        _assert_result_close(outcomes[i][1], _direct_forward(served, samples[i]))
+    assert snap["quarantined"] == 1
+    assert snap["poison_retries"] >= 2  # the co-batched requests re-ran alone
+    assert snap["compile_misses"] == 0  # retries used the warm bucket
+    events = read_flight_record(str(tmp_path / "flight.jsonl"))
+    quar = [e for e in events if e.get("kind") == "quarantine"]
+    assert len(quar) == 1 and quar[0]["seq"] == 1 and quar[0]["reason"] == "exception"
+
+
+def test_poison_nan_output_quarantined(served_setup, monkeypatch):
+    _, served, samples = served_setup
+    monkeypatch.setenv("HYDRAGNN_INJECT_SERVE_NAN", "2")
+    with ModelServer(
+        served, samples, ServeConfig(max_batch=4, max_delay_ms=200.0)
+    ) as server:
+        futs = [server.submit(s) for s in samples[:4]]
+        failed = {}
+        for i, f in enumerate(futs):
+            try:
+                _assert_result_close(
+                    f.result(timeout=120), _direct_forward(served, samples[i])
+                )
+            except RequestFailed as exc:
+                failed[i] = exc
+        snap = server.metrics_snapshot()
+    assert list(failed) == [2]
+    assert failed[2].reason == "nonfinite"
+    assert snap["quarantined"] == 1 and snap["errors"] == 1
+
+
+def test_single_request_batch_quarantined_directly(served_setup, monkeypatch):
+    _, served, samples = served_setup
+    monkeypatch.setenv("HYDRAGNN_INJECT_SERVE_RAISE", "0")
+    with ModelServer(
+        served, samples, ServeConfig(max_batch=4, max_delay_ms=5.0)
+    ) as server:
+        with pytest.raises(RequestFailed):
+            server.predict(samples[0], timeout=120)
+        snap = server.metrics_snapshot()
+        assert snap["quarantined"] == 1
+        # a single-request batch is quarantined without a retry pass
+        assert snap["poison_retries"] == 0
+
+
+# ---------------------------------------------------------------------------
+# supervised dispatch: thread death + wedged forward
+# ---------------------------------------------------------------------------
+
+
+def test_dispatch_death_recovery(served_setup, monkeypatch, tmp_path):
+    _, served, samples = served_setup
+    monkeypatch.setenv("HYDRAGNN_INJECT_SERVE_KILL_DISPATCH", "2")
+    flight = FlightRecorder(str(tmp_path / "flight.jsonl"))
+    server = ModelServer(
+        served,
+        samples,
+        ServeConfig(
+            max_batch=2,
+            max_delay_ms=10.0,
+            dispatch_backoff_base_s=0.5,  # wide enough to observe not-ready
+        ),
+        flight=flight,
+    )
+    server.start()
+    try:
+        futs = [server.submit(s) for s in samples[:8]]
+        # readiness must flip false (thread down, in backoff) -> true
+        saw_not_ready = saw_ready_again = False
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            ready = server.health()["ready"]
+            if not ready:
+                saw_not_ready = True
+            elif saw_not_ready:
+                saw_ready_again = True
+                break
+            time.sleep(0.005)
+        results, dispatch_failed = 0, 0
+        for f in futs:
+            try:
+                f.result(timeout=120)
+                results += 1
+            except RequestFailed as exc:
+                assert exc.reason == "dispatch"
+                dispatch_failed += 1
+        assert saw_not_ready and saw_ready_again
+        # the killed batch's futures resolved with the typed error; the
+        # rest were served by the restarted thread
+        assert dispatch_failed >= 1 and results + dispatch_failed == 8
+        # post-recovery traffic hits the warm compile cache
+        misses_before = server.metrics_snapshot()["compile_misses"]
+        _assert_result_close(
+            server.predict(samples[0], timeout=120),
+            _direct_forward(served, samples[0]),
+        )
+        snap = server.metrics_snapshot()
+        assert snap["compile_misses"] == misses_before == 0
+        assert snap["dispatch_restarts"] == 1
+        assert server.health()["dispatch_restarts"] == 1
+    finally:
+        server.stop()
+    events = read_flight_record(str(tmp_path / "flight.jsonl"))
+    restarts = [e for e in events if e.get("kind") == "dispatch_restart"]
+    assert len(restarts) == 1 and restarts[0]["cause"] == "crash"
+
+
+def test_wedged_dispatch_flips_liveness_then_recovers(
+    served_setup, monkeypatch, tmp_path
+):
+    from hydragnn_tpu.resilience import inject
+
+    _, served, samples = served_setup
+    monkeypatch.setattr(inject, "_SERVE_WEDGED", False)
+    monkeypatch.setenv("HYDRAGNN_INJECT_SERVE_WEDGE", "1:1")
+    flight = FlightRecorder(str(tmp_path / "flight.jsonl"))
+    with ModelServer(
+        served,
+        samples,
+        ServeConfig(max_batch=4, max_delay_ms=50.0, dispatch_stall_s=0.2),
+        flight=flight,
+    ) as server:
+        futs = [server.submit(s) for s in samples[:4]]
+        saw_stalled = False
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            h = server.health()
+            if h["dispatch_stalled"]:
+                saw_stalled = True
+                assert not h["live"] and not h["ready"]
+                break
+            time.sleep(0.01)
+        # the wedge ends; every future still resolves with a result
+        for i, f in enumerate(futs):
+            _assert_result_close(
+                f.result(timeout=120), _direct_forward(served, samples[i])
+            )
+        assert saw_stalled, "watchdog never flagged the wedged forward"
+        deadline = time.monotonic() + 5.0
+        while not server.health()["ready"] and time.monotonic() < deadline:
+            time.sleep(0.01)
+        h = server.health()
+        assert h["ready"] and not h["dispatch_stalled"]  # re-armed, recovered
+        assert server.metrics_snapshot()["dispatch_restarts"] == 0  # no restart
+    events = read_flight_record(str(tmp_path / "flight.jsonl"))
+    wd = [e for e in events if e.get("kind") == "watchdog"]
+    assert len(wd) == 1 and "stacks" in wd[0]
+    # the serve run survived the stall: run_end is stopped, not hung
+    assert events[-1]["kind"] == "run_end" and events[-1]["status"] == "stopped"
+
+
+# ---------------------------------------------------------------------------
+# typed ServerClosed (+ the submit-vs-stop race)
+# ---------------------------------------------------------------------------
+
+
+def test_server_closed_is_typed_and_immediate(served_setup):
+    _, served, samples = served_setup
+    q = MicroBatchQueue(num_buckets=1, max_batch=2, max_delay_s=0.1, max_pending=4)
+    q.close()
+    with pytest.raises(ServerClosed):
+        q.put(0, "x")
+    server = ModelServer(served, samples, ServeConfig(max_batch=2, max_delay_ms=5.0))
+    server.start()
+    server.stop()
+    with pytest.raises(ServerClosed):
+        server.submit(samples[0])
+    with pytest.raises(ServerClosed):
+        server.start()  # a stopped server does not resurrect silently
+
+
+def test_submit_vs_stop_race_leaves_no_hanging_future(served_setup):
+    _, served, samples = served_setup
+    server = ModelServer(
+        served, samples, ServeConfig(max_batch=4, max_delay_ms=5.0)
+    )
+    server.start()
+    futures, rejected = [], []
+    lock = threading.Lock()
+
+    def feeder():
+        # submit until the stop lands (time-bounded, not count-bounded:
+        # the race only exists while submissions straddle the stop)
+        deadline = time.monotonic() + 5.0
+        i = 0
+        while time.monotonic() < deadline:
+            i += 1
+            try:
+                f = server.submit(samples[i % len(samples)])
+                with lock:
+                    futures.append(f)
+            except Overloaded:
+                time.sleep(0.001)
+            except ServerClosed as exc:
+                with lock:
+                    rejected.append(exc)
+                return
+
+    threads = [threading.Thread(target=feeder) for _ in range(2)]
+    for t in threads:
+        t.start()
+    time.sleep(0.05)
+    server.stop()
+    for t in threads:
+        t.join(timeout=30)
+    assert any(isinstance(e, ServerClosed) for e in rejected)
+    # EVERY future handed out resolves: a result (drained) — never a hang
+    for f in futures:
+        f.result(timeout=30)
+
+
+# ---------------------------------------------------------------------------
+# zero-downtime reload
+# ---------------------------------------------------------------------------
+
+
+def _scaled_params(variables, factor):
+    import jax
+
+    def scale(a):
+        arr = np.asarray(a)
+        if np.issubdtype(arr.dtype, np.floating):
+            return arr * factor
+        return a
+
+    return {
+        "params": jax.tree_util.tree_map(scale, variables["params"]),
+        "batch_stats": variables.get("batch_stats", {}),
+    }
+
+
+def test_reload_swaps_weights_without_recompiling(served_setup, tmp_path):
+    _, served, samples = served_setup
+    old_vars = served.variables
+    flight = FlightRecorder(str(tmp_path / "flight.jsonl"))
+    try:
+        with ModelServer(
+            served, samples, ServeConfig(max_batch=4, max_delay_ms=5.0), flight=flight
+        ) as server:
+            before = server.predict(samples[0], timeout=120)
+            info = server.reload(variables=_scaled_params(old_vars, 1.5))
+            after = server.predict(samples[0], timeout=120)
+            # new weights actually serve...
+            _assert_result_close(after, _direct_forward(served, samples[0]))
+            assert any(
+                not np.allclose(after[k], before[k]) for k in after
+            ), "reload did not change the served weights"
+            snap = server.metrics_snapshot()
+            assert snap["reloads"] == 1 and snap["reload_failed"] == 0
+            # ...with ZERO new compiles (AOT executables are shape-
+            # specialized; the warm ladder survives the swap)
+            assert snap["compile_misses"] == 0
+            assert info["canary_buckets"] == len(server.buckets)
+            assert server.health()["ready"]
+        events = read_flight_record(str(tmp_path / "flight.jsonl"))
+        assert [e["source"] for e in events if e.get("kind") == "reload"] == [
+            "<variables>"
+        ]
+    finally:
+        served.variables = old_vars  # module fixture: restore for later tests
+
+
+def test_reload_rolls_back_on_canary_failure(served_setup, monkeypatch, tmp_path):
+    _, served, samples = served_setup
+    flight = FlightRecorder(str(tmp_path / "flight.jsonl"))
+    with ModelServer(
+        served, samples, ServeConfig(max_batch=4, max_delay_ms=5.0), flight=flight
+    ) as server:
+        before = server.predict(samples[0], timeout=120)
+        # torn reload: the candidate is corrupted before the canary
+        monkeypatch.setenv("HYDRAGNN_INJECT_SERVE_TORN_RELOAD", "1")
+        with pytest.raises(ReloadFailed):
+            server.reload(variables=dict(served.variables))
+        monkeypatch.delenv("HYDRAGNN_INJECT_SERVE_TORN_RELOAD")
+        # structurally wrong candidate: rejected by the canary too
+        with pytest.raises(ReloadFailed):
+            server.reload(variables={"params": {"nope": np.zeros(3)}})
+        after = server.predict(samples[0], timeout=120)
+        _assert_result_close(after, before)  # old weights kept serving
+        snap = server.metrics_snapshot()
+        assert snap["reload_failed"] == 2 and snap["reloads"] == 0
+        assert server.health()["ready"]
+    events = read_flight_record(str(tmp_path / "flight.jsonl"))
+    fails = [e for e in events if e.get("kind") == "reload_failed"]
+    assert len(fails) == 2 and all(e.get("rolled_back") for e in fails)
+
+
+# ---------------------------------------------------------------------------
+# registry: the validating checkpoint path
+# ---------------------------------------------------------------------------
+
+
+def test_registry_load_falls_back_on_torn_pointer(served_setup, tmp_path):
+    from hydragnn_tpu.train import create_eval_state, select_optimizer
+    from hydragnn_tpu.utils.checkpoint import save_model
+
+    config, served, samples = served_setup
+    nn = config["NeuralNetwork"]
+    log_dir = str(tmp_path) + "/logs/"
+    tx = select_optimizer(
+        nn["Training"],
+        freeze_conv=bool(nn["Architecture"].get("freeze_conv_layers")),
+    )
+    state = create_eval_state(served.variables, tx)
+    save_model(state, "torn_run", path=log_dir, keep_last=2)
+    # tear the latest-pointer file (torn write / bit rot); the sha256-
+    # sidecar'd step version must be served instead — loudly
+    pointer = log_dir + "torn_run/torn_run.mp"
+    with open(pointer, "r+b") as f:
+        f.truncate(max(f.seek(0, 2) // 2, 1))
+    registry = ModelRegistry(log_dir)
+    with pytest.warns(RuntimeWarning, match="integrity"):
+        loaded = registry.load("torn_run", nn, example_graph=samples[0])
+    # the fallback restore carries the true weights, not garbage
+    want = jax_leaves(served.variables["params"])
+    got = jax_leaves(loaded.variables["params"])
+    assert len(want) == len(got)
+    for w, g in zip(want, got):
+        np.testing.assert_allclose(np.asarray(w), np.asarray(g), rtol=0, atol=0)
+
+
+def jax_leaves(tree):
+    import jax
+
+    return jax.tree_util.tree_leaves(tree)
+
+
+# ---------------------------------------------------------------------------
+# probes: health(), Prometheus textfile, serve_probe exit codes
+# ---------------------------------------------------------------------------
+
+
+def _probe(args):
+    return subprocess.run(
+        [sys.executable, f"{REPO}/tools/serve_probe.py", *args],
+        capture_output=True,
+        text=True,
+    ).returncode
+
+
+def test_health_probe_and_prometheus_textfile(served_setup, tmp_path):
+    _, served, samples = served_setup
+    prom = str(tmp_path / "serve.prom")
+    server = ModelServer(
+        served,
+        samples,
+        ServeConfig(
+            max_batch=2,
+            max_delay_ms=5.0,
+            prometheus_path=prom,
+            prometheus_every_s=0.05,
+        ),
+    )
+    assert not server.health()["live"]  # not started yet
+    server.start()
+    try:
+        h = server.health()
+        assert h["live"] and h["ready"] and h["warm_buckets"] == h["num_buckets"]
+        assert h["reasons"] == []
+        # the supervisor's monitor exports the textfile periodically
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            try:
+                with open(prom) as f:
+                    if "hydragnn_serve_ready" in f.read():
+                        break
+            except OSError:
+                pass
+            time.sleep(0.02)
+        assert _probe(["--prom", prom]) == 0
+        assert _probe(["--prom", prom, "--live"]) == 0
+        # a stale textfile is NO evidence of health: exit 2
+        assert _probe(["--prom", prom, "--max-age", "1e-9"]) == 2
+        assert _probe(["--prom", str(tmp_path / "missing.prom")]) == 2
+    finally:
+        server.stop()
+    # a stopped server exports not-ready/not-live: exit 1
+    server.export_prometheus(prom)
+    assert _probe(["--prom", prom]) == 1
+    assert _probe(["--prom", prom, "--live"]) == 1
+
+
+def test_serve_fault_events_validate_and_render(tmp_path):
+    path = str(tmp_path / "flight.jsonl")
+    flight = FlightRecorder(path)
+    flight.start_run({"mode": "serve"})
+    flight.record("quarantine", seq=7, reason="exception", bucket=0, error="boom")
+    flight.record("dispatch_restart", attempt=1, cause="crash", delay_s=0.05)
+    flight.record("reload", source="run42", swap_s=0.2)
+    flight.record("reload_failed", source="run43", error="canary", rolled_back=True)
+    flight.end_run(status="stopped")
+    flight.close()
+    assert validate_flight_record(path) == []
+    # the serve kinds are schema-KNOWN: no forward-compat warnings
+    assert flight_record_warnings(path) == []
+    out = subprocess.run(
+        [sys.executable, f"{REPO}/tools/obs_report.py", "--faults", path],
+        capture_output=True,
+        text=True,
+    )
+    assert out.returncode == 0, out.stderr
+    for token in ("quarantine", "dispatch_restart", "reload", "reload_failed"):
+        assert token in out.stdout
+    assert "quarantined=1" in out.stdout and "reloads=1" in out.stdout

@@ -227,8 +227,9 @@ def render_heads(events: List[dict]) -> str:
 
 def render_faults(events: List[dict]) -> str:
     """A run's fault history: chronological preemption / rollback /
-    watchdog / restart / retry / error timeline plus non-completed
-    run_end statuses — the view a supervisor post-mortem starts from.
+    watchdog / restart / retry / error timeline — plus the serving-side
+    kinds (quarantine, dispatch_restart, reload/reload_failed) — and
+    non-completed run_end statuses: the view a post-mortem starts from.
     Handles MERGED records (several run_start..run_end segments in one
     file, the append-mode artifact of a supervised run)."""
     t0 = events[0].get("t") if events and isinstance(events[0].get("t"), (int, float)) else None
@@ -263,6 +264,14 @@ def render_faults(events: List[dict]) -> str:
         "watchdog": sum(1 for e in events if e.get("kind") == "watchdog"),
         "restarts": sum(1 for e in events if e.get("kind") == "restart"),
         "errors": sum(1 for e in events if e.get("kind") == "error"),
+        "quarantined": sum(1 for e in events if e.get("kind") == "quarantine"),
+        "dispatch_restarts": sum(
+            1 for e in events if e.get("kind") == "dispatch_restart"
+        ),
+        "reloads": sum(1 for e in events if e.get("kind") == "reload"),
+        "reload_failed": sum(
+            1 for e in events if e.get("kind") == "reload_failed"
+        ),
         "nonfinite_skipped": sum(
             (e.get("nonfinite") or {}).get("skipped", 0)
             for e in events
@@ -293,6 +302,23 @@ def render_faults(events: List[dict]) -> str:
             detail = (
                 f"attempt={e.get('attempt')} cause={e.get('cause')} "
                 f"exit_code={e.get('exit_code')} delay_s={e.get('delay_s')}"
+            )
+        elif kind == "quarantine":
+            detail = (
+                f"seq={e.get('seq')} reason={e.get('reason')} "
+                f"bucket={e.get('bucket')} error={str(e.get('error') or '')[:80]}"
+            )
+        elif kind == "dispatch_restart":
+            detail = (
+                f"attempt={e.get('attempt')} cause={e.get('cause')} "
+                f"delay_s={e.get('delay_s')}"
+            )
+        elif kind == "reload":
+            detail = f"source={e.get('source')} swap_s={e.get('swap_s')}"
+        elif kind == "reload_failed":
+            detail = (
+                f"source={e.get('source')} rolled_back={e.get('rolled_back')} "
+                f"error={str(e.get('error') or '')[:80]}"
             )
         elif kind == "run_end":
             detail = f"status={e.get('status')}"

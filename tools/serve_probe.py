@@ -1,0 +1,107 @@
+"""Exit-code health probe for a running ModelServer — the command an
+orchestrator's liveness/readiness check runs.
+
+The server exports its health gauges into a Prometheus textfile
+(``ServeConfig.prometheus_path`` makes the dispatch supervisor's
+monitor thread rewrite it every ``prometheus_every_s``; or call
+``ModelServer.export_prometheus`` yourself). This CLI turns that file
+into the contract probes speak:
+
+    python tools/serve_probe.py --prom /run/serve.prom            # readiness
+    python tools/serve_probe.py --prom /run/serve.prom --live     # liveness
+    python tools/serve_probe.py --prom /run/serve.prom --max-age 30
+
+Exit codes:
+    0  the probed gauge (``hydragnn_serve_ready`` / ``_live``) is 1 and
+       the file is fresh
+    1  the gauge is 0 — the server says it is not ready/live
+    2  no evidence: file missing, unparseable, gauge absent, or STALE
+       (mtime older than ``--max-age``; a server that stopped exporting
+       is indistinguishable from a dead one, so staleness fails the
+       probe rather than trusting an old "ready")
+
+``--verbose`` prints what was decided and why (probes are run by
+machines, so the default is silent).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+import time
+
+
+def parse_prometheus_gauge(text: str, name: str):
+    """First sample value of ``name`` (any label set) in an exposition-
+    format body, or None when absent."""
+    pat = re.compile(rf"^{re.escape(name)}(?:\{{[^}}]*\}})?\s+([^\s]+)\s*$", re.M)
+    m = pat.search(text)
+    if m is None:
+        return None
+    try:
+        return float(m.group(1))
+    except ValueError:
+        return None
+
+
+def probe(path: str, mode: str = "ready", max_age_s: float = 60.0):
+    """Returns (exit_code, message)."""
+    gauge = f"hydragnn_serve_{mode}"
+    try:
+        age = time.time() - os.stat(path).st_mtime
+    except OSError as exc:
+        return 2, f"no textfile at {path!r} ({exc.__class__.__name__})"
+    if max_age_s > 0 and age > max_age_s:
+        return 2, f"textfile is stale ({age:.1f}s old > --max-age {max_age_s:g}s)"
+    try:
+        with open(path) as f:
+            text = f.read()
+    except OSError as exc:
+        return 2, f"unreadable textfile {path!r} ({exc.__class__.__name__})"
+    value = parse_prometheus_gauge(text, gauge)
+    if value is None:
+        return 2, f"gauge {gauge} not found in {path!r}"
+    if value >= 1.0:
+        return 0, f"{gauge}=1 (age {age:.1f}s)"
+    return 1, f"{gauge}={value:g} — server reports not {mode}"
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument(
+        "--prom",
+        required=True,
+        help="Prometheus textfile the server exports "
+        "(ServeConfig.prometheus_path / ModelServer.export_prometheus)",
+    )
+    g = p.add_mutually_exclusive_group()
+    g.add_argument(
+        "--ready",
+        action="store_true",
+        help="probe readiness (warm buckets + queue below high-water; default)",
+    )
+    g.add_argument(
+        "--live",
+        action="store_true",
+        help="probe liveness only (dispatch thread beating)",
+    )
+    p.add_argument(
+        "--max-age",
+        type=float,
+        default=60.0,
+        help="fail (exit 2) when the textfile is older than this many "
+        "seconds (0 disables; default 60)",
+    )
+    p.add_argument("--verbose", action="store_true", help="print the verdict")
+    args = p.parse_args(argv)
+    mode = "live" if args.live else "ready"
+    rc, msg = probe(args.prom, mode=mode, max_age_s=args.max_age)
+    if args.verbose or rc != 0:
+        print(f"serve_probe[{mode}]: {msg}", file=sys.stderr)
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
