@@ -50,6 +50,7 @@ import time
 from hydragnn_tpu.obs.introspect import (  # noqa: E402
     cost_analysis as _cost_analysis,
     peak_flops as _peak_flops,
+    peak_hbm_bw as _peak_hbm_bw,
 )
 
 
@@ -71,6 +72,72 @@ def _measure_dispatch_ms() -> float:
         np.asarray(tiny(x))
         ts.append((time.perf_counter() - t0) * 1e3)
     return statistics.median(ts)
+
+
+def _kernel_roofline(cols, rows, tot_us, n_steps=2, top=10) -> list:
+    """Per-kernel roofline attribution from the hlo_stats trace rows:
+    for each of the ``top`` ops by device self time, report its time
+    share, its bytes — MEASURED (self time x xprof's measured BW) for
+    regular HLO ops, operand-shape COST-MODEL bytes for custom-calls
+    (Pallas kernels, which xprof reports no BW for) — its achieved
+    GB/s, and its fraction of the chip's HBM roofline. This is how a
+    fusion's win is ATTRIBUTED rather than inferred: the op it removed
+    disappears from the table, and the kernel that replaced it shows
+    its own bytes/time against the roofline (ISSUE 6 satellite;
+    docs/PERF.md "Per-kernel roofline")."""
+    import jax
+
+    try:
+        from tools.analyze_hlo_stats import _customcall_bytes
+    except ImportError:
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from tools.analyze_hlo_stats import _customcall_bytes
+
+    peak_bw = _peak_hbm_bw(jax.devices()[0])
+    i_t = cols.index("total_self_time")
+    i_bw = cols.index("measured_memory_bw")
+    i_cat = cols.index("category")
+    i_expr = cols.index("hlo_op_expression")
+    ops = []
+    for row in rows:
+        cells = row["c"]
+        t_us = float((cells[i_t] or {}).get("v") or 0.0)
+        if t_us <= 0:
+            continue
+        cat = str((cells[i_cat] or {}).get("v") or "")
+        expr = str((cells[i_expr] or {}).get("v") or "")
+        bw = float((cells[i_bw] or {}).get("v") or 0.0)  # GiB/s, 0 for kernels
+        if cat == "custom-call":
+            nbytes = _customcall_bytes(expr) * (
+                float((cells[cols.index("occurrences")] or {}).get("v") or 1.0)
+                if "occurrences" in cols
+                else 1.0
+            )
+            src = "costmodel"
+        else:
+            nbytes = bw * (2**30) * (t_us / 1e6)
+            src = "measured"
+        # a short, stable op label: the assignment target of the HLO
+        # expression (e.g. "%fusion.123"), else the category
+        label = expr.split("=", 1)[0].strip() if "=" in expr else cat
+        ops.append((t_us, cat, label[:60], nbytes, src))
+    ops.sort(reverse=True)
+    out = []
+    for t_us, cat, label, nbytes, src in ops[:top]:
+        gbps = nbytes / (t_us / 1e6) / 1e9 if t_us > 0 else 0.0
+        entry = {
+            "op": label,
+            "category": cat,
+            "time_ms_per_step": round(t_us / 1e3 / n_steps, 3),
+            "pct_device_time": round(100.0 * t_us / max(tot_us, 1e-9), 1),
+            "bytes_per_step": round(nbytes / n_steps),
+            "bytes_source": src,
+            "gbps": round(gbps, 1),
+        }
+        if peak_bw:
+            entry["pct_hbm_roofline"] = round(100.0 * gbps * 1e9 / peak_bw, 1)
+        out.append(entry)
+    return out
 
 
 def _measured_traffic(compiled, state, batches) -> dict:
@@ -124,6 +191,14 @@ def _measured_traffic(compiled, state, batches) -> dict:
                 "bytes_per_step_measured": round(tot_bytes / 2),
                 "hbm_gbps_measured": round(tot_bytes / (tot_us / 1e6) / 1e9, 1),
             }
+            # per-kernel roofline attribution (fused-kernel wins show up
+            # as the replaced ops VANISHING from this table; guarded —
+            # an hlo_stats dialect without the columns must not cost the
+            # measurement above)
+            try:
+                out["roofline"] = _kernel_roofline(cols, tab["rows"], tot_us)
+            except Exception:
+                pass
             # xprof reports no memory BW for custom-calls (Pallas
             # kernels), so their DMA traffic is invisible to the
             # measured sum; the CSR kernels stream each operand once by

@@ -45,21 +45,27 @@
 #                         tools/serve_probe.py must exit 0 on the
 #                         exported Prometheus textfile
 #                         (docs/RESILIENCE.md "Serving resilience").
-#   7. full matrix      — opt-in (CI_FULL=1): all 7 models x head configs
+#   7. perf gate        — tools/bench_gate.py: a tiny fixed-config bench
+#                         measured with D2H-fenced segments and compared
+#                         against the committed BENCH_CI_BASELINE.json
+#                         (>15% graphs/sec regression fails; MFU too on
+#                         TPU), then a self-test proving the gate fails
+#                         on an injected slowdown.
+#   8. full matrix      — opt-in (CI_FULL=1): all 7 models x head configs
 #                         trained to the reference accuracy thresholds
 #                         (HYDRAGNN_FULL_MATRIX=1, ~15 min).
-#   8. TPU kernel suite — opt-in (CI_TPU=1, needs a real TPU):
+#   9. TPU kernel suite — opt-in (CI_TPU=1, needs a real TPU):
 #                         HYDRAGNN_TPU_TESTS=1 on-chip kernel-vs-XLA
 #                         checks, budgeted under the tunnel's dispatch
 #                         throttle (tests/test_tpu_chip.py).
 #
-# Usage: ./ci.sh            # stages 1-6 (the default CI gate)
+# Usage: ./ci.sh            # stages 1-7 (the default CI gate)
 #        CI_FULL=1 ./ci.sh  # + acceptance matrix
 #        CI_TPU=1  ./ci.sh  # + real-chip kernel suite
 set -euo pipefail
 cd "$(dirname "$0")"
 
-echo "== [1/8] format gate =="
+echo "== [1/9] format gate =="
 if python -m black --version >/dev/null 2>&1; then
     python -m black --check .
 elif command -v black >/dev/null 2>&1; then
@@ -69,13 +75,13 @@ else
     python -m compileall -q hydragnn_tpu tests examples tools bench.py bench_scaling.py bench_serve.py __graft_entry__.py
 fi
 
-echo "== [2/8] chip hygiene report =="
+echo "== [2/9] chip hygiene report =="
 python tools/chip_hygiene.py || true
 
-echo "== [3/8] serial suite (virtual 8-device CPU mesh, incl. 2-process pass) =="
+echo "== [3/9] serial suite (virtual 8-device CPU mesh, incl. 2-process pass) =="
 python -m pytest tests/ -q
 
-echo "== [4/8] telemetry smoke (tiny 2-head training -> schema-valid v2 flight record with head diagnostics + MFU ledger) =="
+echo "== [4/9] telemetry smoke (tiny 2-head training -> schema-valid v2 flight record with head diagnostics + MFU ledger) =="
 SMOKE_DIR="$(mktemp -d)"
 JAX_PLATFORMS=cpu python - "$SMOKE_DIR" <<'EOF'
 import sys
@@ -135,7 +141,7 @@ print("introspection smoke: OK (v2 record, head diagnostics + MFU ledger present
 EOF
 rm -rf "$SMOKE_DIR"
 
-echo "== [5/8] fault-injection smoke (SIGTERM mid-epoch -> supervisor resume) =="
+echo "== [5/9] fault-injection smoke (SIGTERM mid-epoch -> supervisor resume) =="
 FAULT_DIR="$(mktemp -d)"
 cat > "$FAULT_DIR/child.py" <<'EOF'
 import sys
@@ -181,7 +187,7 @@ print("fault-injection smoke: OK (one preempted + one resumed, run completed)")
 EOF
 rm -rf "$FAULT_DIR"
 
-echo "== [6/8] serve-chaos smoke (poison request -> quarantine; hot reload from the saved checkpoint; health probe) =="
+echo "== [6/9] serve-chaos smoke (poison request -> quarantine; hot reload from the saved checkpoint; health probe) =="
 SERVE_DIR="$(mktemp -d)"
 JAX_PLATFORMS=cpu python - "$SERVE_DIR" <<'EOF'
 import glob
@@ -269,18 +275,33 @@ python tools/obs_report.py --faults "$SERVE_DIR/serve_flight.jsonl"
 python tools/serve_probe.py --prom "$SERVE_DIR/serve.prom" --verbose
 rm -rf "$SERVE_DIR"
 
+echo "== [7/9] perf gate (tiny fixed-config bench vs committed baseline) =="
+# fails on a >15% graphs/sec regression (and MFU regression on TPU)
+# against BENCH_CI_BASELINE.json, keyed per backend:device so every CI
+# machine gates against its own recorded number (tools/bench_gate.py)
+JAX_PLATFORMS=cpu python tools/bench_gate.py
+# the gate must DEMONSTRABLY fail on a slow build: inject a genuine
+# per-step slowdown into the timed loop and require a nonzero exit
+if JAX_PLATFORMS=cpu python tools/bench_gate.py --inject-slowdown-ms 40 >/tmp/_gate_inject.log 2>&1; then
+    echo "FAIL: bench gate did not catch an injected 40 ms/step slowdown"
+    cat /tmp/_gate_inject.log
+    exit 1
+else
+    echo "bench gate self-test: injected slowdown correctly rejected"
+fi
+
 if [ "${CI_FULL:-0}" = "1" ]; then
-    echo "== [7/8] full acceptance matrix (reference thresholds) =="
+    echo "== [8/9] full acceptance matrix (reference thresholds) =="
     HYDRAGNN_FULL_MATRIX=1 python -m pytest tests/test_train_matrix.py -q
 else
-    echo "== [7/8] full acceptance matrix: skipped (set CI_FULL=1) =="
+    echo "== [8/9] full acceptance matrix: skipped (set CI_FULL=1) =="
 fi
 
 if [ "${CI_TPU:-0}" = "1" ]; then
-    echo "== [8/8] real-chip TPU kernel suite =="
+    echo "== [9/9] real-chip TPU kernel suite =="
     HYDRAGNN_TPU_TESTS=1 python -m pytest tests/test_tpu_chip.py -q
 else
-    echo "== [8/8] real-chip TPU kernel suite: skipped (set CI_TPU=1, needs a TPU) =="
+    echo "== [9/9] real-chip TPU kernel suite: skipped (set CI_TPU=1, needs a TPU) =="
 fi
 
 echo "CI protocol complete."

@@ -82,6 +82,12 @@ class ModelConfig:
     inforward_radius: bool = False
     freeze_conv: bool = False
     initial_bias: Optional[float] = None
+    # Architecture.fused_conv (default on): run each conv layer's
+    # gather -> edge-network -> scatter chain as ONE Pallas kernel
+    # where the backend/knob support it (ops/fused_conv.py); layers
+    # fall back to the composed segment-op paths elsewhere, so the
+    # knob only ever selects between numerically-matching paths.
+    fused_conv: bool = True
     # SyncBatchNorm equivalent: name of the mapped device axis to psum
     # batch statistics over (reference: SyncBatchNorm convert,
     # hydragnn/utils/distributed.py:227-228). None = per-device stats,
@@ -232,6 +238,7 @@ class HydraModel(nn.Module):
                     node_mask=batch.node_mask,
                     edge_attr=edge_attr,
                     edge_weight=edge_weight,
+                    fused_conv=cfg.fused_conv,
                 )
             if cfg.use_edge_attr and batch.edge_attr is not None:
                 edge_weight = jnp.linalg.norm(batch.edge_attr, axis=-1)
@@ -289,6 +296,7 @@ class HydraModel(nn.Module):
             sender_win=batch.sender_win,
             dense_sender_win=batch.dense_sender_win,
             run_align=batch.run_align,
+            fused_conv=cfg.fused_conv,
         )
 
     def _apply_conv(self, conv, x, ctx, train: bool):

@@ -73,6 +73,11 @@ class EdgeContext:
     # (or is batch tail), so segment reductions pre-reduce K-fold with
     # one fused pass (_run_groups) before the serial scatter/segment op.
     run_align: int = 0
+    # static: Architecture.fused_conv — route the gather -> edge-network
+    # -> scatter chain through the single fused Pallas kernel
+    # (ops/fused_conv.py) where the knob/backend allow; layers fall back
+    # to the composed segment-op paths otherwise.
+    fused_conv: bool = False
 
 
 def _local_kernels(n_rows: int) -> bool:
@@ -88,6 +93,43 @@ def _local_kernels(n_rows: int) -> bool:
     )
 
     return n_rows >= local_min_rows() and local_kernel_active()
+
+
+def _fused_active(ctx: EdgeContext) -> bool:
+    """Trace-time gate for the fused conv kernel (ops/fused_conv.py):
+    the config knob (EdgeContext.fused_conv <- Architecture.fused_conv)
+    AND the shared HYDRAGNN_PALLAS knob/backend contract. Receivers are
+    sorted by the EdgeContext contract, so no shape check is needed —
+    narrow widths lane-pad inside the op."""
+    if not ctx.fused_conv:
+        return False
+    from hydragnn_tpu.ops.fused_conv import fused_conv_active
+
+    return fused_conv_active()
+
+
+def _gather_scatter(
+    x: jnp.ndarray,
+    ctx: EdgeContext,
+    n: int,
+    scale: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """``sum_e mask_e * (x[send_e] * scale_e?)`` grouped by receiver —
+    ONE fused Pallas kernel (gather + optional per-edge scale + scatter
+    all in VMEM, no [E, H] HBM intermediate) when active, else the
+    composed gather + masked segment sum the layers always used.
+    Returns x.dtype."""
+    if _fused_active(ctx):
+        from hydragnn_tpu.ops.fused_conv import fused_conv
+
+        return fused_conv(
+            x, ctx.senders, ctx.receivers, ctx.edge_mask, n,
+            scale=scale, win=ctx.sender_win,
+        ).astype(x.dtype)
+    vals = _gather_senders(x, ctx)
+    if scale is not None:
+        vals = vals * scale
+    return _segment_sum_edges(vals, ctx, n)
 
 
 def _run_presum(vals: jnp.ndarray, ctx: EdgeContext) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -158,7 +200,7 @@ class GINConv(nn.Module):
     @nn.compact
     def __call__(self, x: jnp.ndarray, ctx: EdgeContext) -> jnp.ndarray:
         eps = self.param("eps", lambda _: jnp.asarray(100.0, jnp.float32))
-        agg = _segment_sum_edges(_gather_senders(x, ctx), ctx, x.shape[0])
+        agg = _gather_scatter(x, ctx, x.shape[0])
         h = (1.0 + eps) * x + agg
         h = nn.Dense(self.out_dim)(h)
         h = nn.relu(h)
@@ -175,7 +217,7 @@ class SAGEConv(nn.Module):
     @nn.compact
     def __call__(self, x: jnp.ndarray, ctx: EdgeContext) -> jnp.ndarray:
         n = x.shape[0]
-        total = _segment_sum_edges(_gather_senders(x, ctx), ctx, n)
+        total = _gather_scatter(x, ctx, n)
         cnt = _edge_count(ctx, n)
         agg = total / jnp.maximum(cnt, 1.0)[:, None].astype(total.dtype)
         return nn.Dense(self.out_dim)(agg) + nn.Dense(self.out_dim, use_bias=False)(x)
@@ -198,7 +240,7 @@ class MFConv(nn.Module):
     def __call__(self, x: jnp.ndarray, ctx: EdgeContext) -> jnp.ndarray:
         n, fin = x.shape
         ndeg = self.max_degree + 1
-        agg = _segment_sum_edges(_gather_senders(x, ctx), ctx, n)
+        agg = _gather_scatter(x, ctx, n)
         deg = jnp.clip(_edge_count(ctx, n).astype(jnp.int32), 0, self.max_degree)
 
         # init parity with the reference: PyG MFConv holds one torch
@@ -230,21 +272,74 @@ class CGConv(nn.Module):
     (reference: hydragnn/models/CGCNNStack.py:19-49; PyG CGConv).
 
     z_ij = [x_i, x_j, e_ij];  out_i = x_i + sum_j sigmoid(W_f z) * softplus(W_s z)
-    """
+
+    Fused path (TPU / interpret — the PNA message-elimination idea
+    applied to the gate): each Dense over the concat splits exactly into
+    a receiver part (a NODE-level matmul, bias folded in), a sender
+    part (the only true edge-level matmul), and an edge-attr part —
+    ``W z = x_i W[:F] + x_j W[F:2F] + e W[2F:]``. The [E, 2F+De] concat
+    never exists, and the whole gather -> two-branch MLP ->
+    sigmoid*softplus -> scatter chain runs in ONE Pallas kernel
+    (ops/fused_conv.py) with the receiver parts gathered in-VMEM from
+    node-blocked tables. The params stay the ORIGINAL ``nn.Dense``
+    children (the fused path slices the same kernels), so off-TPU the
+    layer computes — and initializes — bit-identically to the
+    pre-fusion form."""
 
     out_dim: int  # must equal input dim; CGConv preserves width
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, ctx: EdgeContext) -> jnp.ndarray:
-        xi = S.gather_rows(x, ctx.receivers, x.shape[0], True)
-        xj = _gather_senders(x, ctx)
-        z = [xi, xj]
-        if ctx.edge_attr is not None:
-            z.append(ctx.edge_attr)
-        z = jnp.concatenate(z, axis=-1)
-        gate = jax.nn.sigmoid(nn.Dense(self.out_dim)(z))
-        core = jax.nn.softplus(nn.Dense(self.out_dim)(z))
-        agg = _segment_sum_edges(gate * core, ctx, x.shape[0]).astype(x.dtype)
+        n, fin = x.shape
+        h = self.out_dim
+        use_edge = ctx.edge_attr is not None
+        dense_f = nn.Dense(h)  # gate (Dense_0)
+        dense_s = nn.Dense(h)  # core (Dense_1)
+        if not _fused_active(ctx):
+            xi = S.gather_rows(x, ctx.receivers, n, True)
+            xj = _gather_senders(x, ctx)
+            z = [xi, xj]
+            if use_edge:
+                z.append(ctx.edge_attr)
+            z = jnp.concatenate(z, axis=-1)
+            gate = jax.nn.sigmoid(dense_f(z))
+            core = jax.nn.softplus(dense_s(z))
+            agg = _segment_sum_edges(gate * core, ctx, n).astype(x.dtype)
+            return x + agg
+
+        # materialize the children's params on a dummy row (same shapes
+        # and RNG draws as the concat form), then decompose their
+        # kernels for the fused kernel's branch layout
+        de = ctx.edge_attr.shape[-1] if use_edge else 0
+        zdim = 2 * fin + de
+        dummy = jnp.zeros((1, zdim), x.dtype)
+        dense_f(dummy)
+        dense_s(dummy)
+        wf = dense_f.variables["params"]["kernel"].astype(x.dtype)
+        bf = dense_f.variables["params"]["bias"].astype(x.dtype)
+        ws = dense_s.variables["params"]["kernel"].astype(x.dtype)
+        bs = dense_s.variables["params"]["bias"].astype(x.dtype)
+
+        # receiver-side parts as node-level matmuls (bias folded in)
+        af = x @ wf[:fin] + bf
+        ac = x @ ws[:fin] + bs
+        cf = cs = None
+        if use_edge:
+            ea = ctx.edge_attr.astype(x.dtype)
+            cf = ea @ wf[2 * fin :]
+            cs = ea @ ws[2 * fin :]
+
+        from hydragnn_tpu.ops.fused_conv import fused_conv
+
+        agg = fused_conv(
+            x, ctx.senders, ctx.receivers, ctx.edge_mask, n,
+            branches=(
+                (wf[fin : 2 * fin], None, af, cf),
+                (ws[fin : 2 * fin], None, ac, cs),
+            ),
+            acts=("sigmoid", "softplus"),
+            win=ctx.sender_win,
+        ).astype(x.dtype)
         return x + agg
 
 
@@ -589,8 +684,9 @@ class CFConv(nn.Module):
         w = w * c[:, None]
 
         h = nn.Dense(self.num_filters, use_bias=False, kernel_init=xavier)(x)
-        msg = _gather_senders(h, ctx) * w
-        agg = _segment_sum_edges(msg, ctx, x.shape[0]).astype(x.dtype)
+        # fused path: gather + per-edge filter product + scatter in one
+        # kernel — the [E, F] message array never touches HBM
+        agg = _gather_scatter(h, ctx, x.shape[0], scale=w).astype(x.dtype)
         return nn.Dense(self.out_dim, kernel_init=xavier)(agg)
 
 

@@ -84,6 +84,7 @@ def model_config_from_dict(
         num_filters=arch.get("num_filters"),
         radius=arch.get("radius"),
         inforward_radius=bool(arch.get("radius_graph_in_forward", False)),
+        fused_conv=bool(arch.get("fused_conv", True)),
         freeze_conv=bool(arch.get("freeze_conv_layers", False)),
         initial_bias=arch.get("initial_bias"),
         bn_axis_name=bn_axis_name if arch.get("SyncBatchNorm") else None,

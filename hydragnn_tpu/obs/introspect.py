@@ -64,6 +64,30 @@ def peak_flops(device) -> Optional[float]:
     return None
 
 
+# HBM bandwidth per chip, by device_kind substring (public specs) — the
+# denominator of the per-kernel roofline attribution (bench.py): an op
+# running near this number is bandwidth-bound and further kernel fusion
+# cannot speed it up; one far below it while off the MXU is
+# overhead/serial-bound — the class the fused kernels exist to kill.
+PEAK_HBM_GBPS = (
+    ("v5 lite", 819.0),
+    ("v5e", 819.0),
+    ("v5p", 2765.0),
+    ("v4", 1228.0),
+    ("v6", 1638.0),
+    ("trillium", 1638.0),
+)
+
+
+def peak_hbm_bw(device) -> Optional[float]:
+    """The device's HBM bandwidth in bytes/s, or None off-table."""
+    kind = getattr(device, "device_kind", "").lower()
+    for sub, gb in PEAK_HBM_GBPS:
+        if sub in kind:
+            return gb * 1e9
+    return None
+
+
 def cost_analysis(compiled_or_lowered) -> Tuple[Optional[float], Optional[float]]:
     """(flops, bytes) per execution from XLA's cost model, or Nones.
 

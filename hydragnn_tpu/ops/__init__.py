@@ -1,3 +1,4 @@
+from hydragnn_tpu.ops.fused_conv import fused_conv, fused_conv_active
 from hydragnn_tpu.ops.segment_pallas import (
     pallas_available,
     pna_aggregate,

@@ -52,6 +52,12 @@ Both need a static ``num_segments``.
   - ``interpret``: force the kernel in interpret mode on ANY backend
     (CPU-mesh tests of the sharded kernel path);
   - ``0``: force XLA.
+
+The FULLY FUSED conv-layer kernel (gather -> edge MLP -> scatter in
+one Pallas call, r07) builds on this file's machinery — window plans,
+vma matching, partitioning compat, the fast gather/sum dispatchers —
+and lives in :mod:`hydragnn_tpu.ops.fused_conv`; it shares the knob
+contract above.
 """
 
 from __future__ import annotations

@@ -63,6 +63,13 @@ class NonFiniteSentry:
         self.consec = consec
         self._bads.append(bad)
 
+    def observe_scan(self, bads, consec) -> None:
+        """Record a whole guarded scan-epoch's outputs: the per-step bad
+        flags [B] and the carry's final consecutive-bad counter (device
+        arrays; no sync — same discipline as :meth:`observe`)."""
+        self.consec = consec
+        self._bads.append(bads.sum())
+
     def epoch_finalize(self) -> Tuple[int, int]:
         """One host sync per epoch: returns (skipped_this_epoch,
         consecutive_bad_at_epoch_end)."""

@@ -133,26 +133,68 @@ def _named_tasks(names: Sequence[str], values) -> Dict[str, float]:
 
 
 class _MetricAccum:
-    """Accumulates per-batch (loss, tasks) weighted by the real graph count
-    as device scalars (no per-batch D2H sync); ``finalize`` materializes
-    once via ``_finalize_weighted``."""
+    """Accumulates per-batch (loss, tasks, graph_mask) as raw device
+    arrays; ``finalize`` does ALL the weighting math in one stacked
+    computation at the epoch boundary. The hot loop therefore dispatches
+    ZERO extra device ops per step — no ``graph_mask.sum()``, no
+    ``loss * n`` multiplies — and syncs exactly once per epoch (the
+    step-span tracer pins this: no ``block_until_ready`` outside the
+    sampled window)."""
 
     def __init__(self):
         self._losses: List[jnp.ndarray] = []
         self._tasks: List[jnp.ndarray] = []
-        self._counts: List[jnp.ndarray] = []
+        self._ns: List[jnp.ndarray] = []
+        self._bads: List[Optional[jnp.ndarray]] = []
 
-    def add(self, loss: jnp.ndarray, tasks: jnp.ndarray, n: jnp.ndarray) -> None:
-        self._losses.append(loss * n)
-        self._tasks.append(tasks * n)
-        self._counts.append(n)
+    def add(
+        self,
+        loss: jnp.ndarray,
+        tasks: jnp.ndarray,
+        n: jnp.ndarray,
+        bad: Optional[jnp.ndarray] = None,
+    ) -> None:
+        """``n``: the batch's ``graph_mask`` (preferred — summed in one
+        stacked op at finalize) or an already-reduced scalar count.
+        ``bad``: the guarded step's 0/1 flag; a bad batch's count is
+        zeroed at finalize (its loss/tasks are already zeroed on
+        device by the guarded step)."""
+        self._losses.append(loss)
+        self._tasks.append(tasks)
+        self._ns.append(n)
+        self._bads.append(bad)
 
     def finalize(self) -> Tuple[float, np.ndarray]:
-        if not self._counts:
+        if not self._ns:
             # zero batches ran (e.g. preemption before the first step);
             # the caller's preempt path discards these values
             return 0.0, np.zeros(0, np.float32)
-        return _finalize_weighted(self._losses, self._tasks, self._counts)
+        losses = jnp.stack(self._losses)
+        tasks = jnp.stack(self._tasks)
+        first = jnp.asarray(self._ns[0])
+        if first.ndim:
+            # graph masks (any stacked shape): one fused count reduction
+            counts = (
+                jnp.stack([jnp.asarray(m) for m in self._ns])
+                .reshape(len(self._ns), -1)
+                .sum(axis=1)
+                .astype(jnp.float32)
+            )
+        else:
+            counts = jnp.stack(self._ns).astype(jnp.float32)
+        if any(b is not None for b in self._bads):
+            bads = jnp.stack(
+                [
+                    jnp.zeros((), jnp.float32) if b is None else b
+                    for b in self._bads
+                ]
+            )
+            counts = counts * (1.0 - bads)
+        return _finalize_weighted(
+            [(losses * counts).sum()],
+            [(tasks * counts[:, None]).sum(axis=0)],
+            [counts.sum()],
+        )
 
 
 def train_epoch(
@@ -205,11 +247,13 @@ def train_epoch(
                 train_step, state, batch, sentry.consec
             )
             sentry.observe(consec, bad)
-            n = batch.graph_mask.sum() * (1.0 - bad)
+            acc.add(loss, task_losses, batch.graph_mask, bad=bad)
         else:
             state, loss, task_losses = spans.step(train_step, state, batch)
-            n = batch.graph_mask.sum()
-        acc.add(loss, task_losses, n)
+            # the raw mask, NOT mask.sum(): the accumulator defers every
+            # metric reduction to ONE stacked dispatch at epoch end, so
+            # the steady-state step is exactly one host->device dispatch
+            acc.add(loss, task_losses, batch.graph_mask)
         if profiler is not None:
             profiler.step()
     avg_loss, avg_tasks = acc.finalize()
@@ -227,7 +271,7 @@ def _finalize_scan(losses, tasks, counts) -> Tuple[float, np.ndarray]:
 
 
 def train_epoch_scan(
-    loader, state: TrainState, scan_fn, epoch: int
+    loader, state: TrainState, scan_fn, epoch: int, diag=None, sentry=None
 ) -> Tuple[TrainState, float, np.ndarray]:
     """One training epoch as a single device dispatch (``Training.
     scan_epoch``): lax.scan over the loader's device-resident stacked
@@ -235,16 +279,36 @@ def train_epoch_scan(
     batch axis (sample-to-batch membership reshuffles only when the
     loader's ``scan_reshuffle_every`` is set — see
     ``GraphLoader.stacked_device_batches``). Same weighted-metric
-    semantics as ``train_epoch``."""
+    semantics as ``train_epoch``.
+
+    ``diag`` (obs/introspect.py:HeadDiagnostics): sampled ONCE per epoch
+    on the first scheduled batch, BEFORE the donating scan consumes the
+    state — scan mode has no step granularity, so per-epoch is the
+    sampling floor. ``sentry``: when the scan_fn is the GUARDED variant
+    (make_scan_epoch(guard_nonfinite=True)), the per-step bad flags and
+    the carry's consecutive counter are handed to it, device-resident."""
     stacked = loader.stacked_device_batches(epoch)
     nb = len(loader)
     if loader.shuffle:
         order = np.random.default_rng(loader.seed + epoch).permutation(nb)
     else:
         order = np.arange(nb)
-    state, losses, tasks, counts = scan_fn(
-        state, stacked, jnp.asarray(order, dtype=jnp.int32)
-    )
+    if diag is not None:
+        # DEVICE-scalar index: a Python-int index would bake the batch
+        # position into the gather executable and recompile every epoch
+        # (the shuffle moves order[0]), tripping the zero-unexpected-
+        # recompile contract the compile monitor enforces
+        i0 = jnp.asarray(order[0], dtype=jnp.int32)
+        first = jax.tree_util.tree_map(lambda x: x[i0], stacked)
+        diag.maybe_sample(state, first)
+    order_dev = jnp.asarray(order, dtype=jnp.int32)
+    if sentry is not None:
+        state, losses, tasks, counts, bads, consec = scan_fn(
+            state, stacked, order_dev, sentry.consec
+        )
+        sentry.observe_scan(bads, consec)
+    else:
+        state, losses, tasks, counts = scan_fn(state, stacked, order_dev)
     avg_loss, avg_tasks = _finalize_scan(losses, tasks, counts)
     return state, avg_loss, avg_tasks
 
@@ -255,7 +319,7 @@ def evaluate_epoch(
     acc = _MetricAccum()
     for batch in iterate_tqdm(loader, verbosity, desc=desc):
         loss, task_losses = eval_step(state, batch)
-        acc.add(loss, task_losses, batch.graph_mask.sum())
+        acc.add(loss, task_losses, batch.graph_mask)
     return acc.finalize()
 
 
@@ -284,7 +348,7 @@ def test_epoch(
     pred_values: List[List[np.ndarray]] = [[] for _ in range(cfg.num_heads)]
     for batch in iterate_tqdm(loader, verbosity, desc="test"):
         loss, task_losses, outputs = eval_step_with_outputs(state, batch)
-        acc.add(loss, task_losses, batch.graph_mask.sum())
+        acc.add(loss, task_losses, batch.graph_mask)
         if return_samples:
             # Stacked multi-device batches carry a leading device axis on
             # masks/targets ([D, G]) while sharded eval outputs come back
@@ -344,6 +408,44 @@ def _allgather_varlen(arr: np.ndarray) -> np.ndarray:
     return np.concatenate([gathered[p, : counts[p]] for p in range(len(counts))])
 
 
+def _scan_auto_eligible(loader) -> Tuple[bool, str]:
+    """Is the whole-epoch scan dispatch the right DEFAULT here?
+    (``Training.scan_epoch`` unset — an explicit true/false always
+    wins.) Eligible = single-device mesh + a loader that can stack the
+    split device-resident + no feature that inherently needs batch
+    granularity (step-indexed fault injection). Returns (eligible,
+    human-readable reason) — the reason lands in the flight manifest's
+    ``dispatch_mode`` field either way."""
+    if not hasattr(loader, "stacked_device_batches") or not hasattr(
+        loader, "shuffle"
+    ):
+        return False, "loader cannot stack device-resident batches"
+    if getattr(loader, "device_stack", 1) != 1:
+        return False, "multi-device stacked loader (sharded mesh)"
+    if jax.process_count() > 1:
+        return False, "multi-process run"
+    try:
+        if len(loader) < 1:
+            return False, "empty loader"
+    except TypeError:
+        return False, "unsized loader"
+    inject = sorted(
+        k
+        for k in os.environ
+        if k.startswith("HYDRAGNN_INJECT_")
+        and not k.startswith("HYDRAGNN_INJECT_SERVE")
+    )
+    if inject:
+        # deterministic fault injection is step-indexed — it needs the
+        # per-step path's batch granularity to fire at the right step
+        return False, f"fault injection active ({inject[0]})"
+    if float(os.environ.get("HYDRAGNN_WATCHDOG_S", 0) or 0) > 0:
+        # the watchdog heartbeats at batch granularity; a whole-epoch
+        # dispatch would read as a stall
+        return False, "hang watchdog active"
+    return True, "single-device mesh + device-resident stacked loader"
+
+
 def train_validate_test(
     model: HydraModel,
     tx,
@@ -394,28 +496,65 @@ def train_validate_test(
     compute_dtype = (
         jnp.bfloat16 if training.get("mixed_precision") else None
     )
-    # Training.scan_epoch: whole-epoch lax.scan dispatch (single-device
-    # path only — sharded callers pass their own train_step). Requires the
-    # train split stacked in HBM; per-step profiler hooks don't fire.
+    # Dispatch-mode resolution. ``Training.scan_epoch`` explicit
+    # true/false always wins; UNSET defaults to the whole-epoch lax.scan
+    # dispatch when eligible (_scan_auto_eligible: single-device mesh +
+    # device-resident stacked loader — it already wins 3x on qm9,
+    # BENCH_r04), with automatic fallback to per-step dispatch and the
+    # decision recorded in the flight manifest's ``dispatch_mode``.
     scan_fn = scan_eval_fn = None
-    if training.get("scan_epoch") and train_step is None:
+    loop_owned = train_step is None
+    scan_cfg = training.get("scan_epoch")
+    scan_auto = scan_cfg is None and loop_owned
+    if not loop_owned:
+        use_scan, dispatch_reason = False, "caller-supplied train step"
+    elif scan_cfg is None:
+        use_scan, dispatch_reason = _scan_auto_eligible(train_loader)
+        if use_scan and (profiler is not None or "Profile" in config):
+            use_scan, dispatch_reason = False, "per-step profiler configured"
+        if use_scan and float(training.get("watchdog_stall_s", 0) or 0) > 0:
+            use_scan, dispatch_reason = False, "hang watchdog active"
+        if use_scan:
+            # the stack must actually materialize (pad-plan/HBM limits):
+            # fall back instead of dying mid-run — the loader caches the
+            # stack, so epoch 0 does not pay this twice
+            try:
+                train_loader.stacked_device_batches(0)
+            except Exception as exc:
+                use_scan = False
+                dispatch_reason = f"stacking failed: {type(exc).__name__}"
+    elif scan_cfg:
+        use_scan, dispatch_reason = True, "Training.scan_epoch=true"
+    else:
+        use_scan, dispatch_reason = False, "Training.scan_epoch=false"
+    # Non-finite guard (hydragnn_tpu/resilience/sentry.py): folded into
+    # the loop-owned step in BOTH dispatch modes — per-step via the
+    # guarded jitted step, scan via the guarded scan body threading the
+    # consecutive-bad counter through the carry. Sharded callers pass
+    # their own step and keep their own policy.
+    guard_nonfinite = bool(training.get("nonfinite_guard", True)) and loop_owned
+    if use_scan:
         scan_fn = make_scan_epoch(
             model,
             tx,
             compute_dtype=compute_dtype,
             remat=bool(training.get("remat", False)),
+            guard_nonfinite=guard_nonfinite,
         )
         if eval_step is None:  # a caller-supplied eval_step keeps priority
             scan_eval_fn = make_scan_eval(model)
-    # Non-finite guard (hydragnn_tpu/resilience/sentry.py): folded into
-    # the default per-step jitted train step only — sharded callers pass
-    # their own step, and the scan path has no batch granularity.
-    # own_step: the loop built the default single-device per-step train
-    # step (vs a caller-supplied sharded step or the scan path) — the
-    # only mode where the per-head diagnostics sampler can observe
-    # per-batch (state, batch) pairs.
-    own_step = train_step is None and scan_fn is None
-    guard_nonfinite = bool(training.get("nonfinite_guard", True)) and own_step
+            if scan_auto:
+                # auto mode must not die on an unstackable VAL split —
+                # eval falls back to per-step, training stays scanned
+                try:
+                    val_loader.stacked_device_batches(0)
+                except Exception:
+                    scan_eval_fn = None
+    # own_step: the loop built the default single-device PER-STEP train
+    # step — the only mode with per-batch (state, batch) pairs on the
+    # host (the diagnostics sampler's per-step granularity; scan mode
+    # samples once per epoch instead).
+    own_step = loop_owned and scan_fn is None
     train_step = train_step or make_train_step(
         model,
         tx,
@@ -583,7 +722,17 @@ def train_validate_test(
             make_diagnostics_step,
         )
 
-        if own_step:
+        if loop_owned:
+            # per-step mode: sample every diag_every steps (default once
+            # per epoch). Scan mode calls the sampler once per EPOCH
+            # (train_epoch_scan), so diag_every converts to an epoch
+            # stride there — the sampling floor one dispatch per epoch
+            # allows.
+            diag_every = int(training.get("diag_every", 0))
+            if scan_fn is not None:
+                every = max(1, diag_every // max(len(train_loader), 1))
+            else:
+                every = diag_every or max(len(train_loader), 1)
             diag = HeadDiagnostics(
                 make_diagnostics_step(
                     model,
@@ -592,8 +741,7 @@ def train_validate_test(
                     remat=bool(training.get("remat", False)),
                 ),
                 head_names=head_names,
-                every=int(training.get("diag_every", 0))
-                or max(len(train_loader), 1),
+                every=every,
             )
         try:
             example = next(iter(train_loader))
@@ -611,12 +759,11 @@ def train_validate_test(
     # Fault tolerance (hydragnn_tpu/resilience, docs/RESILIENCE.md):
     # preemption handler (SIGTERM/SIGINT -> graceful stop + final
     # checkpoint within Training.preempt_grace_s), non-finite sentry
-    # over the guarded train step (single-device per-step path only —
-    # sharded callers pass their own step; the scan path is one
-    # dispatch per epoch, batch granularity does not exist there), and
-    # the opt-in hang watchdog (Training.watchdog_stall_s or
-    # HYDRAGNN_WATCHDOG_S; off by default — it must be sized above the
-    # worst expected compile time).
+    # over the guarded loop-owned step (per-step OR the guarded scan
+    # body — sharded callers pass their own step and keep their own
+    # policy), and the opt-in hang watchdog (Training.watchdog_stall_s
+    # or HYDRAGNN_WATCHDOG_S; off by default — it must be sized above
+    # the worst expected compile time, and it forces per-step dispatch).
     from hydragnn_tpu.resilience import (
         HangWatchdog,
         NonFiniteSentry,
@@ -705,6 +852,14 @@ def train_validate_test(
             "start_epoch": start_epoch,
             "mixed_precision": compute_dtype is not None,
             "scan_epoch": scan_fn is not None,
+            # which dispatch mode actually ran, whether it was the
+            # automatic default, and why — the satellite contract: a
+            # flight record always says which mode executed the epochs
+            "dispatch_mode": {
+                "mode": "scan_epoch" if scan_fn is not None else "per_step",
+                "auto": scan_auto,
+                "reason": dispatch_reason,
+            },
             "compile_monitor_available": bool(cmon and cmon.available),
             "nonfinite_guard": sentry is not None,
             "preempt_handler": bool(preempt and preempt.available),
@@ -879,7 +1034,8 @@ def train_validate_test(
         with (profiler if profiler is not None else contextlib.nullcontext()):
             if scan_fn is not None:
                 state, train_loss, train_tasks = train_epoch_scan(
-                    train_loader, state, scan_fn, epoch
+                    train_loader, state, scan_fn, epoch, diag=diag,
+                    sentry=sentry,
                 )
             else:
                 state, train_loss, train_tasks = train_epoch(

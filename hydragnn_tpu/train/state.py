@@ -262,7 +262,8 @@ def make_scan_epoch(
     tx: optax.GradientTransformation,
     compute_dtype=None,
     remat: bool = False,
-) -> Callable[..., Tuple[TrainState, jnp.ndarray, jnp.ndarray, jnp.ndarray]]:
+    guard_nonfinite: bool = False,
+) -> Callable[..., Tuple]:
     """Whole-epoch training as ONE dispatch: ``lax.scan`` of the train
     step over device-resident stacked batches.
 
@@ -271,14 +272,47 @@ def make_scan_epoch(
     scanning the epoch inside one jitted program amortizes it to one
     dispatch per epoch. Requires every batch of the epoch stacked on a
     leading axis and resident in HBM (GraphLoader.stacked_device_batches),
-    so it suits datasets that fit on-device; the streaming per-step path
-    remains the default.
+    so it suits datasets that fit on-device. Since the scan-eligibility
+    work (train/loop.py:_scan_auto_eligible) this is the DEFAULT
+    dispatch mode on a single-device mesh with a stackable loader; the
+    streaming per-step path remains for everything else.
 
     Returns jitted ``(state, stacked_batches, order) -> (state, losses[B],
     tasks[B, H], counts[B])`` where ``order`` is an int32 permutation of
     the batch axis (the per-epoch reshuffle, device-side gather) and
     ``counts`` the real-graph count per batch for weighted averaging.
+
+    ``guard_nonfinite=True`` scans the GUARDED step body instead — the
+    same on-device non-finite skip the per-step path gets
+    (:func:`_guarded_step_body`), with the consecutive-bad counter
+    threaded through the scan carry. Signature then becomes
+    ``(state, stacked, order, consec0) -> (state, losses, tasks, counts,
+    bads[B], consec_end)`` where bad steps contribute zero loss/count
+    (the ``NonFiniteSentry.observe_scan`` contract).
     """
+    if guard_nonfinite:
+        gbody = _guarded_step_body(
+            model, tx, compute_dtype=compute_dtype, remat=remat
+        )
+
+        def epoch_guarded(
+            state: TrainState, stacked: GraphBatch, order: jnp.ndarray,
+            consec: jnp.ndarray,
+        ):
+            def scan_body(carry, i: jnp.ndarray):
+                state, consec = carry
+                batch = jax.tree_util.tree_map(lambda x: x[i], stacked)
+                state, loss, tasks, consec, bad = gbody(state, batch, consec)
+                cnt = batch.graph_mask.sum().astype(jnp.float32) * (1.0 - bad)
+                return (state, consec), (loss, tasks, cnt, bad)
+
+            (state, consec), (losses, tasks, counts, bads) = jax.lax.scan(
+                scan_body, (state, consec), order
+            )
+            return state, losses, tasks, counts, bads, consec
+
+        return jax.jit(epoch_guarded, donate_argnums=(0,))
+
     body = _train_step_body(model, tx, compute_dtype=compute_dtype, remat=remat)
 
     def epoch(state: TrainState, stacked: GraphBatch, order: jnp.ndarray):

@@ -160,6 +160,10 @@ def update_config(
 
     arch.setdefault("freeze_conv_layers", False)
     arch.setdefault("initial_bias", None)
+    # fused conv-layer Pallas kernel (ops/fused_conv.py): default on;
+    # the knob only selects between numerically-matching paths, so off
+    # is purely a debugging/ablation escape hatch
+    arch.setdefault("fused_conv", True)
     nn["Training"].setdefault("Optimizer", {"type": "AdamW", "learning_rate": 1e-3})
     nn["Training"].setdefault("loss_function_type", "mse")
     arch.setdefault("SyncBatchNorm", False)

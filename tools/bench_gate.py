@@ -1,0 +1,202 @@
+#!/usr/bin/env python
+"""CI bench gate: a tiny FIXED-config training bench compared against a
+committed baseline — the stage that keeps future PRs from silently
+regressing the hot path (ISSUE 6 satellite; wired as a ci.sh stage).
+
+Protocol:
+  - build the fixed tiny flagship config (PNA multi-head — the same
+    model family as the headline bench, shrunk to CI scale), compile
+    one train step, and measure graphs/sec as the MEDIAN of several
+    D2H-fenced segments (the bench.py timing discipline: a real
+    readback fences each segment);
+  - compare against the committed baseline (``BENCH_CI_BASELINE.json``)
+    keyed by ``backend:device_kind`` so a CPU CI box and a TPU runner
+    each gate against their own machine's number;
+  - FAIL (exit 2) when throughput drops more than ``--tolerance``
+    (default 15%) below baseline; on TPU, MFU (from the XLA cost model
+    + the chip peak table) gates with the same tolerance;
+  - a machine with no recorded baseline WRITES one and passes (prints
+    a notice) — the committed file carries this container's key; other
+    machines self-baseline on first run. ``--update-baseline`` forces a
+    rewrite (use after an intentional perf change, and commit it).
+
+Self-test hook: ``--inject-slowdown-ms F`` sleeps F ms inside the timed
+loop after every step — a genuine measured slowdown, not a doctored
+number — so ci.sh can assert the gate demonstrably fails on a slow
+build (the acceptance criterion).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+# invoked as `python tools/bench_gate.py` from the repo root: sys.path[0]
+# is tools/, so the package root must be added explicitly
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _measure(inject_ms: float, steps: int) -> dict:
+    import jax
+    import numpy as np
+
+    from hydragnn_tpu.flagship import build_flagship
+    from hydragnn_tpu.obs.introspect import cost_analysis, peak_flops
+    from hydragnn_tpu.train import (
+        create_train_state,
+        make_train_step,
+        select_optimizer,
+    )
+
+    # FIXED config — change it only together with --update-baseline:
+    # the committed baseline prices exactly this shape.
+    batch_size = 16
+    config, model, variables, loader = build_flagship(
+        n_samples=80,
+        hidden_dim=16,
+        num_conv_layers=2,
+        batch_size=batch_size,
+        unit_cells=(2, 3),
+    )
+    tx = select_optimizer(config["NeuralNetwork"]["Training"])
+    state = create_train_state(variables, tx)
+    on_tpu = jax.default_backend() == "tpu"
+    step = make_train_step(
+        model, tx, compute_dtype=jax.numpy.bfloat16 if on_tpu else None
+    )
+    batches = list(loader)
+    compiled = step.lower(state, batches[0]).compile()
+    flops, _ = cost_analysis(compiled)
+
+    state, loss, _ = compiled(state, batches[0])  # warmup execution
+    np.asarray(loss)
+    n_seg = 5
+    per_seg = max(1, steps // n_seg)
+    seg_ms = []
+    done = 0
+    for _ in range(n_seg):
+        t0 = time.perf_counter()
+        for _ in range(per_seg):
+            state, loss, _ = compiled(state, batches[done % len(batches)])
+            done += 1
+            if inject_ms > 0:
+                time.sleep(inject_ms / 1e3)
+        np.asarray(loss)  # real D2H fence
+        seg_ms.append((time.perf_counter() - t0) / per_seg * 1e3)
+    step_ms = statistics.median(seg_ms)
+    dev = jax.devices()[0]
+    peak = peak_flops(dev)
+    out = {
+        "graphs_per_sec": round(batch_size / step_ms * 1e3, 2),
+        "step_ms_median": round(step_ms, 3),
+        "step_ms_segments": [round(t, 2) for t in seg_ms],
+        "steps": done,
+        "backend": jax.default_backend(),
+        "device_kind": getattr(dev, "device_kind", str(dev)),
+        "mfu": (
+            round(flops / (step_ms / 1e3) / peak, 5)
+            if flops and peak and on_tpu
+            else None
+        ),
+    }
+    return out
+
+
+def main() -> int:
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--baseline", default=os.path.join(here, "BENCH_CI_BASELINE.json")
+    )
+    ap.add_argument(
+        "--tolerance",
+        type=float,
+        default=float(os.environ.get("HYDRAGNN_BENCH_GATE_TOL", 0.15)),
+        help="max fractional regression before failing (default 0.15)",
+    )
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--update-baseline", action="store_true")
+    ap.add_argument(
+        "--inject-slowdown-ms",
+        type=float,
+        default=0.0,
+        help="self-test: sleep this many ms per step inside the timed loop",
+    )
+    args = ap.parse_args()
+
+    cur = _measure(args.inject_slowdown_ms, args.steps)
+    key = f"{cur['backend']}:{cur['device_kind']}"
+    print(
+        f"bench gate [{key}]: {cur['graphs_per_sec']} graphs/sec "
+        f"(step {cur['step_ms_median']} ms, segments "
+        f"{cur['step_ms_segments']}, mfu {cur['mfu']})"
+    )
+
+    baselines = {}
+    if os.path.exists(args.baseline):
+        with open(args.baseline) as f:
+            baselines = json.load(f)
+    base = baselines.get(key)
+
+    if base is None or args.update_baseline:
+        if args.inject_slowdown_ms > 0:
+            print("bench gate: refusing to record a baseline with an "
+                  "injected slowdown")
+            return 1
+        baselines[key] = {
+            "graphs_per_sec": cur["graphs_per_sec"],
+            "step_ms_median": cur["step_ms_median"],
+            "mfu": cur["mfu"],
+            "steps": cur["steps"],
+        }
+        with open(args.baseline, "w") as f:
+            json.dump(baselines, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(
+            f"bench gate: {'updated' if base else 'recorded new'} baseline "
+            f"for {key} -> {args.baseline} (commit it)"
+        )
+        return 0
+
+    floor = base["graphs_per_sec"] * (1.0 - args.tolerance)
+    failures = []
+    if cur["graphs_per_sec"] < floor:
+        failures.append(
+            f"graphs/sec {cur['graphs_per_sec']} < {floor:.2f} "
+            f"(baseline {base['graphs_per_sec']} - {args.tolerance:.0%})"
+        )
+    if cur["mfu"] is not None and base.get("mfu"):
+        mfu_floor = base["mfu"] * (1.0 - args.tolerance)
+        if cur["mfu"] < mfu_floor:
+            failures.append(
+                f"MFU {cur['mfu']} < {mfu_floor:.5f} "
+                f"(baseline {base['mfu']} - {args.tolerance:.0%})"
+            )
+    if failures:
+        for msg in failures:
+            print(f"bench gate FAIL: {msg}")
+        return 2
+    print(
+        f"bench gate OK: within {args.tolerance:.0%} of baseline "
+        f"{base['graphs_per_sec']} graphs/sec"
+        + (
+            f" (and MFU baseline {base['mfu']})"
+            if cur["mfu"] is not None and base.get("mfu")
+            else ""
+        )
+    )
+    if cur["graphs_per_sec"] > base["graphs_per_sec"] * (1.0 + args.tolerance):
+        print(
+            "bench gate: current throughput exceeds baseline by more than "
+            "the tolerance — consider --update-baseline (and commit it) so "
+            "the gate guards the new level"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
