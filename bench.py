@@ -677,6 +677,11 @@ def main() -> None:
     scan = os.environ.get("BENCH_SCAN", "0") == "1"
     configs: dict = {}
 
+    # the bench measures the single-chip hot path; saying so through the
+    # Partitioner keeps bench/train/serve on one sharding vocabulary
+    # (docs/PARALLELISM.md — multi-width runs live in bench_scaling.py)
+    from hydragnn_tpu.parallel import Partitioner
+
     flight.start_run(
         {
             "mode": "bench",
@@ -687,6 +692,7 @@ def main() -> None:
             "smoke": smoke,
             "dispatch_ms": dispatch_ms,
             "init_retries": init_retries,
+            "parallel": Partitioner().manifest(),
             "knobs": {
                 "samples": n_samples,
                 "batch": batch_size,

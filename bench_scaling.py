@@ -50,12 +50,16 @@ def run(sizes=None) -> dict:
     import jax
     import numpy as np
 
-    from hydragnn_tpu.parallel import make_mesh, make_sharded_train_step, place_state
+    from hydragnn_tpu.parallel import Partitioner
     from hydragnn_tpu.train import create_train_state, select_optimizer
 
     smoke = os.environ.get("BENCH_SMOKE", "0") == "1"
     steps = int(os.environ.get("BENCH_STEPS", 3 if smoke else 10))
     batch_size = int(os.environ.get("BENCH_BATCH", 16 if smoke else 256))
+    # BENCH_FSDP=k: additionally measure each width's (data=d/k, fsdp=k)
+    # layout — same compute, state sharded over the fsdp axis — so the
+    # scaling record carries the FSDP story alongside pure DP
+    fsdp_width = int(os.environ.get("BENCH_FSDP", "0") or 0)
     n_dev = len(jax.devices())
     if sizes is None:
         sizes = [s for s in (1, 2, 4, 8) if s <= n_dev]
@@ -69,23 +73,24 @@ def run(sizes=None) -> dict:
     base_rate = None
     base_d = None
     on_cpu = jax.default_backend() == "cpu"
-    for d in sizes:
+    variants = [(d, 1) for d in sizes]
+    if fsdp_width > 1:
+        variants += [
+            (d, fsdp_width) for d in sizes if d >= fsdp_width and d % fsdp_width == 0
+        ]
+    for d, fsdp in variants:
+        key = str(d) if fsdp == 1 else f"{d}_fsdp{fsdp}"
         if batch_size % d:
-            results[str(d)] = {"skipped": f"batch {batch_size} % {d} != 0"}
+            results[key] = {"skipped": f"batch {batch_size} % {d} != 0"}
             continue
         config, model, variables, loader = _build(batch_size, d, smoke)
         tx = select_optimizer(config["NeuralNetwork"]["Training"])
-        if d == 1:
-            # unstacked single-device reference: the plain jitted step
-            # (api.py uses the same split: sharded only when stack > 1)
-            from hydragnn_tpu.train import make_train_step
-
-            state = create_train_state(variables, tx, seed=0)
-            step = make_train_step(model, tx)
-        else:
-            mesh = make_mesh(d)
-            state = place_state(mesh, create_train_state(variables, tx, seed=0))
-            step = make_sharded_train_step(model, tx, mesh)
+        # ONE sharding story (docs/PARALLELISM.md): every width — incl.
+        # the single-device reference — goes through the Partitioner,
+        # exactly like train/ and serve/ do
+        part = Partitioner(data=d // fsdp, fsdp=fsdp)
+        state = part.shard_init(create_train_state(variables, tx, seed=0))
+        step = part.shard_train_step(model, tx)
         batches = list(loader)
 
         state, loss, _ = step(state, batches[0])
@@ -125,19 +130,30 @@ def run(sizes=None) -> dict:
         rate = done * batch_size / dt
         if base_rate is None:
             base_rate, base_d = rate, d
-        results[str(d)] = {
+        results[key] = {
             "step_ms": round(dt / done * 1e3, 3),
             "graphs_per_sec": round(rate, 2),
             "graphs_per_sec_per_chip": round(rate / d, 2),
             "first_step_loss": first_loss,
             "loss_matches_serial": bool(loss_ok),
         }
+        if fsdp > 1:
+            # the FSDP variant's point: state bytes per device, from the
+            # partitioner's committed shardings
+            man = part.manifest(state=state)
+            results[key]["fsdp"] = fsdp
+            results[key]["state_bytes_per_device"] = (
+                man["params"]["bytes_per_device"] + man["opt"]["bytes_per_device"]
+            )
+            results[key]["state_bytes_global"] = (
+                man["params"]["bytes_global"] + man["opt"]["bytes_global"]
+            )
         # Only publish an efficiency figure where it MEANS efficiency:
         # on a virtual CPU mesh the "devices" contend for the same host
         # cores, and an efficiency-named number that must not be read as
         # efficiency invites misquotation (r04 verdict weak #6).
         if not on_cpu:
-            results[str(d)]["parallel_efficiency"] = round(
+            results[key]["parallel_efficiency"] = round(
                 (rate / d) / (base_rate / base_d), 4
             )
     return {

@@ -23,20 +23,31 @@
 #                         spawns 2 python processes with a shared
 #                         coordinator itself (TPU-native launch shape —
 #                         jax.distributed, not MPI).
-#   4. telemetry smoke  — one tiny training through api.run_training,
+#   4. partitioner      — unified-Partitioner gate (docs/PARALLELISM.md):
+#      smoke               (a) grep gate — no module outside
+#                         hydragnn_tpu/parallel/ may construct a
+#                         jax.sharding.Mesh directly (train/serve/bench
+#                         obtain meshes exclusively through Partitioner);
+#                         (b) forced 8-device CPU host mesh, one tiny
+#                         train run with Parallel.fsdp=2 — the flight
+#                         manifest must carry the parallel block with
+#                         sharded param/opt leaves and a per-device byte
+#                         drop, and the loss history must equal the
+#                         fsdp=1 data-parallel run's.
+#   5. telemetry smoke  — one tiny training through api.run_training,
 #                         then the emitted flight record is schema-
 #                         validated (tools/obs_report.py --validate
 #                         --require-complete) and pretty-printed: the
 #                         committed proof that a default run leaves a
 #                         parseable evidence artifact
 #                         (docs/OBSERVABILITY.md).
-#   5. fault-injection  — a tiny run is SIGTERM-killed mid-epoch via
+#   6. fault-injection  — a tiny run is SIGTERM-killed mid-epoch via
 #      smoke               HYDRAGNN_INJECT_SIGTERM_STEP, the restart
 #                         supervisor (tools/supervise.py) resumes it to
 #                         completion, and the merged flight record must
 #                         validate with exactly one preempted run_end +
 #                         one resumed event (docs/RESILIENCE.md).
-#   6. serve-chaos      — a tiny trained run is served; a poison request
+#   7. serve-chaos      — a tiny trained run is served; a poison request
 #      smoke               is injected (raise-in-forward), then the
 #                         checkpoint is HOT-reloaded into the running
 #                         server; the server must answer identically
@@ -45,27 +56,27 @@
 #                         tools/serve_probe.py must exit 0 on the
 #                         exported Prometheus textfile
 #                         (docs/RESILIENCE.md "Serving resilience").
-#   7. perf gate        — tools/bench_gate.py: a tiny fixed-config bench
+#   8. perf gate        — tools/bench_gate.py: a tiny fixed-config bench
 #                         measured with D2H-fenced segments and compared
 #                         against the committed BENCH_CI_BASELINE.json
 #                         (>15% graphs/sec regression fails; MFU too on
 #                         TPU), then a self-test proving the gate fails
 #                         on an injected slowdown.
-#   8. full matrix      — opt-in (CI_FULL=1): all 7 models x head configs
+#   9. full matrix      — opt-in (CI_FULL=1): all 7 models x head configs
 #                         trained to the reference accuracy thresholds
 #                         (HYDRAGNN_FULL_MATRIX=1, ~15 min).
-#   9. TPU kernel suite — opt-in (CI_TPU=1, needs a real TPU):
+#  10. TPU kernel suite — opt-in (CI_TPU=1, needs a real TPU):
 #                         HYDRAGNN_TPU_TESTS=1 on-chip kernel-vs-XLA
 #                         checks, budgeted under the tunnel's dispatch
 #                         throttle (tests/test_tpu_chip.py).
 #
-# Usage: ./ci.sh            # stages 1-7 (the default CI gate)
+# Usage: ./ci.sh            # stages 1-8 (the default CI gate)
 #        CI_FULL=1 ./ci.sh  # + acceptance matrix
 #        CI_TPU=1  ./ci.sh  # + real-chip kernel suite
 set -euo pipefail
 cd "$(dirname "$0")"
 
-echo "== [1/9] format gate =="
+echo "== [1/10] format gate =="
 if python -m black --version >/dev/null 2>&1; then
     python -m black --check .
 elif command -v black >/dev/null 2>&1; then
@@ -75,13 +86,102 @@ else
     python -m compileall -q hydragnn_tpu tests examples tools bench.py bench_scaling.py bench_serve.py __graft_entry__.py
 fi
 
-echo "== [2/9] chip hygiene report =="
+echo "== [2/10] chip hygiene report =="
 python tools/chip_hygiene.py || true
 
-echo "== [3/9] serial suite (virtual 8-device CPU mesh, incl. 2-process pass) =="
+echo "== [3/10] serial suite (virtual 8-device CPU mesh, incl. 2-process pass) =="
 python -m pytest tests/ -q
 
-echo "== [4/9] telemetry smoke (tiny 2-head training -> schema-valid v2 flight record with head diagnostics + MFU ledger) =="
+echo "== [4/10] partitioner smoke (Mesh( grep gate; fsdp=2 train == fsdp=1, flight parallel block) =="
+# Train, serve, and bench obtain meshes/shardings exclusively through the
+# Partitioner: no module outside hydragnn_tpu/parallel/ may construct a
+# jax.sharding.Mesh directly. tests/ are exempt (they build adversarial
+# meshes on purpose).
+MESH_HITS="$(grep -rn 'Mesh(' --include='*.py' hydragnn_tpu bench.py bench_scaling.py bench_serve.py tools examples __graft_entry__.py | grep -v '^hydragnn_tpu/parallel/' || true)"
+if [ -n "$MESH_HITS" ]; then
+    echo "FAIL: direct Mesh( construction outside hydragnn_tpu/parallel/:"
+    echo "$MESH_HITS"
+    exit 1
+fi
+PART_DIR="$(mktemp -d)"
+XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+    python - "$PART_DIR" <<'EOF'
+import glob
+import sys
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from hydragnn_tpu.api import run_training
+from hydragnn_tpu.data.synthetic import deterministic_graph_data
+from hydragnn_tpu.flagship import flagship_config
+from hydragnn_tpu.obs.flight import read_flight_record
+from hydragnn_tpu.parallel import FSDP_AXIS
+
+out = sys.argv[1]
+assert jax.local_device_count() == 8, jax.devices()
+
+
+def cfg(fsdp):
+    c = flagship_config(hidden_dim=8, num_conv_layers=2, batch_size=8, num_epoch=2)
+    c["NeuralNetwork"]["Parallel"] = {"fsdp": fsdp}
+    return c
+
+
+def data():
+    return deterministic_graph_data(
+        number_configurations=24,
+        unit_cell_x_range=(2, 3),
+        unit_cell_y_range=(2, 3),
+        unit_cell_z_range=(2, 3),
+        seed=0,
+    )
+
+
+_, _, hist_dp, _ = run_training(cfg(1), samples=data(), log_dir=out + "/dp/")
+_, state, hist_f, _ = run_training(cfg(2), samples=data(), log_dir=out + "/fsdp/")
+
+# the fsdp layout changes WHERE state bytes live, never what is computed
+np.testing.assert_allclose(hist_f["train_loss"], hist_dp["train_loss"], rtol=1e-5)
+
+# committed shardings, not inference: param leaves carry the fsdp axis
+sharded = sum(
+    any(
+        e == FSDP_AXIS or (isinstance(e, tuple) and FSDP_AXIS in e)
+        for e in leaf.sharding.spec
+        if e is not None
+    )
+    for leaf in jax.tree_util.tree_leaves(state.params)
+)
+assert sharded > 0, "no fsdp-sharded parameter leaves"
+
+# flight parallel block: mesh shape, fsdp factor, per-device byte drop
+flight = glob.glob(out + "/fsdp/*/flight.jsonl")[0]
+start = [e for e in read_flight_record(flight) if e["kind"] == "run_start"][0]
+par = start["manifest"]["parallel"]
+assert par["available"] and par["fsdp"] == 2, par
+assert par["mesh"]["shape"] == {"data": 4, "fsdp": 2}, par["mesh"]
+assert par["params"]["sharded"] == sharded, (par["params"], sharded)
+assert par["params"]["bytes_per_device"] < par["params"]["bytes_global"]
+assert par["opt"]["bytes_per_device"] < par["opt"]["bytes_global"]
+print(
+    f"partitioner smoke: OK (loss histories equal, {sharded} fsdp-sharded "
+    f"param leaves, {par['params']['bytes_per_device']}/"
+    f"{par['params']['bytes_global']} param bytes per device)"
+)
+EOF
+PART_FLIGHT="$(ls "$PART_DIR"/fsdp/*/flight.jsonl)"
+# --validate must surface the parallel block alongside the verdict
+PART_OUT="$(python tools/obs_report.py --validate "$PART_FLIGHT")"
+echo "$PART_OUT"
+echo "$PART_OUT" | grep -q "parallel: mesh=" || {
+    echo "FAIL: --validate did not surface the parallel block"; exit 1; }
+rm -rf "$PART_DIR"
+
+echo "== [5/10] telemetry smoke (tiny 2-head training -> schema-valid v2 flight record with head diagnostics + MFU ledger) =="
 SMOKE_DIR="$(mktemp -d)"
 JAX_PLATFORMS=cpu python - "$SMOKE_DIR" <<'EOF'
 import sys
@@ -141,7 +241,7 @@ print("introspection smoke: OK (v2 record, head diagnostics + MFU ledger present
 EOF
 rm -rf "$SMOKE_DIR"
 
-echo "== [5/9] fault-injection smoke (SIGTERM mid-epoch -> supervisor resume) =="
+echo "== [6/10] fault-injection smoke (SIGTERM mid-epoch -> supervisor resume) =="
 FAULT_DIR="$(mktemp -d)"
 cat > "$FAULT_DIR/child.py" <<'EOF'
 import sys
@@ -187,7 +287,7 @@ print("fault-injection smoke: OK (one preempted + one resumed, run completed)")
 EOF
 rm -rf "$FAULT_DIR"
 
-echo "== [6/9] serve-chaos smoke (poison request -> quarantine; hot reload from the saved checkpoint; health probe) =="
+echo "== [7/10] serve-chaos smoke (poison request -> quarantine; hot reload from the saved checkpoint; health probe) =="
 SERVE_DIR="$(mktemp -d)"
 JAX_PLATFORMS=cpu python - "$SERVE_DIR" <<'EOF'
 import glob
@@ -275,7 +375,7 @@ python tools/obs_report.py --faults "$SERVE_DIR/serve_flight.jsonl"
 python tools/serve_probe.py --prom "$SERVE_DIR/serve.prom" --verbose
 rm -rf "$SERVE_DIR"
 
-echo "== [7/9] perf gate (tiny fixed-config bench vs committed baseline) =="
+echo "== [8/10] perf gate (tiny fixed-config bench vs committed baseline) =="
 # fails on a >15% graphs/sec regression (and MFU regression on TPU)
 # against BENCH_CI_BASELINE.json, keyed per backend:device so every CI
 # machine gates against its own recorded number (tools/bench_gate.py)
@@ -291,17 +391,17 @@ else
 fi
 
 if [ "${CI_FULL:-0}" = "1" ]; then
-    echo "== [8/9] full acceptance matrix (reference thresholds) =="
+    echo "== [9/10] full acceptance matrix (reference thresholds) =="
     HYDRAGNN_FULL_MATRIX=1 python -m pytest tests/test_train_matrix.py -q
 else
-    echo "== [8/9] full acceptance matrix: skipped (set CI_FULL=1) =="
+    echo "== [9/10] full acceptance matrix: skipped (set CI_FULL=1) =="
 fi
 
 if [ "${CI_TPU:-0}" = "1" ]; then
-    echo "== [9/9] real-chip TPU kernel suite =="
+    echo "== [10/10] real-chip TPU kernel suite =="
     HYDRAGNN_TPU_TESTS=1 python -m pytest tests/test_tpu_chip.py -q
 else
-    echo "== [9/9] real-chip TPU kernel suite: skipped (set CI_TPU=1, needs a TPU) =="
+    echo "== [10/10] real-chip TPU kernel suite: skipped (set CI_TPU=1, needs a TPU) =="
 fi
 
 echo "CI protocol complete."

@@ -126,27 +126,51 @@ def _example_for_init(example, device_stack: int):
 
 
 def _choose_device_stack(config: Dict[str, Any]) -> int:
-    """Data-parallel width for this process: all local devices when the
-    per-process batch size divides evenly, else single-device. Multi-host
-    runs combine this with a global mesh over every process's devices
-    (each process feeds its own shard; ``globalize_batch`` assembles the
-    logical batch), so the reference's DDP-over-mpirun launch shape maps
-    to one process per host here."""
+    """Batch device-axis width for this process: all local devices
+    (divided by ``Parallel.edge``, which shards WITHIN each sub-batch)
+    when the per-process batch size divides evenly, else single-device.
+    Multi-host runs combine this with a global mesh over every process's
+    devices (each process feeds its own shard; ``globalize_batch``
+    assembles the logical batch), so the reference's DDP-over-mpirun
+    launch shape maps to one process per host here. The width feeds
+    ``Partitioner.from_config``, which splits it into ``data × fsdp``."""
     n_local = jax.local_device_count()
-    bs = int(config["NeuralNetwork"]["Training"]["batch_size"])
-    if n_local > 1 and bs % n_local != 0:
+    nn = config["NeuralNetwork"]
+    par = nn.get("Parallel") or {}
+    fsdp = int(par.get("fsdp", 1) or 1)
+    edge = int(par.get("edge", 1) or 1)
+    if n_local % edge:
+        raise ValueError(
+            f"Parallel.edge={edge} must divide local_device_count={n_local}"
+        )
+    usable = n_local // edge
+    bs = int(nn["Training"]["batch_size"])
+    if usable > 1 and bs % usable != 0:
+        if fsdp > 1:
+            # an explicit fsdp request must not silently degrade to a
+            # replicated single-device run that may not even fit HBM
+            raise ValueError(
+                f"Parallel.fsdp={fsdp} is set but batch_size={bs} is not "
+                f"divisible by the usable device width {usable}; pick a "
+                "batch size the device width divides"
+            )
         import warnings
 
         warnings.warn(
-            f"batch_size={bs} is not divisible by local_device_count="
-            f"{n_local}; falling back to SINGLE-DEVICE execution "
-            f"(~{n_local}x throughput loss). Use a batch_size divisible "
-            f"by {n_local} to engage all local devices.",
+            f"batch_size={bs} is not divisible by the usable device "
+            f"width {usable}; falling back to SINGLE-DEVICE execution "
+            f"(~{usable}x throughput loss). Use a batch_size divisible "
+            f"by {usable} to engage all local devices.",
             RuntimeWarning,
             stacklevel=2,
         )
         return 1
-    return n_local
+    if fsdp > 1 and (usable < fsdp or usable % fsdp):
+        raise ValueError(
+            f"Parallel.fsdp={fsdp} must divide the usable device width "
+            f"{usable} (local_device_count={n_local}, edge={edge})"
+        )
+    return usable
 
 
 def train_with_loaders(
@@ -171,7 +195,6 @@ def train_with_loaders(
     # a host-local batch regardless of the distribution mode.
     example = next(iter(train_loader))
     multihost = jax.process_count() > 1
-    sharded = device_stack > 1 or multihost
     example_one = _example_for_init(example, device_stack)
 
     training = nn_config["Training"]
@@ -185,78 +208,67 @@ def train_with_loaders(
     tx = select_optimizer(training, freeze_conv=freeze)
 
     train_step = eval_step = eval_step_out = stats_step = None
-    if sharded:
-        from hydragnn_tpu.parallel import (
-            DATA_AXIS,
-            batch_sharding,
-            make_mesh,
-            make_sharded_eval_step,
-            make_sharded_stats_step,
-            make_sharded_train_step,
-            place_state,
-        )
+    # ONE sharding story (docs/PARALLELISM.md): the Partitioner owns the
+    # composed (data, fsdp, edge) mesh, the loader placement, the state
+    # layout (replicated / ZeRO-1 / FSDP), and every partitioned step.
+    from hydragnn_tpu.parallel import Partitioner
 
+    if multihost:
+        # Global mesh over every process's devices; each process feeds
+        # its shard of the logical batch (the reference's one-DDP-rank-
+        # per-GPU launch becomes one-process-per-host + a data mesh).
+        # Heterogeneous hosts can locally derive different widths
+        # (device_stack falls back to 1 when batch_size doesn't divide
+        # its local device count); meshes/batch shapes must agree
+        # everywhere or the collectives fail opaquely downstream, so the
+        # widths are validated BEFORE the partitioner builds its global
+        # mesh from them. Gather every process's (validity, width)
+        # BEFORE raising: if only some processes raised, the rest would
+        # block forever inside this collective.
+        from jax.experimental import multihost_utils
+
+        ok = device_stack in (1, jax.local_device_count())
+        info = np.asarray(
+            multihost_utils.process_allgather(
+                np.asarray([int(ok), device_stack], dtype=np.int64)
+            )
+        ).reshape(-1, 2)
+        if not info[:, 0].all():
+            bad = [int(s) for o, s in info.tolist() if not o]
+            raise ValueError(
+                "multi-host device_stack must be 1 or local_device_count; "
+                f"invalid widths across processes: {bad}"
+            )
+        stacks = info[:, 1]
+        if not (stacks == device_stack).all():
+            raise ValueError(
+                f"device_stack must agree across processes, got {stacks.tolist()}"
+            )
+    partitioner = Partitioner.from_config(
+        nn_config, device_stack=device_stack, multihost=multihost
+    )
+    if not partitioner.single_device or multihost:
         model, variables = create_model_config(
-            nn_config, example_one, bn_axis_name=DATA_AXIS
+            nn_config, example_one, bn_axis_name=partitioner.bn_axis_name
         )
-        if multihost:
-            # Global mesh over every process's devices; each process feeds
-            # its shard of the logical batch (the reference's one-DDP-rank-
-            # per-GPU launch becomes one-process-per-host + a data mesh).
-            # Heterogeneous hosts can locally derive different widths
-            # (device_stack falls back to 1 when batch_size doesn't divide
-            # its local device count); meshes/batch shapes must agree
-            # everywhere or the collectives fail opaquely downstream.
-            # Gather every process's (validity, width) BEFORE raising: if
-            # only some processes raised, the rest would block forever
-            # inside this collective.
-            from jax.experimental import multihost_utils
-
-            ok = device_stack in (1, jax.local_device_count())
-            info = np.asarray(
-                multihost_utils.process_allgather(
-                    np.asarray([int(ok), device_stack], dtype=np.int64)
-                )
-            ).reshape(-1, 2)
-            if not info[:, 0].all():
-                bad = [int(s) for o, s in info.tolist() if not o]
-                raise ValueError(
-                    "multi-host device_stack must be 1 or local_device_count; "
-                    f"invalid widths across processes: {bad}"
-                )
-            stacks = info[:, 1]
-            if not (stacks == device_stack).all():
-                raise ValueError(
-                    f"device_stack must agree across processes, got {stacks.tolist()}"
-                )
-            from hydragnn_tpu.parallel import make_multihost_mesh
-
-            mesh = make_multihost_mesh(per_process=device_stack)
-            for loader in (train_loader, val_loader, test_loader):
-                loader.set_global_mesh(mesh)
-        else:
-            mesh = make_mesh(device_stack)
-            for loader in (train_loader, val_loader, test_loader):
-                loader.set_sharding(batch_sharding(mesh))
-        zero1 = bool(training.get("Optimizer", {}).get("use_zero_redundancy", False))
+        for loader in (train_loader, val_loader, test_loader):
+            partitioner.attach_loader(loader)
         state = create_train_state(variables, tx)
         # place BEFORE restoring: the restore target then carries the run's
-        # real (ZeRO-1) shardings, so orbax places shards directly and the
-        # msgpack path re-places onto them
-        state = place_state(mesh, state, zero1=zero1)
+        # real (FSDP/ZeRO-1) shardings, so orbax places shards directly and
+        # the msgpack path re-places onto them
+        state = partitioner.shard_init(state)
         state = load_existing_model_config(state, training, log_dir)
         compute_dtype = jax.numpy.bfloat16 if training.get("mixed_precision") else None
-        train_step = make_sharded_train_step(
+        train_step = partitioner.shard_train_step(
             model,
             tx,
-            mesh,
-            zero1=zero1,
             compute_dtype=compute_dtype,
             remat=bool(training.get("remat", False)),
         )
-        eval_step = make_sharded_eval_step(model, mesh)
-        eval_step_out = make_sharded_eval_step(model, mesh, with_outputs=True)
-        stats_step = make_sharded_stats_step(model, mesh)
+        eval_step = partitioner.shard_eval_step(model)
+        eval_step_out = partitioner.shard_eval_step(model, with_outputs=True)
+        stats_step = partitioner.shard_stats_step(model)
     else:
         model, variables = create_model_config(nn_config, example_one)
         state = create_train_state(variables, tx)
@@ -290,6 +302,7 @@ def train_with_loaders(
         # (the NeuralNetwork section alone loses Dataset/Verbosity —
         # docs/OBSERVABILITY.md documents the manifest contract)
         run_config=config,
+        partitioner=partitioner,
     )
 
     save_model(state, log_name, log_dir, verbosity)
@@ -379,9 +392,33 @@ def serve_model(
 
     from hydragnn_tpu.serve import ModelRegistry, ModelServer, ServeConfig
 
+    # Serving under the SAME sharding story as training: Parallel.fsdp
+    # shards the served parameters over the fsdp axis (a model beyond one
+    # chip's HBM serves from N chips); the bucket-ladder AOT compiles run
+    # under the partitioner's mesh instead of an implicit single device.
+    from hydragnn_tpu.parallel import Partitioner
+
+    par = config["NeuralNetwork"].get("Parallel") or {}
+    fsdp = int(par.get("fsdp", 1) or 1)
+    if fsdp > jax.local_device_count():
+        import warnings
+
+        warnings.warn(
+            f"Parallel.fsdp={fsdp} exceeds local_device_count="
+            f"{jax.local_device_count()}; serving single-device "
+            "(replicated parameters)",
+            RuntimeWarning,
+            stacklevel=2,
+        )
+        fsdp = 1
+    partitioner = Partitioner(fsdp=fsdp)
+
     registry = ModelRegistry(log_dir)
     served = registry.load(
-        log_name, config["NeuralNetwork"], example_graph=reference[0]
+        log_name,
+        config["NeuralNetwork"],
+        example_graph=reference[0],
+        partitioner=partitioner,
     )
     server = ModelServer(served, reference, serve_config or ServeConfig(), flight=flight)
     # reload("run_name") without an explicit log_dir restores from the
@@ -431,18 +468,13 @@ def run_prediction(
     state = load_existing_model(state, log_name, log_dir)
     state = state.replace(opt_state=())
 
-    if device_stack > 1:
-        from hydragnn_tpu.parallel import (
-            batch_sharding,
-            make_mesh,
-            make_sharded_eval_step,
-            place_state,
-        )
+    from hydragnn_tpu.parallel import Partitioner
 
-        mesh = make_mesh(device_stack)
-        test_loader.set_sharding(batch_sharding(mesh))
-        state = place_state(mesh, state)
-        eval_step = make_sharded_eval_step(model, mesh, with_outputs=True)
+    partitioner = Partitioner.from_config(nn_config, device_stack=device_stack)
+    if not partitioner.single_device:
+        partitioner.attach_loader(test_loader)
+        state = partitioner.shard_init(state)
+        eval_step = partitioner.shard_eval_step(model, with_outputs=True)
     else:
         eval_step = make_eval_step(model, with_outputs=True)
     error, error_rmse_task, true_values, predicted_values = test_epoch(

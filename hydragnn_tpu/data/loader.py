@@ -187,6 +187,8 @@ class GraphLoader:
         self._stacked_key: Optional[int] = None
         self._sharding = None
         self._global_mesh = None
+        self._global_axes = None
+        self._placer = None
         self._epoch = 0
         sub = batch_size // device_stack
         # Pad plan from the FULL dataset, not the local shard: all hosts
@@ -311,15 +313,29 @@ class GraphLoader:
             self._stacked = None
         self._sharding = sharding
 
-    def set_global_mesh(self, mesh) -> None:
+    def set_global_mesh(self, mesh, axes=None) -> None:
         """Multi-host mode: assemble each local [device_stack, ...] batch
-        into global jax.Arrays sharded over ``mesh``'s data axis (leading
-        axis = device_stack × process_count). The assembly runs in the
-        prefetch thread so cross-host batch formation overlaps compute."""
+        into global jax.Arrays sharded over ``mesh``'s batch axes
+        (``axes``; default the data axis — the Partitioner passes its
+        composed ``(data, fsdp)`` lead axes; leading axis = device_stack
+        × process_count). The assembly runs in the prefetch thread so
+        cross-host batch formation overlaps compute."""
         if mesh is not self._global_mesh:
             self._cached_batches = None
             self._stacked = None
         self._global_mesh = mesh
+        self._global_axes = axes
+
+    def set_placer(self, placer) -> None:
+        """Arbitrary per-batch placement callable (the Partitioner's
+        ``shard_batch`` for composed meshes whose per-FIELD layouts a
+        single uniform sharding cannot express, e.g. the edge axis).
+        Overrides ``set_sharding``; must be set before the first
+        iteration builds any cache."""
+        if placer is not self._placer:
+            self._cached_batches = None
+            self._stacked = None
+        self._placer = placer
 
     def __len__(self) -> int:
         n = len(self.samples)
@@ -379,7 +395,7 @@ class GraphLoader:
         assembly (multi-host), explicit sharding (single-host mesh), or
         pass-through (jit moves it)."""
         if self._global_mesh is not None:
-            from hydragnn_tpu.parallel.mesh import globalize_batch
+            from hydragnn_tpu.parallel.mesh import DATA_AXIS, globalize_batch
 
             if self.device_stack == 1:
                 # the sharded steps expect a leading device axis even when
@@ -387,7 +403,10 @@ class GraphLoader:
                 batch = jax.tree_util.tree_map(
                     lambda x: np.asarray(x)[None], batch
                 )
-            return globalize_batch(self._global_mesh, batch)
+            axes = self._global_axes if self._global_axes is not None else DATA_AXIS
+            return globalize_batch(self._global_mesh, batch, axes=axes)
+        if self._placer is not None:
+            return self._placer(batch)
         if self._sharding is not None:
             return jax.device_put(batch, self._sharding)
         return batch

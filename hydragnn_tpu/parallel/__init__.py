@@ -13,9 +13,19 @@ from hydragnn_tpu.parallel.mesh import (
     setup_distributed,
 )
 from hydragnn_tpu.parallel.edge_sharded import (
+    make_dp_edge_eval_step,
+    make_dp_edge_stats_step,
     make_dp_edge_train_step,
     place_dp_edge_batch,
     place_giant_batch,
+)
+from hydragnn_tpu.parallel.partitioner import (
+    AXIS_ORDER,
+    EDGE_AXIS,
+    FSDP_AXIS,
+    ParallelConfig,
+    Partitioner,
+    parallel_manifest_summary,
 )
 from hydragnn_tpu.parallel.sharded import (
     make_sharded_eval_step,

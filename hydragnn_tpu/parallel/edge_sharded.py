@@ -155,11 +155,19 @@ def place_giant_batch(mesh: Mesh, batch):
     return jax.device_put(batch, edge_axis_shardings(mesh, batch))
 
 
-def place_dp_edge_batch(mesh: Mesh, batch):
+def _lead_entry(batch_axes):
+    """PartitionSpec entry for the stacked batch's leading device axis."""
+    if not batch_axes:
+        return None
+    return batch_axes[0] if len(batch_axes) == 1 else tuple(batch_axes)
+
+
+def place_dp_edge_batch(mesh: Mesh, batch, batch_axes=(DATA_AXIS,)):
     """Place a device-stacked batch ([D_data, ...] leaves from
-    ``GraphLoader(device_stack=D_data)``) on a 2-D ``(data, edge)`` mesh:
-    axis 0 shards over ``data``; leaves whose SECOND axis is the edge
-    axis additionally shard it over ``edge``. Companion of
+    ``GraphLoader(device_stack=D_data)``) on a composed mesh carrying an
+    ``edge`` axis: axis 0 shards over the batch axes (``data``, or
+    ``data × fsdp`` under the Partitioner); leaves whose SECOND axis is
+    the edge axis additionally shard it over ``edge``. Companion of
     :func:`make_dp_edge_train_step`."""
     d_edge = int(mesh.shape["edge"])
     e = batch.senders.shape[1]
@@ -170,8 +178,9 @@ def place_dp_edge_batch(mesh: Mesh, batch):
             "(or a multiple of it)"
         )
 
-    dp = NamedSharding(mesh, P(DATA_AXIS))
-    dp_edge = NamedSharding(mesh, P(DATA_AXIS, "edge"))
+    lead = _lead_entry(batch_axes)
+    dp = NamedSharding(mesh, P(lead))
+    dp_edge = NamedSharding(mesh, P(lead, "edge"))
 
     # Edge leaves are selected by GraphBatch field NAME, not by shape:
     # a node- or graph-axis leaf whose pad coincidentally equals the edge
@@ -193,14 +202,17 @@ def place_dp_edge_batch(mesh: Mesh, batch):
 
 
 def make_dp_edge_train_step(
-    model, tx, mesh: Mesh
+    model, tx, mesh: Mesh, batch_axes=(DATA_AXIS,), state_sharding_fn=None
 ):
-    """Data-parallel x edge-sharded training on a 2-D ``(data, edge)``
-    mesh: sub-batches vmap over the data axis (each holding its own
-    graphs) while every sub-batch's edge arrays shard over the edge axis
-    — GSPMD partitions both (the giant-graph analog of composing DP with
-    sequence parallelism). Parameters stay replicated; the weighted-loss
-    gradient over shared params is the DP gradient mean.
+    """Data-parallel x edge-sharded training on a composed mesh carrying
+    an ``edge`` axis: sub-batches vmap over the leading batch axis (each
+    holding its own graphs) while every sub-batch's edge arrays shard
+    over the edge axis — GSPMD partitions both (the giant-graph analog of
+    composing DP with sequence parallelism). Parameters stay replicated
+    by default; ``state_sharding_fn`` (the Partitioner's FSDP layout)
+    pins an fsdp-sharded parameter/optimizer layout instead — GSPMD then
+    all-gathers parameters into the vmapped forward and reduce-scatters
+    the state update, composing edge sharding with FSDP.
 
     Returns jitted ``(state, batch[D_data-leading]) -> (state, loss,
     tasks)`` matching ``make_sharded_train_step``'s contract."""
@@ -264,15 +276,86 @@ def make_dp_edge_train_step(
             opt_state=opt_state,
             rng=rng,
         )
-        # pin the replicated state layout (see sharded.py: without it the
-        # batch's (data, edge) sharding can propagate into params,
-        # churning layouts across donated steps)
+        # pin the state layout (see sharded.py: without it the batch's
+        # (data, edge) sharding can propagate into params, churning
+        # layouts across donated steps); a caller-supplied layout (the
+        # Partitioner's FSDP sharding) wins over the replicated default
         new_state = jax.lax.with_sharding_constraint(
-            new_state, _state_sharding(mesh, new_state, zero1=False)
+            new_state,
+            _state_sharding(
+                mesh, new_state, zero1=False, state_sharding_fn=state_sharding_fn
+            ),
         )
         return new_state, loss, tasks
 
     return jax.jit(step, donate_argnums=(0,))
+
+
+def make_dp_edge_eval_step(model, mesh: Mesh, with_outputs: bool = False):
+    """Eval companion of :func:`make_dp_edge_train_step`: the vmapped
+    eval forward over the stacked batch axis, edge arrays sharded over
+    the mesh's ``edge`` axis by the batch placement. With
+    ``with_outputs`` the per-head outputs come back flattened over the
+    device axis ([D*G, d] / [D*N, d]) so ``test_epoch``'s mask
+    flattening aligns — the same contract as ``make_sharded_eval_step``."""
+    import jax.numpy as _jnp
+
+    from hydragnn_tpu.models.base import model_loss as _model_loss
+    from hydragnn_tpu.ops.segment_pallas import xla_segment_ops
+
+    def step(state, batch):
+        with xla_segment_ops():
+            return _body(state, batch)
+
+    def _body(state, batch):
+        def per_shard(batch_d):
+            outputs = model.apply(
+                {"params": state.params, "batch_stats": state.batch_stats},
+                batch_d,
+                train=False,
+            )
+            loss, tasks = _model_loss(model.cfg, outputs, batch_d)
+            n = batch_d.graph_mask.sum().astype(_jnp.float32)
+            return loss, _jnp.stack(tasks), n, tuple(outputs)
+
+        losses, tasks, ns, outputs = jax.vmap(per_shard, axis_name=DATA_AXIS)(
+            batch
+        )
+        w = ns / _jnp.maximum(ns.sum(), 1.0)
+        loss = (losses * w).sum()
+        tasks = (tasks * w[:, None]).sum(axis=0)
+        if with_outputs:
+            flat = [o.reshape((-1,) + o.shape[2:]) for o in outputs]
+            return loss, tasks, flat
+        return loss, tasks
+
+    return jax.jit(step)
+
+
+def make_dp_edge_stats_step(model, mesh: Mesh):
+    """BatchNorm-recalibration companion of
+    :func:`make_dp_edge_train_step` (see train.state.make_stats_step):
+    vmapped train-mode forward updating only the running statistics,
+    averaged over the stacked sub-batches."""
+    from hydragnn_tpu.ops.segment_pallas import xla_segment_ops
+
+    def step(state, batch):
+        with xla_segment_ops():
+            def per_shard(batch_d):
+                _, mutated = model.apply(
+                    {"params": state.params, "batch_stats": state.batch_stats},
+                    batch_d,
+                    train=False,
+                    bn_train=True,
+                    mutable=["batch_stats"],
+                )
+                return mutated["batch_stats"]
+
+            stats = jax.vmap(per_shard, axis_name=DATA_AXIS)(batch)
+            new_stats = jax.tree_util.tree_map(lambda s: s.mean(axis=0), stats)
+            return state.replace(batch_stats=new_stats)
+
+    return jax.jit(step)
 
 
 def edge_sharded_gin_layer(

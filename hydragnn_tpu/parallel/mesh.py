@@ -124,15 +124,16 @@ def make_multihost_mesh(
     return Mesh(np.array(picked).reshape(shape), axis_names)
 
 
-def globalize_batch(mesh: Mesh, batch):
+def globalize_batch(mesh: Mesh, batch, axes=DATA_AXIS):
     """Assemble per-process local ``[D_local, ...]`` batch leaves into
-    global ``jax.Array``s sharded ``P(data)`` over a multi-process mesh
-    (global leading axis = D_local × process_count). This is the moment a
-    multi-host batch becomes one logical array — the analog of the
-    reference's implicit "each DDP rank owns its own sub-batch" contract
-    (hydragnn/preprocess/load_data.py:229-231), expressed as a sharding
-    instead of per-rank processes."""
-    sh = NamedSharding(mesh, P(DATA_AXIS))
+    global ``jax.Array``s sharded over ``axes`` (default the data axis;
+    the Partitioner passes its composed ``(data, fsdp)`` lead axes) on a
+    multi-process mesh (global leading axis = D_local × process_count).
+    This is the moment a multi-host batch becomes one logical array — the
+    analog of the reference's implicit "each DDP rank owns its own
+    sub-batch" contract (hydragnn/preprocess/load_data.py:229-231),
+    expressed as a sharding instead of per-rank processes."""
+    sh = NamedSharding(mesh, P(axes))
     return jax.tree_util.tree_map(
         lambda x: jax.make_array_from_process_local_data(sh, np.asarray(x)), batch
     )

@@ -25,12 +25,20 @@ class ServedModel:
     on accelerator backends (each dispatch consumes a freshly-built
     request batch, so its device memory can be recycled into outputs);
     CPU skips donation — XLA:CPU cannot use donated buffers and would
-    warn per dispatch."""
+    warn per dispatch.
+
+    ``partitioner`` (hydragnn_tpu/parallel/partitioner.py) carries the
+    serving mesh: with ``fsdp > 1`` the variables are sharded over it
+    (set by the registry's admission paths) and the server places every
+    request/warmup batch replicated on the same mesh so the AOT
+    executables see one committed layout. None/default = the
+    single-device story, unchanged."""
 
     name: str
     model: Any  # HydraModel
     variables: Dict[str, Any]  # {'params': ..., 'batch_stats': ...}
     nn_config: Optional[Dict[str, Any]] = None
+    partitioner: Any = None
     _forward: Any = dataclasses.field(default=None, repr=False)
 
     @property
@@ -80,9 +88,17 @@ class ModelRegistry:
         model: Any,
         variables: Dict[str, Any],
         nn_config: Optional[Dict[str, Any]] = None,
+        partitioner: Any = None,
     ) -> ServedModel:
+        variables = dict(variables)
+        if partitioner is not None:
+            variables = partitioner.shard_variables(variables)
         served = ServedModel(
-            name=name, model=model, variables=dict(variables), nn_config=nn_config
+            name=name,
+            model=model,
+            variables=variables,
+            nn_config=nn_config,
+            partitioner=partitioner,
         )
         with self._lock:
             self._models[name] = served
@@ -94,6 +110,7 @@ class ModelRegistry:
         nn_config: Dict[str, Any],
         example_graph: Any,
         seed: int = 0,
+        partitioner: Any = None,
     ) -> ServedModel:
         """Build the model from its (completed) ``NeuralNetwork`` config,
         then overwrite the fresh init with the checkpoint under
@@ -125,11 +142,17 @@ class ModelRegistry:
         )
         state = create_eval_state(variables, tx)
         state = load_existing_model(state, log_name, self.log_dir)
+        served_vars = {"params": state.params, "batch_stats": state.batch_stats}
+        if partitioner is not None:
+            # fsdp-shard the served parameters over the partitioner's
+            # mesh (a model beyond one chip's HBM serves from N chips)
+            served_vars = partitioner.shard_variables(served_vars)
         served = ServedModel(
             name=log_name,
             model=model,
-            variables={"params": state.params, "batch_stats": state.batch_stats},
+            variables=served_vars,
             nn_config=nn_config,
+            partitioner=partitioner,
         )
         with self._lock:
             self._models[log_name] = served

@@ -198,6 +198,18 @@ class ModelServer:
             raise ValueError("reference_samples must be non-empty (sizes the buckets)")
         self.served = served
         self.config = config or ServeConfig()
+        # ONE sharding story with training (docs/PARALLELISM.md): the
+        # served model's Partitioner owns the serving mesh — fsdp-sharded
+        # variables, request/warmup batches placed replicated on the same
+        # mesh so every AOT executable sees one committed layout. The
+        # default Partitioner is the single-device story: every placement
+        # below is a no-op.
+        if served.partitioner is not None:
+            self.partitioner = served.partitioner
+        else:
+            from hydragnn_tpu.parallel import Partitioner
+
+            self.partitioner = Partitioner()
         self.buckets: List[Bucket] = build_bucket_ladder(
             reference_samples,
             self.config.max_batch,
@@ -280,6 +292,11 @@ class ModelServer:
                     for b in self.buckets
                 ],
                 "warmup_compile_s": round(time.monotonic() - t0, 3),
+                # which mesh the ladder compiled under + the served
+                # parameter sharding summary (fsdp serving)
+                "parallel": self.partitioner.manifest(
+                    variables=self.served.variables
+                ),
             }
         )
         from hydragnn_tpu.resilience.supervisor import SupervisorPolicy
@@ -489,6 +506,10 @@ class ModelServer:
                     new_vars = dict(variables)
                 if inject.serve_torn_reload():
                     new_vars = _corrupt_variables(new_vars)
+                # same committed layout as the running weights: the warm
+                # executables are sharding-exact, so the candidate must
+                # land on the mesh BEFORE the canary invokes them
+                new_vars = self.partitioner.shard_variables(new_vars)
                 self._canary(new_vars)
             except Exception as exc:
                 self.metrics.record_reload(ok=False)
@@ -578,10 +599,12 @@ class ModelServer:
         from hydragnn_tpu.graph.batch import batch_graphs
 
         inject.maybe_serve_raise([seq])
-        batch = batch_graphs(
-            [g],
-            node_multiple=self.config.node_multiple,
-            edge_multiple=self.config.edge_multiple,
+        batch = self.partitioner.shard_inference_batch(
+            batch_graphs(
+                [g],
+                node_multiple=self.config.node_multiple,
+                edge_multiple=self.config.edge_multiple,
+            )
         )
         shape_key = (batch.num_nodes, batch.num_edges, batch.num_graphs)
         with self._eager_lock:
@@ -651,11 +674,13 @@ class ModelServer:
         try:
             inject.maybe_serve_wedge(seqs)
             inject.maybe_serve_raise(seqs)
-            batch = batch_graphs(
-                [r.item for r in requests],
-                n_node_pad=bucket.node_pad,
-                n_edge_pad=bucket.edge_pad,
-                n_graph_pad=bucket.graph_pad,
+            batch = self.partitioner.shard_inference_batch(
+                batch_graphs(
+                    [r.item for r in requests],
+                    n_node_pad=bucket.node_pad,
+                    n_edge_pad=bucket.edge_pad,
+                    n_graph_pad=bucket.graph_pad,
+                )
             )
             exe = self._cache.executable(bucket)
             outputs = [np.asarray(o) for o in exe(self.served.variables, batch)]
@@ -796,9 +821,13 @@ class ModelServer:
             g["pos"] = np.zeros((2, spec["pos_dim"]), dtype=np.float32)
         if spec["has_edge_attr"]:
             g["edge_attr"] = np.zeros((1, spec["edge_dim"]), dtype=np.float32)
-        return batch_graphs(
-            [g],
-            n_node_pad=bucket.node_pad,
-            n_edge_pad=bucket.edge_pad,
-            n_graph_pad=bucket.graph_pad,
+        # placed through the partitioner so the AOT lowering sees the
+        # exact committed layout request batches will arrive with
+        return self.partitioner.shard_inference_batch(
+            batch_graphs(
+                [g],
+                n_node_pad=bucket.node_pad,
+                n_edge_pad=bucket.edge_pad,
+                n_graph_pad=bucket.graph_pad,
+            )
         )

@@ -408,19 +408,27 @@ def _allgather_varlen(arr: np.ndarray) -> np.ndarray:
     return np.concatenate([gathered[p, : counts[p]] for p in range(len(counts))])
 
 
-def _scan_auto_eligible(loader) -> Tuple[bool, str]:
+def _scan_auto_eligible(loader, partitioner=None) -> Tuple[bool, str]:
     """Is the whole-epoch scan dispatch the right DEFAULT here?
     (``Training.scan_epoch`` unset — an explicit true/false always
     wins.) Eligible = single-device mesh + a loader that can stack the
     split device-resident + no feature that inherently needs batch
     granularity (step-indexed fault injection). Returns (eligible,
     human-readable reason) — the reason lands in the flight manifest's
-    ``dispatch_mode`` field either way."""
+    ``dispatch_mode`` field either way.
+
+    ``partitioner`` (hydragnn_tpu/parallel/partitioner.py) is the
+    authoritative topology signal when given: the scan path trusts
+    ``partitioner.single_device`` instead of sniffing the loader's
+    mesh shape itself."""
     if not hasattr(loader, "stacked_device_batches") or not hasattr(
         loader, "shuffle"
     ):
         return False, "loader cannot stack device-resident batches"
-    if getattr(loader, "device_stack", 1) != 1:
+    if partitioner is not None:
+        if not partitioner.single_device:
+            return False, "partitioner mesh is multi-device"
+    elif getattr(loader, "device_stack", 1) != 1:
         return False, "multi-device stacked loader (sharded mesh)"
     if jax.process_count() > 1:
         return False, "multi-process run"
@@ -467,6 +475,7 @@ def train_validate_test(
     stats_step=None,
     flight=None,
     run_config=None,
+    partitioner=None,
 ) -> Tuple[TrainState, Dict[str, Any]]:
     """Train for ``Training.num_epoch`` epochs with validation-driven LR
     plateau + early stopping; returns (final_state, history dict). ``config``
@@ -482,7 +491,13 @@ def train_validate_test(
     data-wait / dispatch / device step-time decomposition and compile
     counts, and a final summary. Callers may pass their own ``flight``
     recorder (bench harnesses) and ``run_config`` (the full resolved
-    config for the manifest; defaults to the NeuralNetwork section)."""
+    config for the manifest; defaults to the NeuralNetwork section).
+
+    ``partitioner`` (hydragnn_tpu/parallel/partitioner.py) is the run's
+    sharding authority: the scan-epoch auto-dispatch trusts its
+    single-device verdict, and the manifest's ``parallel`` block (mesh
+    shape, fsdp factor, per-leaf sharding summary, per-device bytes,
+    replicated-leaf fallbacks) comes from it — docs/PARALLELISM.md."""
     training = config["Training"]
     num_epoch = int(training["num_epoch"])
     early_stop = bool(training.get("EarlyStopping", False))
@@ -509,7 +524,9 @@ def train_validate_test(
     if not loop_owned:
         use_scan, dispatch_reason = False, "caller-supplied train step"
     elif scan_cfg is None:
-        use_scan, dispatch_reason = _scan_auto_eligible(train_loader)
+        use_scan, dispatch_reason = _scan_auto_eligible(
+            train_loader, partitioner=partitioner
+        )
         if use_scan and (profiler is not None or "Profile" in config):
             use_scan, dispatch_reason = False, "per-step profiler configured"
         if use_scan and float(training.get("watchdog_stall_s", 0) or 0) > 0:
@@ -832,6 +849,18 @@ def train_validate_test(
         }
 
     _dev0 = jax.devices()[0]
+    # flight ``parallel`` block (docs/PARALLELISM.md): the partitioner's
+    # mesh shape, axis names, fsdp factor, per-leaf param/optimizer
+    # sharding summary, per-device bytes, and any replicated-leaf
+    # fallbacks — computed from the PLACED state so it reports what is
+    # actually committed, not what was intended
+    if partitioner is not None:
+        parallel_block = partitioner.manifest(state=state)
+    else:
+        parallel_block = {
+            "available": False,
+            "reason": "caller passed no partitioner",
+        }
     flight.start_run(
         {
             "run": log_name,
@@ -843,6 +872,7 @@ def train_validate_test(
                 "device_stack": getattr(train_loader, "device_stack", 1),
                 "process_count": jax.process_count(),
             },
+            "parallel": parallel_block,
             "pad_plans": {
                 "train": _loader_plan(train_loader),
                 "val": _loader_plan(val_loader),

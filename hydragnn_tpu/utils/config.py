@@ -174,6 +174,16 @@ def update_config(
     # per-epoch train.prom textfile export when set
     nn["Training"].setdefault("diagnostics", True)
     nn["Training"].setdefault("diag_every", 0)
+    # NeuralNetwork.Parallel: the unified Partitioner's axis widths
+    # (hydragnn_tpu/parallel/partitioner.py, docs/PARALLELISM.md).
+    # ``fsdp`` shards parameters AND optimizer state over their own mesh
+    # axis (models beyond one chip's HBM); ``edge`` shards each
+    # sub-batch's edge arrays (giant graphs). The data width is derived
+    # from the available devices, never configured here. No reference
+    # analog (the reference's only model-parallel axis is DDP).
+    nn.setdefault("Parallel", {})
+    nn["Parallel"].setdefault("fsdp", 1)
+    nn["Parallel"].setdefault("edge", 1)
 
     config = normalize_output_config(config)
     return config

@@ -85,6 +85,11 @@ def render_report(events: List[dict]) -> str:
         ):
             if key in man:
                 lines.append(f"  {key}: {_fmt(man[key])}")
+        par = man.get("parallel")
+        if isinstance(par, dict) and par.get("available"):
+            from hydragnn_tpu.parallel.partitioner import parallel_manifest_summary
+
+            lines.append(f"  parallel: {parallel_manifest_summary(par)}")
         for split, plan in (man.get("pad_plans") or {}).items():
             lines.append(f"  pad[{split}]: {plan}")
     epochs = [e for e in events if e.get("kind") == "epoch"]
@@ -481,6 +486,16 @@ def main(argv=None) -> int:
                     print(f"  - {prob}")
             else:
                 print(f"{path}: OK ({len(events)} events)")
+                # surface the parallelism story alongside the verdict:
+                # which mesh ran this record and how its state sharded
+                start = _first(events, "run_start")
+                par = ((start or {}).get("manifest") or {}).get("parallel")
+                if isinstance(par, dict) and par.get("available"):
+                    from hydragnn_tpu.parallel.partitioner import (
+                        parallel_manifest_summary,
+                    )
+
+                    print(f"  parallel: {parallel_manifest_summary(par)}")
             _print_warnings(events)
         else:
             if len(args.records) > 1:

@@ -25,7 +25,10 @@ the compiled programs themselves:
      HBM, times the DERIVED 16-way efficiency — replacing BASELINE.md's
      hand arithmetic.
 
-Writes SCALING_est_r05.json and prints it.
+Writes SCALING_est_r06.json (override with SCALING_OUT) and prints it.
+FSDP variants (``FSDP_WIDTHS``, default "2,4") additionally compile the
+largest width with parameters+optimizer sharded over an fsdp axis and
+model the all-gather/reduce-scatter wire traffic the HLO then carries.
 
 Link budgets: v5e exposes 4 ICI links/chip in a 2D torus (1,600 Gbps
 aggregate = 200 GB/s); a ring all-reduce uses one axis, and achievable
@@ -104,41 +107,68 @@ def collective_bytes(hlo: str) -> dict:
     return out
 
 
-def compile_width(n_dev: int) -> dict:
-    """Compile the sharded flagship step over an n_dev data mesh and
-    return its collective-bytes table + parameter size."""
+def compile_width(n_dev: int, fsdp: int = 1) -> dict:
+    """Compile the partitioned flagship step over an n_dev mesh
+    (``data = n_dev/fsdp × fsdp``) and return its collective-bytes table
+    + parameter size + the partitioner's per-device state bytes. The
+    SAME Partitioner train/serve/bench use builds the step, so the HLO
+    read here is the HLO a real run compiles."""
     from hydragnn_tpu.flagship import build_flagship
-    from hydragnn_tpu.parallel import make_mesh, make_sharded_train_step, place_state
+    from hydragnn_tpu.parallel import Partitioner
     from hydragnn_tpu.train import create_train_state, select_optimizer
 
     config, model, variables, loader = build_flagship(
         n_samples=4 * n_dev * 2, batch_size=4 * n_dev, device_stack=n_dev,
         hidden_dim=128, num_conv_layers=6,
     )
-    mesh = make_mesh(n_dev)
+    part = Partitioner(data=n_dev // fsdp, fsdp=fsdp)
     tx = select_optimizer(config["NeuralNetwork"]["Training"])
-    state = place_state(mesh, create_train_state(variables, tx))
-    step = make_sharded_train_step(model, tx, mesh, compute_dtype=jnp.bfloat16)
+    state = part.shard_init(create_train_state(variables, tx))
+    step = part.shard_train_step(model, tx, compute_dtype=jnp.bfloat16)
     batch = next(iter(loader))
     hlo = step.lower(state, batch).compile().as_text()
     param_bytes = sum(
         int(np.prod(p.shape)) * 4
         for p in jax.tree_util.tree_leaves(variables["params"])
     )
-    return {"collectives": collective_bytes(hlo), "param_bytes": param_bytes}
+    man = part.manifest(state=state)
+    return {
+        "collectives": collective_bytes(hlo),
+        "param_bytes": param_bytes,
+        "fsdp": fsdp,
+        "state_bytes_per_device": (
+            man["params"]["bytes_per_device"] + man["opt"]["bytes_per_device"]
+        ),
+        "state_bytes_global": (
+            man["params"]["bytes_global"] + man["opt"]["bytes_global"]
+        ),
+    }
 
 
 def width_record(n_dev: int, comp: dict, dcn_slices: int = 1) -> dict:
     """Efficiency model for one mesh width.
 
-    In-step: ring all-reduce wire bytes over ICI; when the data axis
-    spans ``dcn_slices`` ICI slices, the inter-slice fraction of the
-    ring rides DCN instead (2(s-1)/s of the payload crosses a slice
-    boundary once per direction, shared by the slice's hosts)."""
+    In-step: ring all-reduce wire bytes over ICI; with an fsdp axis the
+    compiled program additionally carries the FSDP parameter all-gather
+    and gradient/state reduce-scatter — read from the SAME HLO and
+    modeled as rings over the fsdp axis width (each chip wires
+    (f-1)/f of the payload per collective). When the data axis spans
+    ``dcn_slices`` ICI slices, the inter-slice fraction of the ring
+    rides DCN instead (2(s-1)/s of the payload crosses a slice boundary
+    once per direction, shared by the slice's hosts)."""
     ar = comp["collectives"].get("all-reduce", 0)
     n = n_dev
     wire = 2 * (n - 1) / n * ar
     t_ici_ms = wire / (ICI_GBPS * 1e9) * 1e3
+    # FSDP wire traffic (zero on pure-DP meshes, whose HLO carries no
+    # all-gather/reduce-scatter): parameters all-gather into the step,
+    # gradients/optimizer state reduce-scatter out of it, both ringing
+    # over the fsdp axis
+    f = int(comp.get("fsdp", 1) or 1)
+    ag = comp["collectives"].get("all-gather", 0)
+    rs = comp["collectives"].get("reduce-scatter", 0)
+    fsdp_wire = ((f - 1) / f) * (ag + rs) if f > 1 else 0.0
+    t_fsdp_ms = fsdp_wire / (ICI_GBPS * 1e9) * 1e3
     t_dcn_ms = 0.0
     if dcn_slices > 1:
         # ring over slices: each slice boundary carries the full reduced
@@ -160,11 +190,12 @@ def width_record(n_dev: int, comp: dict, dcn_slices: int = 1) -> dict:
         ckpt_bytes / (DCN_GBPS * 1e9) * 1e3
         / (STEPS_PER_EPOCH * EPOCHS_PER_CHECKPOINT)
     )
-    exposed = t_ici_ms + t_dcn_ms + t_eval_ms + t_ckpt_ms
+    exposed = t_ici_ms + t_fsdp_ms + t_dcn_ms + t_eval_ms + t_ckpt_ms
     eff_no_overlap = STEP_MS_DEVICE / (STEP_MS_DEVICE + exposed)
     eff_half_overlap = STEP_MS_DEVICE / (STEP_MS_DEVICE + 0.5 * exposed)
-    return {
+    rec = {
         "n_devices": n,
+        "fsdp": f,
         "dcn_slices": dcn_slices,
         "collective_bytes_per_step": comp["collectives"],
         "allreduce_bytes_per_step": int(ar),
@@ -176,6 +207,18 @@ def width_record(n_dev: int, comp: dict, dcn_slices: int = 1) -> dict:
         "dp_efficiency_no_overlap": round(eff_no_overlap, 4),
         "dp_efficiency_half_overlap": round(eff_half_overlap, 4),
     }
+    if f > 1:
+        rec.update(
+            {
+                "allgather_bytes_per_step": int(ag),
+                "reduce_scatter_bytes_per_step": int(rs),
+                "fsdp_wire_bytes_per_chip_ring": int(fsdp_wire),
+                "t_fsdp_ms": round(t_fsdp_ms, 3),
+                "state_bytes_per_device": comp.get("state_bytes_per_device"),
+                "state_bytes_global": comp.get("state_bytes_global"),
+            }
+        )
+    return rec
 
 
 def main():
@@ -185,6 +228,23 @@ def main():
         print(f"compiling {n}-way sharded step ...", file=sys.stderr)
         comp_by_n[n] = compile_width(n)
         widths[str(n)] = width_record(n, comp_by_n[n])
+    # FSDP variants at the largest width: the (data = n/f, fsdp = f)
+    # layouts of the SAME computation — all-gather/reduce-scatter wire
+    # traffic read from their compiled HLO, state bytes per device from
+    # the partitioner's committed shardings
+    n_max = max(MESH_SIZES)
+    fsdp_widths = [
+        int(s)
+        for s in os.environ.get("FSDP_WIDTHS", "2,4").split(",")
+        if s.strip()
+    ]
+    for f in fsdp_widths:
+        if f <= 1 or n_max % f:
+            continue
+        print(f"compiling {n_max}-way fsdp={f} step ...", file=sys.stderr)
+        widths[f"{n_max}_fsdp{f}"] = width_record(
+            n_max, compile_width(n_max, fsdp=f)
+        )
     # multi-slice variants at 32-way: the data axis spanning 2 and 4
     # ICI slices (DCN between slices)
     if 32 in comp_by_n:
@@ -212,7 +272,10 @@ def main():
     }
 
     rec = {
-        "mesh": "1-D data-parallel (DP) over ICI (+DCN variants)",
+        "mesh": (
+            "Partitioner (data[, fsdp]) over ICI (+DCN variants); "
+            "fsdp variants shard params+optimizer over the fsdp axis"
+        ),
         "step_ms_device_single_chip": STEP_MS_DEVICE,
         "batch_per_chip": BATCH_PER_CHIP,
         "ici_gbps_assumed": ICI_GBPS,
@@ -234,7 +297,7 @@ def main():
     }
     out = os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "SCALING_est_r05.json",
+        os.environ.get("SCALING_OUT", "SCALING_est_r06.json"),
     )
     with open(out, "w") as f:
         json.dump(rec, f, indent=1)
