@@ -74,7 +74,8 @@ def _measure_dispatch_ms() -> float:
     return statistics.median(ts)
 
 
-def _kernel_roofline(cols, rows, tot_us, n_steps=2, top=10) -> list:
+def _kernel_roofline(cols, rows, tot_us, n_steps=2, top=10,
+                     edge_occ_frac=None) -> list:
     """Per-kernel roofline attribution from the hlo_stats trace rows:
     for each of the ``top`` ops by device self time, report its time
     share, its bytes — MEASURED (self time x xprof's measured BW) for
@@ -134,13 +135,21 @@ def _kernel_roofline(cols, rows, tot_us, n_steps=2, top=10) -> list:
             "bytes_source": src,
             "gbps": round(gbps, 1),
         }
+        # cost-model entries price PADDED operand shapes; the batch's
+        # real-edge occupancy says how much of that a kernel bounding
+        # its chunk loop at the occupancy actually moves (ISSUE 10)
+        if src == "costmodel" and edge_occ_frac is not None:
+            entry["bytes_per_step_useful"] = round(
+                nbytes / n_steps * edge_occ_frac
+            )
+            entry["pad_waste_frac"] = round(1.0 - edge_occ_frac, 4)
         if peak_bw:
             entry["pct_hbm_roofline"] = round(100.0 * gbps * 1e9 / peak_bw, 1)
         out.append(entry)
     return out
 
 
-def _measured_traffic(compiled, state, batches) -> dict:
+def _measured_traffic(compiled, state, batches, edge_occ_frac=None) -> dict:
     """Trace 2 executions and sum per-op device self time and
     self_time x measured-BW bytes from xprof's hlo_stats — the
     MEASURED counterpart of the cost model's 'bytes accessed', which
@@ -196,7 +205,9 @@ def _measured_traffic(compiled, state, batches) -> dict:
             # an hlo_stats dialect without the columns must not cost the
             # measurement above)
             try:
-                out["roofline"] = _kernel_roofline(cols, tab["rows"], tot_us)
+                out["roofline"] = _kernel_roofline(
+                    cols, tab["rows"], tot_us, edge_occ_frac=edge_occ_frac
+                )
             except Exception:
                 pass
             # xprof reports no memory BW for custom-calls (Pallas
@@ -230,6 +241,16 @@ def _measured_traffic(compiled, state, batches) -> dict:
                 out["hbm_gbps_combined_est"] = round(
                     (tot_bytes + kernel_bytes) / (tot_us / 1e6) / 1e9, 1
                 )
+                if edge_occ_frac is not None:
+                    # shape-priced kernel bytes scaled by the batch's
+                    # real-edge occupancy: the USEFUL fraction of that
+                    # estimate (occupancy skipping makes the rest free)
+                    out["kernel_bytes_per_step_useful_est"] = round(
+                        kernel_bytes / 2 * edge_occ_frac
+                    )
+                    out["kernel_pad_waste_frac"] = round(
+                        1.0 - edge_occ_frac, 4
+                    )
             except Exception:
                 pass
             return out
@@ -419,6 +440,20 @@ def _bench_one(
     real_nodes = float(
         sum(s.num_nodes for s in loader.samples) / max(len(loader.samples), 1)
     )
+    # per-config pad-occupancy + the analytic conv-traffic model
+    # (useful vs padded bytes across kernel modes — the numbers the
+    # cost model can't see because it prices padded operand shapes)
+    from hydragnn_tpu.obs.introspect import (
+        conv_traffic_model,
+        pad_waste_from_batch,
+    )
+
+    pad_waste = pad_waste_from_batch(batches[0])
+    conv_traffic = conv_traffic_model(
+        pad_waste["node_pad"], pad_waste["edge_pad"], hidden, layers,
+        real_edges=pad_waste["real_edges_mean"],
+    )
+    edge_occ_frac = 1.0 - pad_waste["edge_waste_frac"]
     out = {
         "graphs_per_sec": round(batch_size / step_s, 2),
         "step_ms": round(step_s * 1e3, 3),
@@ -430,13 +465,19 @@ def _bench_one(
         "edge_features": bool(edge_lengths),
         "hidden_dim": hidden,
         "num_conv_layers": layers,
+        "pad_waste": pad_waste,
+        "conv_traffic_model": conv_traffic,
     }
     if not scan:
         out["step_ms_median"] = round(statistics.median(seg_ms), 3)
         out["step_ms_segments"] = [round(t, 2) for t in seg_ms]
         out["step_ms_spread"] = round(max(seg_ms) - min(seg_ms), 3)
     if measure_bytes:
-        out.update(_measured_traffic(compiled, state, batches))
+        out.update(
+            _measured_traffic(
+                compiled, state, batches, edge_occ_frac=edge_occ_frac
+            )
+        )
     if scan_step_ms is not None:
         out["scan_step_ms"] = round(scan_step_ms, 3)
         out["graphs_per_sec_scan"] = round(batch_size / max(scan_step_ms, 1e-9) * 1e3, 2)

@@ -60,8 +60,10 @@
 #                         measured with D2H-fenced segments and compared
 #                         against the committed BENCH_CI_BASELINE.json
 #                         (>15% graphs/sec regression fails; MFU too on
-#                         TPU), then a self-test proving the gate fails
-#                         on an injected slowdown.
+#                         TPU; >15% cost-model bytes/step INCREASE
+#                         fails), then self-tests proving the gate fails
+#                         on an injected slowdown and on injected
+#                         cost-model traffic.
 #   9. full matrix      — opt-in (CI_FULL=1): all 7 models x head configs
 #                         trained to the reference accuracy thresholds
 #                         (HYDRAGNN_FULL_MATRIX=1, ~15 min).
@@ -388,6 +390,15 @@ if JAX_PLATFORMS=cpu python tools/bench_gate.py --inject-slowdown-ms 40 >/tmp/_g
     exit 1
 else
     echo "bench gate self-test: injected slowdown correctly rejected"
+fi
+# same for the traffic arm: price a real ballast executable's
+# cost-model bytes into the step and require a nonzero exit
+if JAX_PLATFORMS=cpu python tools/bench_gate.py --inject-traffic-mb 64 >/tmp/_gate_traffic.log 2>&1; then
+    echo "FAIL: bench gate did not catch 64 MiB of injected step traffic"
+    cat /tmp/_gate_traffic.log
+    exit 1
+else
+    echo "bench gate self-test: injected traffic correctly rejected"
 fi
 
 if [ "${CI_FULL:-0}" = "1" ]; then

@@ -618,6 +618,13 @@ def _mask_out(batch: GraphBatch) -> GraphBatch:
             w[1, pad_slot // _block_rows(batch, w)] = batch.dense_senders.size
             dense["dense_sender_win"] = w
     derived = {}
+    if batch.edge_occupancy is not None:
+        # ZERO occupancy: the fused conv kernel's chunk loop clamps at
+        # ceil(edge_occupancy / CE), so a filler batch costs no DMAs and
+        # no MXU work at all on its device slot (ISSUE 10 satellite)
+        derived["edge_occupancy"] = _np.int32(0)
+    if batch.n_real_nodes is not None:
+        derived["n_real_nodes"] = _np.int32(0)
     if batch.sender_perm is not None:
         derived["sender_perm"] = _np.arange(batch.num_edges, dtype=_np.int32)
     if batch.in_degree is not None:

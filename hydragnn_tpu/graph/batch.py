@@ -144,6 +144,24 @@ class GraphBatch:
     # them.
     sender_win: Optional[jnp.ndarray] = None  # [2, n_blocks] int32
     dense_sender_win: Optional[jnp.ndarray] = None  # [2, n_blocks] int32
+    # Edge OCCUPANCY: scalar int32 — the index AFTER the last edge slot
+    # that can carry a real (unmasked) edge. Everything at position >=
+    # edge_occupancy is pure padding (the batch_graphs sentinel tail;
+    # run_align keeps its masked self-loops interleaved BELOW this
+    # bound, so the bound is int(adeg.sum()) there, tot_edges otherwise;
+    # _mask_out filler batches advertise 0). The fused conv kernel
+    # clamps its chunk loop at ceil(edge_occupancy / CE), so tail
+    # padding costs zero DMAs and zero MXU work — device cost scales
+    # with real edges, not the pad plan (ISSUE 10). Carried as a scalar
+    # ARRAY (not static) so bucket-ladder batches with different
+    # occupancies share one jit cache entry and device_stack stacking
+    # works. None on externally-built batches — consumers then process
+    # the full pad (slower, never wrong).
+    edge_occupancy: Optional[jnp.ndarray] = None  # [] int32
+    # Real (unmasked) node count, for pad-waste accounting in the
+    # bench/ledger layers (obs/introspect.py, bench.py). None on
+    # externally-built batches.
+    n_real_nodes: Optional[jnp.ndarray] = None  # [] int32
     # STATIC (pytree meta): run-aligned edge layout factor. When K > 0,
     # every node's receiver-run is padded to a multiple of K with MASKED
     # self-loop edges (sender = receiver = the node), so every K-group
@@ -212,6 +230,16 @@ class GraphBatch:
                     "dense map assume padding edges only ever point at "
                     "padding nodes)"
                 )
+        if self.edge_occupancy is not None:
+            occ = int(np_.asarray(self.edge_occupancy))
+            real_pos = np_.flatnonzero(emask)
+            assert not real_pos.size or int(real_pos.max()) < occ, (
+                "unmasked edge at position >= edge_occupancy (the fused "
+                "kernel skips all chunks past the occupancy bound)"
+            )
+            assert int(np_.asarray(self.n_real_nodes)) == int(nmask.sum()), (
+                "n_real_nodes != node_mask.sum()"
+            )
         if self.sender_perm is not None:
             sp = np_.asarray(self.sender_perm)
             assert np_.all(send[sp][:-1] <= send[sp][1:]), (
@@ -391,6 +419,13 @@ def batch_graphs(
         if has_edge_attr:
             edge_attr = edge_attr[perm]
 
+    # Index after the last slot that can hold a real edge (see
+    # GraphBatch.edge_occupancy). Receiver-major sort puts the sentinel
+    # tail last, so this is tot_edges here; the run_align relayout
+    # interleaves its masked self-loops below int(adeg.sum()) and
+    # overwrites it below.
+    edge_occ = tot_edges
+
     if run_align and run_align > 1:
         if dense_slots:
             raise ValueError("run_align and dense_slots are mutually exclusive")
@@ -431,6 +466,7 @@ def batch_graphs(
             new_ea[new_pos] = edge_attr[:tot_edges]
             edge_attr = new_ea
         senders, receivers, edge_mask = new_send, new_recv, new_mask
+        edge_occ = total
 
     dense_senders = dense_mask = dense_edge_attr = dense_sender_perm = None
     if dense_slots is not None and dense_slots > 0:
@@ -511,6 +547,8 @@ def batch_graphs(
         dense_sender_win=(
             jnp.asarray(dense_sender_win) if dense_sender_win is not None else None
         ),
+        edge_occupancy=jnp.asarray(np.int32(edge_occ)),
+        n_real_nodes=jnp.asarray(np.int32(tot_nodes)),
         run_align=int(run_align) if run_align and run_align > 1 else 0,
     )
 

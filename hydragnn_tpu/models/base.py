@@ -88,6 +88,23 @@ class ModelConfig:
     # fall back to the composed segment-op paths elsewhere, so the
     # knob only ever selects between numerically-matching paths.
     fused_conv: bool = True
+    # Architecture.conv_bf16 (default off): stream the conv hot path's
+    # activation bytes (x, gathered sender windows, receiver tables,
+    # per-edge scale) in bfloat16 with f32 MXU accumulation — halves
+    # the dominant HBM traffic on the bandwidth-bound profile
+    # (docs/PERF.md r08). Params and the inter-layer BN+relu stream
+    # stay f32; numerics are tolerance-bounded vs the f32 path
+    # (tests/test_conv_traffic.py pins the bound).
+    conv_bf16: bool = False
+    # Architecture.conv_residency (default off): opt IN to the
+    # multi-layer VMEM-resident conv stack (ops/fused_conv.py:
+    # fused_conv_stack) where a consumer can use it. The chassis
+    # encoder interleaves MaskedBatchNorm between conv layers, which
+    # breaks cross-layer residency by construction — the knob is
+    # threaded for external/headless stacks and recorded in the flight
+    # manifest; docs/PERF.md r08 documents the VMEM-budget decision
+    # rule and this limitation honestly.
+    conv_residency: bool = False
     # SyncBatchNorm equivalent: name of the mapped device axis to psum
     # batch statistics over (reference: SyncBatchNorm convert,
     # hydragnn/utils/distributed.py:227-228). None = per-device stats,
@@ -239,6 +256,9 @@ class HydraModel(nn.Module):
                     edge_attr=edge_attr,
                     edge_weight=edge_weight,
                     fused_conv=cfg.fused_conv,
+                    conv_bf16=cfg.conv_bf16,
+                    # in-forward edges are rebuilt per step with their
+                    # own mask layout; no host occupancy bound applies
                 )
             if cfg.use_edge_attr and batch.edge_attr is not None:
                 edge_weight = jnp.linalg.norm(batch.edge_attr, axis=-1)
@@ -295,8 +315,10 @@ class HydraModel(nn.Module):
             ),
             sender_win=batch.sender_win,
             dense_sender_win=batch.dense_sender_win,
+            edge_occ=batch.edge_occupancy,
             run_align=batch.run_align,
             fused_conv=cfg.fused_conv,
+            conv_bf16=cfg.conv_bf16,
         )
 
     def _apply_conv(self, conv, x, ctx, train: bool):

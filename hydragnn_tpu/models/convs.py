@@ -68,6 +68,12 @@ class EdgeContext:
     # the backward
     sender_win: Optional[jnp.ndarray] = None  # [2, n_blocks] int32
     dense_sender_win: Optional[jnp.ndarray] = None  # [2, n_blocks] int32
+    # loader-emitted edge occupancy (GraphBatch.edge_occupancy): index
+    # after the last slot that can hold a REAL edge. Handed to the fused
+    # kernel as its chunk-loop bound so fully-padded tail chunks (bucket
+    # ladders, _mask_out filler) cost zero DMAs/MXU work. None = process
+    # the full pad (externally-built batches; always correct).
+    edge_occ: Optional[jnp.ndarray] = None  # [] int32
     # static: run-aligned edge layout factor (GraphBatch.run_align).
     # K > 0 guarantees every K-group of edge slots shares one receiver
     # (or is batch tail), so segment reductions pre-reduce K-fold with
@@ -78,6 +84,14 @@ class EdgeContext:
     # (ops/fused_conv.py) where the knob/backend allow; layers fall back
     # to the composed segment-op paths otherwise.
     fused_conv: bool = False
+    # static: Architecture.conv_bf16 — flow the conv hot path's
+    # activation streams (x, gathered sender windows, receiver tables,
+    # per-edge scale) in bfloat16 with f32 MXU accumulation, halving the
+    # dominant HBM byte streams (ISSUE 10). Applies to BOTH the fused
+    # kernel and the composed fallback so the two stay within the
+    # documented tolerance of each other; the inter-layer stream is
+    # restored to the caller dtype on return (BN + relu run f32).
+    conv_bf16: bool = False
 
 
 def _local_kernels(n_rows: int) -> bool:
@@ -118,18 +132,25 @@ def _gather_scatter(
     ONE fused Pallas kernel (gather + optional per-edge scale + scatter
     all in VMEM, no [E, H] HBM intermediate) when active, else the
     composed gather + masked segment sum the layers always used.
-    Returns x.dtype."""
+    Returns x.dtype. ``ctx.conv_bf16`` rounds the streamed operands to
+    bf16 in BOTH paths (accumulation stays f32 — the segment-sum family
+    contract); the result is cast back to the incoming dtype."""
+    xd = x.dtype
+    if ctx.conv_bf16:
+        x = x.astype(jnp.bfloat16)
+        if scale is not None:
+            scale = scale.astype(jnp.bfloat16)
     if _fused_active(ctx):
         from hydragnn_tpu.ops.fused_conv import fused_conv
 
         return fused_conv(
             x, ctx.senders, ctx.receivers, ctx.edge_mask, n,
-            scale=scale, win=ctx.sender_win,
-        ).astype(x.dtype)
+            scale=scale, win=ctx.sender_win, real_edges=ctx.edge_occ,
+        ).astype(xd)
     vals = _gather_senders(x, ctx)
     if scale is not None:
         vals = vals * scale
-    return _segment_sum_edges(vals, ctx, n)
+    return _segment_sum_edges(vals, ctx, n).astype(xd)
 
 
 def _run_presum(vals: jnp.ndarray, ctx: EdgeContext) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -293,14 +314,20 @@ class CGConv(nn.Module):
         n, fin = x.shape
         h = self.out_dim
         use_edge = ctx.edge_attr is not None
-        dense_f = nn.Dense(h)  # gate (Dense_0)
-        dense_s = nn.Dense(h)  # core (Dense_1)
+        # conv_bf16 rounds the streamed operands (x, receiver tables,
+        # edge features) to bf16 in both branches of this layer; params
+        # stay f32 (param_dtype default), so the knob changes only the
+        # hot-path byte streams, not initialization or the checkpoint.
+        cdt = jnp.bfloat16 if ctx.conv_bf16 else None
+        dense_f = nn.Dense(h, dtype=cdt)  # gate (Dense_0)
+        dense_s = nn.Dense(h, dtype=cdt)  # core (Dense_1)
+        xc = x.astype(jnp.bfloat16) if ctx.conv_bf16 else x
         if not _fused_active(ctx):
-            xi = S.gather_rows(x, ctx.receivers, n, True)
-            xj = _gather_senders(x, ctx)
+            xi = S.gather_rows(xc, ctx.receivers, n, True)
+            xj = _gather_senders(xc, ctx)
             z = [xi, xj]
             if use_edge:
-                z.append(ctx.edge_attr)
+                z.append(ctx.edge_attr.astype(xc.dtype))
             z = jnp.concatenate(z, axis=-1)
             gate = jax.nn.sigmoid(dense_f(z))
             core = jax.nn.softplus(dense_s(z))
@@ -315,30 +342,31 @@ class CGConv(nn.Module):
         dummy = jnp.zeros((1, zdim), x.dtype)
         dense_f(dummy)
         dense_s(dummy)
-        wf = dense_f.variables["params"]["kernel"].astype(x.dtype)
-        bf = dense_f.variables["params"]["bias"].astype(x.dtype)
-        ws = dense_s.variables["params"]["kernel"].astype(x.dtype)
-        bs = dense_s.variables["params"]["bias"].astype(x.dtype)
+        wf = dense_f.variables["params"]["kernel"].astype(xc.dtype)
+        bf = dense_f.variables["params"]["bias"].astype(xc.dtype)
+        ws = dense_s.variables["params"]["kernel"].astype(xc.dtype)
+        bs = dense_s.variables["params"]["bias"].astype(xc.dtype)
 
         # receiver-side parts as node-level matmuls (bias folded in)
-        af = x @ wf[:fin] + bf
-        ac = x @ ws[:fin] + bs
+        af = xc @ wf[:fin] + bf
+        ac = xc @ ws[:fin] + bs
         cf = cs = None
         if use_edge:
-            ea = ctx.edge_attr.astype(x.dtype)
+            ea = ctx.edge_attr.astype(xc.dtype)
             cf = ea @ wf[2 * fin :]
             cs = ea @ ws[2 * fin :]
 
         from hydragnn_tpu.ops.fused_conv import fused_conv
 
         agg = fused_conv(
-            x, ctx.senders, ctx.receivers, ctx.edge_mask, n,
+            xc, ctx.senders, ctx.receivers, ctx.edge_mask, n,
             branches=(
                 (wf[fin : 2 * fin], None, af, cf),
                 (ws[fin : 2 * fin], None, ac, cs),
             ),
             acts=("sigmoid", "softplus"),
             win=ctx.sender_win,
+            real_edges=ctx.edge_occ,
         ).astype(x.dtype)
         return x + agg
 

@@ -85,6 +85,8 @@ def model_config_from_dict(
         radius=arch.get("radius"),
         inforward_radius=bool(arch.get("radius_graph_in_forward", False)),
         fused_conv=bool(arch.get("fused_conv", True)),
+        conv_bf16=bool(arch.get("conv_bf16", False)),
+        conv_residency=bool(arch.get("conv_residency", False)),
         freeze_conv=bool(arch.get("freeze_conv_layers", False)),
         initial_bias=arch.get("initial_bias"),
         bn_axis_name=bn_axis_name if arch.get("SyncBatchNorm") else None,

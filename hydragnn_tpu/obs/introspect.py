@@ -107,6 +107,119 @@ def cost_analysis(compiled_or_lowered) -> Tuple[Optional[float], Optional[float]
         return None, None
 
 
+def pad_waste_from_batch(batch) -> Dict[str, Any]:
+    """Pad-occupancy accounting for one loader batch: how much of the
+    static edge/node pad the batch actually fills. Uses the loader's
+    occupancy fields (``GraphBatch.edge_occupancy`` — the fused
+    kernel's actual chunk-loop bound, which under run_align includes
+    the interleaved masked self-loops below it — and ``n_real_nodes``)
+    when present, the masks otherwise. Works on single batches and
+    device-stacked ones (means over the leading device axis)."""
+    senders = np.asarray(batch.senders)
+    edge_pad = int(senders.shape[-1])
+    nmask = np.asarray(batch.node_mask)
+    node_pad = int(nmask.shape[-1])
+    occ = getattr(batch, "edge_occupancy", None)
+    if occ is not None:
+        real_e = float(np.asarray(occ).mean())
+    else:
+        real_e = float(np.asarray(batch.edge_mask).sum(axis=-1).mean())
+    nrn = getattr(batch, "n_real_nodes", None)
+    if nrn is not None:
+        real_n = float(np.asarray(nrn).mean())
+    else:
+        real_n = float(nmask.sum(axis=-1).mean())
+    return {
+        "edge_pad": edge_pad,
+        "node_pad": node_pad,
+        "real_edges_mean": round(real_e, 1),
+        "real_nodes_mean": round(real_n, 1),
+        "edge_waste_frac": round(1.0 - real_e / max(edge_pad, 1), 4),
+        "node_waste_frac": round(1.0 - real_n / max(node_pad, 1), 4),
+    }
+
+
+def conv_traffic_model(
+    node_pad: int,
+    edge_pad: int,
+    hidden: int,
+    layers: int,
+    real_edges: Optional[float] = None,
+) -> Dict[str, Any]:
+    """Analytic bytes/step of the conv hot path under each kernel mode
+    (docs/PERF.md r08) — the useful-vs-padded byte accounting the XLA
+    cost model cannot provide (it prices custom-calls from operand
+    SHAPES, so occupancy skipping and the bf16 activation path are
+    invisible to it).
+
+    Prices, per conv layer, what the fused kernel physically moves:
+    edge-id chunk DMAs (3 int32 streams in whole CE-edge chunks),
+    sender gather windows (BW rows x padded width, ~one window per
+    chunk — the loader's locality contract), the layer's params, and
+    the f32 output write. ``fused_skip`` bounds the chunk loop at
+    ``real_edges`` (GraphBatch.edge_occupancy); ``fused_skip_bf16``
+    additionally moves activations as bf16; ``resident_skip`` loads the
+    features once and keeps them in VMEM across layers (intermediate
+    out-block flushes counted honestly). ``xla_unfused`` is the
+    materialized gather->message->scatter chain for scale."""
+    from hydragnn_tpu.ops.segment_pallas import ALIGN, BN, BW, CE
+
+    hp = ((int(hidden) + 127) // 128) * 128
+    node_pad = int(node_pad)
+    edge_pad = int(edge_pad)
+    layers = max(int(layers), 1)
+    n_pad_out = ((node_pad + BN - 1) // BN) * BN
+    n_res = max(((node_pad + ALIGN - 1) // ALIGN) * ALIGN, BW, n_pad_out)
+    e_eff = edge_pad if real_edges is None else min(float(real_edges), edge_pad)
+
+    def chunks(e: float) -> int:
+        return -(-int(e) // CE) if e > 0 else 0
+
+    def fused(e: float, act_bytes: int) -> int:
+        per_layer = (
+            3 * chunks(e) * CE * 4        # send/recv/mask id streams
+            + chunks(e) * BW * hp * act_bytes  # sender gather windows
+            + (hp * hp + hp) * 4          # layer params (f32 always)
+            + n_pad_out * hp * 4          # f32 output write
+        )
+        return layers * per_layer
+
+    xla = layers * (
+        node_pad * hp * 4        # x read
+        + 4 * edge_pad * hp * 4  # gather write+read, message write+read
+        + 2 * edge_pad * 4       # id reads
+        + n_pad_out * hp * 4     # scatter output
+    )
+    padded = fused(edge_pad, 4)
+    skip = fused(e_eff, 4)
+    skip_bf16 = fused(e_eff, 2)
+    resident_skip = n_res * hp * 4 + layers * (
+        3 * chunks(e_eff) * CE * 4 + (hp * hp + hp) * 4 + n_pad_out * hp * 4
+    )
+
+    def drop(b: int) -> float:
+        return round(1.0 - b / max(padded, 1), 4)
+
+    return {
+        "hidden_padded": hp,
+        "edge_pad": edge_pad,
+        "real_edges": None if real_edges is None else int(real_edges),
+        "assumption": "one BW-row gather window per CE-edge chunk (loader locality)",
+        "bytes_per_step": {
+            "xla_unfused": int(xla),
+            "fused_padded": int(padded),
+            "fused_skip": int(skip),
+            "fused_skip_bf16": int(skip_bf16),
+            "resident_skip": int(resident_skip),
+        },
+        "drop_vs_fused_padded": {
+            "fused_skip": drop(skip),
+            "fused_skip_bf16": drop(skip_bf16),
+            "resident_skip": drop(resident_skip),
+        },
+    }
+
+
 def device_memory_stats(device=None) -> Dict[str, Any]:
     """Device-memory watermark with the compile-monitor-style
     ``available`` degradation: CPU (and any backend without
@@ -356,6 +469,8 @@ class HardwareLedger:
         self.peak = peak
         self.device = device
         self.reason = reason
+        self.pad_waste: Optional[Dict[str, Any]] = None
+        self.conv_traffic: Optional[Dict[str, Any]] = None
         self._mfus: List[float] = []
         self._peak_mem: Optional[int] = None
 
@@ -391,6 +506,17 @@ class HardwareLedger:
     def available(self) -> bool:
         return self.flops_per_step is not None
 
+    def set_conv_traffic(
+        self,
+        pad_waste: Optional[Dict[str, Any]],
+        conv_traffic: Optional[Dict[str, Any]],
+    ) -> None:
+        """Attach the batch pad-occupancy accounting and the analytic
+        conv-traffic model (useful vs padded bytes) — computed by the
+        loop from the example batch; lands in the flight manifest."""
+        self.pad_waste = pad_waste
+        self.conv_traffic = conv_traffic
+
     def manifest(self) -> Dict[str, Any]:
         """The ``run_start`` ledger fields: what one step costs and what
         the chip could do."""
@@ -404,6 +530,10 @@ class HardwareLedger:
         out["peak_bf16_tflops"] = (
             round(self.peak / 1e12, 1) if self.peak else None
         )
+        if self.pad_waste is not None:
+            out["pad_waste"] = self.pad_waste
+        if self.conv_traffic is not None:
+            out["conv_traffic"] = self.conv_traffic
         return out
 
     def epoch_record(self, steps: int, wall_s: float) -> Dict[str, Any]:

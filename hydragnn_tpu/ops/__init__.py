@@ -1,4 +1,10 @@
-from hydragnn_tpu.ops.fused_conv import fused_conv, fused_conv_active
+from hydragnn_tpu.ops.fused_conv import (
+    fused_conv,
+    fused_conv_active,
+    fused_conv_stack,
+    residency_vmem_budget_bytes,
+    residency_vmem_bytes,
+)
 from hydragnn_tpu.ops.segment_pallas import (
     pallas_available,
     pna_aggregate,

@@ -65,6 +65,7 @@ contract); callers cast.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional, Sequence, Tuple
 
 import jax
@@ -171,8 +172,17 @@ def _make_fused_kernel(k_br, acts, has_rtab, has_eterm, has_scale, hp, hop,
         sem_win = next(it)
 
         i = pl.program_id(0)
-        lo = ptr_ref[i]
-        hi = ptr_ref[i + 1]
+        # Occupancy clamp (ISSUE 10): plan row 3 carries the index after
+        # the last slot that can hold a REAL edge. Everything past it is
+        # padding whose messages the mask would zero anyway — bounding
+        # [lo, hi) at the occupancy makes fully-padded tail chunks cost
+        # zero DMAs and zero MXU work while leaving every contributing
+        # term bit-identical (skipped chunks contributed exact +0: the
+        # mask factor zeroes their messages before the scatter, and the
+        # bf16 split of 0 is 0).
+        occ = plan_ref[3, 0]
+        lo = jnp.minimum(ptr_ref[i], occ)
+        hi = jnp.minimum(ptr_ref[i + 1], occ)
         n_clamp = plan_ref[2, 0]
         out_ref[:] = jnp.zeros_like(out_ref)
         k0 = lo // CE
@@ -377,9 +387,12 @@ def _make_fused_kernel(k_br, acts, has_rtab, has_eterm, has_scale, hp, hop,
 
 
 def _fused_kernel_call(x, senders, receivers, mask, w_cat, b_cat, rtab,
-                       eterm, scale, num_segments, spec, interpret):
+                       eterm, scale, real_edges, num_segments, spec,
+                       interpret):
     """Shard-local fused kernel invocation. Operands are pre-padded to
-    128-lane widths by the dispatcher; receivers sorted ascending."""
+    128-lane widths by the dispatcher; receivers sorted ascending.
+    ``real_edges`` ([1] int32 or None) bounds the chunk loop — None
+    processes the full edge pad (always correct; `ptr <= e` already)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -409,6 +422,17 @@ def _fused_kernel_call(x, senders, receivers, mask, w_cat, b_cat, rtab,
     block_ptr = jnp.searchsorted(recv[:e], boundaries, side="left").astype(jnp.int32)
     n_chunks = e_pad // CE
     plan = _window_plan_local(send, n_pad_t, n_chunks, ce=CE)
+    # plan row 3: the occupancy bound for the kernel's chunk-loop clamp.
+    # Defaults to e (a no-op: block_ptr <= e by construction); clamped
+    # to e so a stale/overshooting caller value cannot read past the pad.
+    occ = (
+        jnp.full((1,), e, jnp.int32)
+        if real_edges is None
+        else jnp.minimum(real_edges.reshape(1).astype(jnp.int32), e)
+    )
+    plan = jnp.concatenate(
+        [plan, jnp.broadcast_to(occ, (1, n_chunks))], axis=0
+    )
 
     operands = [x, send[None, :], recv[None, :], mask_i[None, :]]
     in_specs = [
@@ -548,13 +572,17 @@ def _get_partitioned_fused(layout: Tuple[str, ...]):
         return mesh, lower_fn, NamedSharding(mesh, P()), tuple(arg_sh)
 
     # shardy rule (newer jax): edge-dim operands share factor "e",
-    # node-space the output's "n"; distinct width factors per operand
+    # node-space the output's "n"; distinct width factors per operand.
+    # The occupancy scalar ("o", [1]) is replicated — its one dim gets
+    # its own private factor.
     parts = []
     for idx, kind in enumerate(layout):
         if kind in ("e", "t", "s"):
             parts.append("e" if idx in (1, 2, 3) else f"e w{idx}")
         elif kind == "n":
             parts.append(f"n w{idx}")
+        elif kind == "o":
+            parts.append(f"o{idx}")
         else:
             parts.append(f"p{idx} w{idx}")
     _def_partition_compat(
@@ -568,13 +596,16 @@ def _get_partitioned_fused(layout: Tuple[str, ...]):
 
 
 def _flatten_operands(x, senders, receivers, mask, w_cat, b_cat, rtab, eterm,
-                      scale):
+                      scale, real_edges):
     """(layout, operands) with absent optionals dropped — the layout is
-    the partitioned-op cache key and the unflatten schema."""
+    the partitioned-op cache key and the unflatten schema. The occupancy
+    scalar travels last as kind "o" ([1] int32, replicated: a shard's
+    local real-edge positions are <= their global positions, so the
+    global bound never clips a shard-local real edge)."""
     layout = ["n", "e", "e", "e"]
     operands = [x, senders, receivers, mask]
     for a, kind in ((w_cat, "p"), (b_cat, "p"), (rtab, "n"), (eterm, "t"),
-                    (scale, "s")):
+                    (scale, "s"), (real_edges, "o")):
         if a is not None:
             layout.append(kind)
             operands.append(a)
@@ -583,7 +614,8 @@ def _flatten_operands(x, senders, receivers, mask, w_cat, b_cat, rtab, eterm,
 
 def _unflatten_operands(layout, operands):
     """Inverse of :func:`_flatten_operands` for the op body: positions
-    4+ are (w, b, rtab, eterm, scale) in order, present or None."""
+    4+ are (w, b, rtab, eterm, scale, real_edges) in order, present or
+    None."""
     it = list(operands[4:])
     x, senders, receivers, mask = operands[:4]
     kinds = list(layout[4:])
@@ -593,7 +625,9 @@ def _unflatten_operands(layout, operands):
     rtab = it.pop(0) if "n" in kinds else None
     eterm = it.pop(0) if "t" in kinds else None
     scale = it.pop(0) if "s" in kinds else None
-    return x, senders, receivers, mask, w_cat, b_cat, rtab, eterm, scale
+    real_edges = it.pop(0) if "o" in kinds else None
+    return (x, senders, receivers, mask, w_cat, b_cat, rtab, eterm, scale,
+            real_edges)
 
 
 # ---------------------------------------------------------------------------
@@ -678,8 +712,11 @@ def _cat_branches(branches):
 
 
 def _fused_impl(spec, num_segments, use_kernel, interpret, x, senders,
-                receivers, mask, win, branches, scale):
+                receivers, mask, win, real_edges, branches, scale):
     if not use_kernel or senders.shape[0] == 0:
+        # the reference path ignores the occupancy bound: skipped chunks
+        # only ever held masked edges, whose messages the jnp.where
+        # zeroes — the two paths are definitionally identical
         return _fused_ref(
             spec, num_segments, x, senders, receivers, mask, branches, scale
         )
@@ -687,6 +724,8 @@ def _fused_impl(spec, num_segments, use_kernel, interpret, x, senders,
     layout, operands = _flatten_operands(
         x, senders.astype(jnp.int32), receivers.astype(jnp.int32),
         jax.lax.stop_gradient(mask), w_cat, b_cat, rtab_cat, eterm_cat, scale,
+        None if real_edges is None
+        else jax.lax.stop_gradient(real_edges).reshape(1).astype(jnp.int32),
     )
     op = _get_partitioned_fused(layout)
     return op(*operands, spec, num_segments, interpret)
@@ -694,16 +733,16 @@ def _fused_impl(spec, num_segments, use_kernel, interpret, x, senders,
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3))
 def _fused_conv(spec, num_segments, use_kernel, interpret, x, senders,
-                receivers, mask, win, branches, scale):
+                receivers, mask, win, real_edges, branches, scale):
     return _fused_impl(spec, num_segments, use_kernel, interpret, x, senders,
-                       receivers, mask, win, branches, scale)
+                       receivers, mask, win, real_edges, branches, scale)
 
 
 def _fused_conv_fwd(spec, num_segments, use_kernel, interpret, x, senders,
-                    receivers, mask, win, branches, scale):
+                    receivers, mask, win, real_edges, branches, scale):
     out = _fused_impl(spec, num_segments, use_kernel, interpret, x, senders,
-                      receivers, mask, win, branches, scale)
-    return out, (x, senders, receivers, mask, win, branches, scale)
+                      receivers, mask, win, real_edges, branches, scale)
+    return out, (x, senders, receivers, mask, win, real_edges, branches, scale)
 
 
 def _fused_conv_bwd(spec, num_segments, use_kernel, interpret, res, g):
@@ -715,7 +754,7 @@ def _fused_conv_bwd(spec, num_segments, use_kernel, interpret, res, g):
     saving [E, *] residuals — the same recompute-over-HBM trade as the
     PNA presum backward."""
     k_br, acts = spec
-    x, senders, receivers, mask, win, branches, scale = res
+    x, senders, receivers, mask, win, real_edges, branches, scale = res
     dt = x.dtype
     n = x.shape[0]
     f0 = jax.dtypes.float0
@@ -797,6 +836,7 @@ def _fused_conv_bwd(spec, num_segments, use_kernel, interpret, res, g):
         jnp.zeros(receivers.shape, dtype=f0),
         jnp.zeros(mask.shape, dtype=f0),
         None if win is None else jnp.zeros(win.shape, dtype=f0),
+        None if real_edges is None else jnp.zeros(real_edges.shape, dtype=f0),
         g_branches,
         g_scale,
     )
@@ -820,6 +860,7 @@ def fused_conv(
     acts: Sequence[str] = (),
     scale: Optional[jnp.ndarray] = None,
     win: Optional[jnp.ndarray] = None,
+    real_edges: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """Fused gather -> edge network -> masked scatter (module docstring).
 
@@ -832,6 +873,11 @@ def fused_conv(
     ``win``: loader-emitted sender block windows ([2, n_blocks] int32)
     — routes the backward's sender scatter through the local-window
     kernel; without it the backward falls back to XLA's scatter-add.
+    ``real_edges``: optional scalar int32 occupancy bound
+    (GraphBatch.edge_occupancy) — every edge slot at position >=
+    real_edges must be MASKED; the kernel then skips fully-padded tail
+    chunks entirely (zero DMAs, zero MXU work) with bit-identical
+    output. None processes the full pad.
 
     CONTRACT: ``receivers`` sorted ascending (the loader contract all
     convs rely on — same as ``segment_sum_family``). Returns float32
@@ -856,7 +902,7 @@ def fused_conv(
 
     if not use_kernel:
         return _fused_conv(spec, num_segments, False, False, x, senders,
-                           receivers, mask, win, branches, scale)
+                           receivers, mask, win, real_edges, branches, scale)
 
     # lane-pad every width to the 128-lane kernel tile; padding lives
     # OUTSIDE the custom-vjp op, so AD slices the cotangents back
@@ -881,5 +927,475 @@ def fused_conv(
     )
     sck = _pad_cols(scale, hop)
     out = _fused_conv(spec, num_segments, True, interpret, xk, senders,
-                      receivers, mask, win, brk, sck)
+                      receivers, mask, win, real_edges, brk, sck)
     return out[:, :hout]
+
+
+# ---------------------------------------------------------------------------
+# cross-layer VMEM residency: the fused conv STACK
+# ---------------------------------------------------------------------------
+#
+# A width-preserving stack of L fused conv layers executed as ONE kernel
+# with the node features RESIDENT in VMEM between layers:
+#
+#     h_0     = x
+#     out_l   = segment_sum(mask * act_e(h_l[send] @ W_l + b_l))
+#     h_{l+1} = act_i(out_l)
+#
+# returning out_{L-1} (no inter-layer activation on the last layer).
+# The single-layer kernel reads the gather table from HBM once per
+# sender window per chunk and writes the layer output back to HBM — for
+# an L-layer stack that is L full round trips of the node features.
+# Here the features live in a ping-pong VMEM scratch pair: layer l
+# gathers its windows from slot l%2 with plain VMEM dynamic slices
+# (zero HBM gather traffic after the one-time load) and writes its
+# activated out blocks into slot (l+1)%2. Per-layer weights arrive as a
+# blocked [L, hp, hp] operand whose index map advances with the layer
+# grid dim, so Pallas's input pipeline double-buffers layer l+1's
+# weight DMA behind layer l's compute. The TPU grid (L, n_blocks)
+# executes sequentially in lexicographic order — every block of layer l
+# completes before layer l+1 starts, which is what makes the ping-pong
+# safe.
+#
+# Restrictions (enforced by the dispatcher, which falls back to the
+# per-layer loop): square weights (width-preserving), f32 activations,
+# num_segments == x.shape[0] (outputs feed back as inputs), one edge
+# MLP per layer (no rtab/eterm/scale — those are per-layer functions of
+# h_l and would have to be recomputed in-kernel), and the VMEM
+# footprint estimate under HYDRAGNN_RESIDENCY_VMEM_MB. Intermediate
+# layers' out-block flushes do write garbage to the output's HBM
+# buffer, but the final layer's flush overwrites every block (last
+# writer wins on the sequential grid) — the waste is L-1 node-space
+# writes, far smaller than the L-1 edge-space gather round trips
+# deleted.
+
+
+def _make_stack_kernel(act_e, act_i, hp, n_layers):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    def kernel(ptr_ref, plan_ref, *refs):
+        (x_hbm, send_hbm, recv_hbm, mask_hbm, w_ref, b_ref, out_ref,
+         xbuf, send_vmem, recv_vmem, mask_vmem, gacc_ref,
+         sem_ids, sem_x) = refs
+
+        l = pl.program_id(0)
+        i = pl.program_id(1)
+        # same occupancy clamp as the single-layer kernel: the edge set
+        # is identical for every layer, so skipped tail chunks are
+        # skipped L times over
+        occ = plan_ref[3, 0]
+        lo = jnp.minimum(ptr_ref[i], occ)
+        hi = jnp.minimum(ptr_ref[i + 1], occ)
+        n_clamp = plan_ref[2, 0]
+        out_ref[:] = jnp.zeros_like(out_ref)
+        k0 = lo // CE
+        k1 = (hi + CE - 1) // CE
+        sslot = l % 2  # layer l reads slot l%2, writes slot (l+1)%2
+
+        # one-time residency load at grid step (0, 0): x -> slot 0, and
+        # zero slot 1 so rows outside the written blocks ([n_pad_out,
+        # n_res), never stored to) read as exact zeros in every layer
+        @pl.when((l == 0) & (i == 0))
+        def _load_resident():
+            cp = pltpu.make_async_copy(x_hbm, xbuf.at[0], sem_x.at[0])
+            cp.start()
+            cp.wait()
+            xbuf[1] = jnp.zeros(xbuf.shape[1:], xbuf.dtype)
+
+        def id_dmas(slot, k):
+            start = pl.multiple_of(k * CE, CE)
+            return [
+                pltpu.make_async_copy(
+                    send_hbm.at[:, pl.ds(start, CE)], send_vmem.at[slot],
+                    sem_ids.at[slot, 0],
+                ),
+                pltpu.make_async_copy(
+                    recv_hbm.at[:, pl.ds(start, CE)], recv_vmem.at[slot],
+                    sem_ids.at[slot, 1],
+                ),
+                pltpu.make_async_copy(
+                    mask_hbm.at[:, pl.ds(start, CE)], mask_vmem.at[slot],
+                    sem_ids.at[slot, 2],
+                ),
+            ]
+
+        @pl.when(k0 < k1)
+        def _warmup():
+            for cp in id_dmas(k0 % 2, k0):
+                cp.start()
+
+        def chunk_body(k, _):
+            slot = k % 2
+
+            @pl.when(k + 1 < k1)
+            def _prefetch_ids():
+                for cp in id_dmas((k + 1) % 2, k + 1):
+                    cp.start()
+
+            for cp in id_dmas(slot, k):
+                cp.wait()
+            send = send_vmem[slot][0, :]  # [CE]
+            astart = plan_ref[0, k]
+            wcnt = plan_ref[1, k]
+            gacc_ref[:] = jnp.zeros_like(gacc_ref)
+
+            # windowed sender gather — same one-hot math as the single
+            # kernel, but the window is a VMEM slice of the resident
+            # buffer instead of an HBM DMA (the traffic this mode
+            # deletes). The source slot alternates per layer; the two
+            # pl.when branches keep the slot index static for the load.
+            def window_body(w, _):
+                wstart = astart + w * BW
+                cstart = pl.multiple_of(
+                    jnp.minimum(wstart, n_clamp), ALIGN
+                )
+                local = send - cstart
+                in_range = (send >= wstart) & (send < wstart + BW)
+                local = jnp.where(in_range, local, -1)
+                onehot = (
+                    local[:, None]
+                    == jax.lax.broadcasted_iota(jnp.int32, (CE, BW), 1)
+                ).astype(jnp.float32)
+
+                def accumulate(win):
+                    gacc_ref[:] += jax.lax.dot_general(
+                        onehot, win, (((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                        precision=jax.lax.Precision.HIGHEST,
+                    )
+
+                @pl.when(sslot == 0)
+                def _from_slot0():
+                    accumulate(xbuf[0, pl.ds(cstart, BW), :])
+
+                @pl.when(sslot == 1)
+                def _from_slot1():
+                    accumulate(xbuf[1, pl.ds(cstart, BW), :])
+
+                return 0
+
+            jax.lax.fori_loop(0, wcnt, window_body, 0)
+
+            v = gacc_ref[:]  # [CE, hp] f32, exact copies of h_l rows
+            rows = jax.lax.broadcasted_iota(jnp.int32, (BN, CE), 0) + i * BN
+            onehot_r = recv_vmem[slot] == rows  # [BN, CE]
+            mf = mask_vmem[slot][0, :].astype(jnp.float32)[:, None]
+
+            # this layer's edge MLP (w_ref block = [1, hp, hp] at layer l)
+            pre = jax.lax.dot_general(
+                v, w_ref[0], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST,
+            )
+            pre = pre + b_ref[0]  # [1, hp] broadcasts
+            msg = _ACTS[act_e][0](pre) * mf
+
+            # masked one-hot scatter, 3-term bf16 split (exact f32)
+            onehot_t = onehot_r.astype(jnp.bfloat16)
+            hi_t = msg.astype(jnp.bfloat16)
+            r1 = msg - hi_t.astype(jnp.float32)
+            mid_t = r1.astype(jnp.bfloat16)
+            lo_t = (r1 - mid_t.astype(jnp.float32)).astype(jnp.bfloat16)
+            for term in (hi_t, mid_t, lo_t):
+                out_ref[:] += jax.lax.dot_general(
+                    onehot_t, term, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+            return 0
+
+        jax.lax.fori_loop(k0, k1, chunk_body, 0)
+
+        # hand the activated block to the next layer: store into the
+        # TARGET slot (static index under pl.when, dynamic row offset).
+        # Rows [num_segments, n_pad_out) get act_i(0) here where the
+        # per-layer loop re-pads zeros — but no sender ever points at
+        # them (senders < num_segments), so they are only ever read with
+        # zero one-hot coefficients: exact +0 either way.
+        @pl.when(l + 1 < n_layers)
+        def _store_next():
+            y = _ACTS[act_i][0](out_ref[:])
+            row0 = pl.multiple_of(i * BN, BN)
+
+            @pl.when(sslot == 0)
+            def _to_slot1():
+                xbuf[1, pl.ds(row0, BN), :] = y
+
+            @pl.when(sslot == 1)
+            def _to_slot0():
+                xbuf[0, pl.ds(row0, BN), :] = y
+
+    return kernel
+
+
+def _stack_kernel_call(x, senders, receivers, mask, w_stack, b_stack,
+                       real_edges, num_segments, spec, interpret):
+    """Resident-stack kernel invocation. ``x`` pre-padded to 128 lanes,
+    ``w_stack`` [L, hp, hp] f32, ``b_stack`` [L, 1, hp] f32."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    act_e, act_i, n_layers = spec
+    e = senders.shape[0]
+    n, hp = x.shape
+
+    n_pad_out = ((num_segments + BN - 1) // BN) * BN
+    # the resident buffer doubles as gather table AND inter-layer output
+    # target: rows must cover both the window headroom and every written
+    # out block (n_pad_out can exceed the single kernel's gather pad)
+    n_res = max(((n + ALIGN - 1) // ALIGN) * ALIGN, BW, n_pad_out)
+    if n_res != n:
+        x = jnp.concatenate(
+            [x, jnp.zeros((n_res - n, hp), x.dtype)], axis=0
+        )
+    e_pad = ((e + CE - 1) // CE) * CE
+    send = jnp.concatenate(
+        [senders.astype(jnp.int32), jnp.full((e_pad - e,), n_res, jnp.int32)]
+    )
+    recv = jnp.concatenate(
+        [receivers.astype(jnp.int32), jnp.full((e_pad - e,), n_pad_out, jnp.int32)]
+    )
+    mask_i = jnp.concatenate(
+        [mask.astype(jnp.int32), jnp.zeros((e_pad - e,), jnp.int32)]
+    )
+    n_blocks = n_pad_out // BN
+    boundaries = jnp.arange(n_blocks + 1, dtype=jnp.int32) * BN
+    block_ptr = jnp.searchsorted(recv[:e], boundaries, side="left").astype(jnp.int32)
+    n_chunks = e_pad // CE
+    plan = _window_plan_local(send, n_res, n_chunks, ce=CE)
+    occ = (
+        jnp.full((1,), e, jnp.int32)
+        if real_edges is None
+        else jnp.minimum(real_edges.reshape(1).astype(jnp.int32), e)
+    )
+    plan = jnp.concatenate([plan, jnp.broadcast_to(occ, (1, n_chunks))], axis=0)
+
+    operands = [
+        x, send[None, :], recv[None, :], mask_i[None, :],
+        w_stack.astype(jnp.float32), b_stack.astype(jnp.float32),
+    ]
+    in_specs = [
+        pl.BlockSpec(memory_space=pl.ANY),  # x (one-time residency DMA)
+        pl.BlockSpec(memory_space=pl.ANY),  # send
+        pl.BlockSpec(memory_space=pl.ANY),  # recv
+        pl.BlockSpec(memory_space=pl.ANY),  # mask
+        # per-layer params: block index follows the layer grid dim, so
+        # the pipeline prefetches layer l+1's weights during layer l
+        pl.BlockSpec((1, hp, hp), lambda l, i, p, q: (l, 0, 0)),
+        pl.BlockSpec((1, 1, hp), lambda l, i, p, q: (l, 0, 0)),
+    ]
+    kernel = _make_stack_kernel(act_e, act_i, hp, n_layers)
+    scratch = [
+        pltpu.VMEM((2, n_res, hp), jnp.float32),  # resident ping-pong pair
+        pltpu.VMEM((2, 1, CE), jnp.int32),
+        pltpu.VMEM((2, 1, CE), jnp.int32),
+        pltpu.VMEM((2, 1, CE), jnp.int32),
+        pltpu.VMEM((CE, hp), jnp.float32),
+        pltpu.SemaphoreType.DMA((2, 3)),
+        pltpu.SemaphoreType.DMA((1,)),
+    ]
+    vma = _vma_of(*operands)
+    operands = [_match_vma(o, vma) for o in operands]
+    block_ptr = _match_vma(block_ptr, vma)
+    plan = _match_vma(plan, vma)
+    out_sds = _sds((n_pad_out, hp), jnp.float32, vma=vma)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(n_layers, n_blocks),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((BN, hp), lambda l, i, p, q: (i, 0)),
+        scratch_shapes=scratch,
+    )
+    out = pl.pallas_call(
+        kernel,
+        out_shape=out_sds,
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(block_ptr, plan, *operands)
+    return out[:num_segments]
+
+
+def _stack_ref_loop(spec, num_segments, use_kernel, interpret, x, senders,
+                    receivers, mask, win, real_edges, w_stack, b_stack):
+    """Per-layer composition of ``_fused_conv`` — three jobs at once:
+    the numerical contract the resident kernel is tested against
+    (bit-exact in f32), the VMEM-budget fallback path (still per-layer
+    fused kernels when available), and the backward's recompute target.
+    Intermediate activations are cast back to the input dtype so bf16
+    stacks stay bf16 layer to layer."""
+    act_e, act_i, n_layers = spec
+    h = x
+    out = None
+    for l in range(n_layers):
+        branches = ((w_stack[l], b_stack[l].reshape(-1), None, None),)
+        out = _fused_conv((1, (act_e,)), num_segments, use_kernel, interpret,
+                          h, senders, receivers, mask, win, real_edges,
+                          branches, None)
+        if l + 1 < n_layers:
+            h = _ACTS[act_i][0](out).astype(x.dtype)
+    return out
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3))
+def _fused_stack(spec, num_segments, use_kernel, interpret, x, senders,
+                 receivers, mask, win, real_edges, w_stack, b_stack):
+    if use_kernel:
+        return _stack_kernel_call(x, senders, receivers, mask, w_stack,
+                                  b_stack, real_edges, num_segments, spec,
+                                  interpret)
+    return _stack_ref_loop(spec, num_segments, False, interpret, x, senders,
+                           receivers, mask, win, real_edges, w_stack, b_stack)
+
+
+def _fused_stack_fwd(spec, num_segments, use_kernel, interpret, x, senders,
+                     receivers, mask, win, real_edges, w_stack, b_stack):
+    out = _fused_stack(spec, num_segments, use_kernel, interpret, x, senders,
+                       receivers, mask, win, real_edges, w_stack, b_stack)
+    return out, (x, senders, receivers, mask, win, real_edges, w_stack, b_stack)
+
+
+def _fused_stack_bwd(spec, num_segments, use_kernel, interpret, res, g):
+    """Recompute-based backward: differentiate the per-layer composition
+    (which runs the fast single-layer VJPs — local-window scatters, MXU
+    contractions). The resident forward is bit-identical to that
+    composition, so gradients are consistent by construction."""
+    x, senders, receivers, mask, win, real_edges, w_stack, b_stack = res
+    f0 = jax.dtypes.float0
+
+    def f(x_, w_, b_):
+        return _stack_ref_loop(spec, num_segments, use_kernel, interpret, x_,
+                               senders, receivers, mask, win, real_edges,
+                               w_, b_)
+
+    _, vjp = jax.vjp(f, x, w_stack, b_stack)
+    gx, gw, gb = vjp(g)
+    return (
+        gx,
+        jnp.zeros(senders.shape, dtype=f0),
+        jnp.zeros(receivers.shape, dtype=f0),
+        jnp.zeros(mask.shape, dtype=f0),
+        None if win is None else jnp.zeros(win.shape, dtype=f0),
+        None if real_edges is None else jnp.zeros(real_edges.shape, dtype=f0),
+        gw,
+        gb,
+    )
+
+
+_fused_stack.defvjp(_fused_stack_fwd, _fused_stack_bwd)
+
+
+def residency_vmem_budget_bytes() -> int:
+    """VMEM the resident stack kernel may claim, from
+    ``HYDRAGNN_RESIDENCY_VMEM_MB`` (default 12 — a TPU core has ~16MB
+    and the compiler needs headroom for the pipeline's own buffers)."""
+    return int(float(os.environ.get("HYDRAGNN_RESIDENCY_VMEM_MB", "12")) * (1 << 20))
+
+
+def residency_vmem_bytes(num_nodes: int, width: int) -> int:
+    """Estimated VMEM footprint of the resident stack kernel for a
+    given gather-table size — the decision rule documented in
+    docs/PERF.md r08. Dominated by the ping-pong feature pair."""
+    hp = _pad128(width)
+    n_pad_out = ((num_nodes + BN - 1) // BN) * BN
+    n_res = max(((num_nodes + ALIGN - 1) // ALIGN) * ALIGN, BW, n_pad_out)
+    return (
+        2 * n_res * hp * 4        # resident ping-pong feature pair
+        + 2 * (hp * hp + hp) * 4  # double-buffered layer params
+        + 3 * 2 * CE * 4          # id chunk buffers
+        + CE * hp * 4             # gather accumulator
+        + 2 * BN * hp * 4         # out block double buffer
+    )
+
+
+def fused_conv_stack(
+    x: jnp.ndarray,
+    senders: jnp.ndarray,
+    receivers: jnp.ndarray,
+    edge_mask: jnp.ndarray,
+    num_segments: int,
+    weights: jnp.ndarray,
+    biases: Optional[jnp.ndarray] = None,
+    edge_act: str = "none",
+    inter_act: str = "relu",
+    win: Optional[jnp.ndarray] = None,
+    real_edges: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """L fused conv layers with cross-layer VMEM residency (see the
+    section comment above). Computes, for l in [0, L):
+
+        h_0 = x;  out_l = segment_sum(mask * edge_act(h_l[send] @ W_l + b_l))
+        h_{l+1} = inter_act(out_l)
+
+    and returns out_{L-1} as float32 [num_segments, H] (no inter_act on
+    the last layer; callers apply their own epilogue and cast).
+
+    ``weights``: [L, H, H] (or a sequence of [H, H]) — width-preserving
+    by construction. ``biases``: [L, H] or None. ``num_segments`` must
+    equal ``x.shape[0]`` (outputs feed back as inputs). ``win`` /
+    ``real_edges``: as in :func:`fused_conv`; the occupancy bound
+    applies to every layer. Falls back to a per-layer loop of
+    :func:`fused_conv` (same numerics) when the Pallas kernel is off,
+    activations are not f32, or the estimated VMEM footprint exceeds
+    :func:`residency_vmem_budget_bytes`."""
+    if not isinstance(weights, jnp.ndarray):
+        weights = jnp.stack([jnp.asarray(w) for w in weights], axis=0)
+    if weights.ndim != 3 or weights.shape[1] != weights.shape[2]:
+        raise ValueError(
+            f"fused_conv_stack needs square [L, H, H] weights, got {weights.shape}"
+        )
+    n, h = x.shape
+    n_layers = int(weights.shape[0])
+    if weights.shape[1] != h:
+        raise ValueError(
+            f"weights width {weights.shape[1]} != feature width {h}"
+        )
+    if num_segments != n:
+        raise ValueError(
+            "fused_conv_stack feeds layer outputs back as inputs; "
+            f"num_segments ({num_segments}) must equal x.shape[0] ({n})"
+        )
+    for name in (edge_act, inter_act):
+        if name not in _ACTS:
+            raise ValueError(f"unknown fused_conv_stack activation {name!r}")
+    if biases is not None and not isinstance(biases, jnp.ndarray):
+        biases = jnp.stack([jnp.asarray(b) for b in biases], axis=0)
+
+    spec = (edge_act, inter_act, n_layers)
+    mask = jax.lax.stop_gradient(edge_mask)
+    use_kernel = fused_conv_active() and senders.shape[0] > 0
+    interpret = _interpret_mode()
+
+    hp = _pad128(h)
+    xk = _pad_cols(x, hp)
+    wk = weights
+    if hp != h:
+        wk = jnp.concatenate(
+            [wk, jnp.zeros((n_layers, hp - h, h), wk.dtype)], axis=1
+        )
+        wk = _pad_cols(wk, hp)
+    bk = (
+        jnp.zeros((n_layers, 1, hp), wk.dtype)
+        if biases is None
+        else _pad_cols(biases, hp).reshape(n_layers, 1, hp)
+    )
+    re_ = (
+        None
+        if real_edges is None
+        else jax.lax.stop_gradient(real_edges).reshape(1).astype(jnp.int32)
+    )
+
+    resident = (
+        use_kernel
+        and xk.dtype == jnp.float32
+        and wk.dtype == jnp.float32
+        and residency_vmem_bytes(n, h) <= residency_vmem_budget_bytes()
+    )
+    if resident:
+        out = _fused_stack(spec, num_segments, True, interpret, xk, senders,
+                           receivers, mask, win, re_, wk, bk)
+    else:
+        # per-layer dispatch: still the fused single-layer kernel when
+        # available (each call carries its own VJP), plain XLA otherwise
+        out = _stack_ref_loop(spec, num_segments, use_kernel, interpret, xk,
+                              senders, receivers, mask, win, re_, wk, bk)
+    return out[:, :h]

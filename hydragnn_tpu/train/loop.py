@@ -770,6 +770,30 @@ def train_validate_test(
             # the scan path runs the SAME step body nb times per
             # dispatch, so the per-step lowered cost prices it too
             ledger = HardwareLedger.from_step(train_step, lower_args)
+            # useful-vs-padded byte accounting: the XLA cost model above
+            # prices padded shapes; the pad-waste fractions + analytic
+            # conv-traffic model say how much of that a bucket-ladder
+            # batch actually uses (its own guard: this is telemetry and
+            # must never take the ledger down with it)
+            try:
+                from hydragnn_tpu.obs.introspect import (
+                    conv_traffic_model,
+                    pad_waste_from_batch,
+                )
+
+                waste = pad_waste_from_batch(example)
+                ledger.set_conv_traffic(
+                    waste,
+                    conv_traffic_model(
+                        waste["node_pad"],
+                        waste["edge_pad"],
+                        model.cfg.hidden_dim,
+                        model.cfg.num_conv_layers,
+                        real_edges=waste["real_edges_mean"],
+                    ),
+                )
+            except Exception:
+                pass
         except Exception:
             ledger = HardwareLedger.disabled(reason="example_batch_unavailable")
 

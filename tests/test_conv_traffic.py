@@ -1,0 +1,387 @@
+"""HBM-traffic campaign pins (ops/fused_conv.py + obs/introspect.py):
+occupancy-aware chunk skipping must be bit-identical (f32) to the full
+pad walk, the VMEM-resident multi-layer stack must be bit-identical to
+the per-layer loop it replaces (forward AND gradients), the bf16
+activation path must sit within its documented tolerance of f32, the
+loader's filler batches must advertise zero device cost, and the
+analytic conv-traffic model must show the headline >=30% bytes/step
+drop on the large-graph shape — all in Pallas interpret mode on CPU."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from hydragnn_tpu.ops.fused_conv import (
+    _fused_ref,
+    fused_conv,
+    fused_conv_stack,
+    residency_vmem_budget_bytes,
+    residency_vmem_bytes,
+)
+from hydragnn_tpu.ops.segment_pallas import CE
+
+
+@pytest.fixture
+def occ_case():
+    """Tail-occupancy layout: every edge slot at index >= real is pad
+    (masked) — the loader contract behind GraphBatch.edge_occupancy."""
+    rng = np.random.default_rng(42)
+    e, n, h = 1400, 120, 128
+    real = 640  # > CE, and leaves a full tail chunk to skip
+    recv = np.sort(rng.integers(0, n - 15, e)).astype(np.int32)
+    send = rng.integers(0, n, e).astype(np.int32)
+    mask = rng.random(e) > 0.2
+    mask[real:] = False
+    x = rng.normal(size=(n, h)).astype(np.float32)
+    return (
+        jnp.asarray(x),
+        jnp.asarray(send),
+        jnp.asarray(recv),
+        jnp.asarray(mask),
+        n,
+        jnp.asarray(real, jnp.int32),
+    )
+
+
+def _mlp_params(h, seed=7):
+    rng = np.random.default_rng(seed)
+    W = jnp.asarray((rng.normal(size=(h, h)) * 0.1).astype(np.float32))
+    b = jnp.asarray((rng.normal(size=(h,)) * 0.1).astype(np.float32))
+    return W, b
+
+
+def pytest_occupancy_skip_bit_exact_fwd_and_vjp(occ_case, monkeypatch):
+    """The skip path's contract: with every slot >= real_edges masked,
+    bounding the chunk loop is BIT-IDENTICAL in f32 — forward and
+    grads — because skipped chunks only ever contributed exact +0."""
+    monkeypatch.setenv("HYDRAGNN_PALLAS", "interpret")
+    x, send, recv, mask, n, re_ = occ_case
+    W, b = _mlp_params(x.shape[1])
+
+    def run(real_edges):
+        return fused_conv(
+            x, send, recv, mask, n,
+            branches=((W, b, None, None),), acts=("sigmoid",),
+            real_edges=real_edges,
+        )
+
+    out_skip = run(re_)
+    out_full = run(None)
+    np.testing.assert_array_equal(np.asarray(out_skip), np.asarray(out_full))
+    ref = _fused_ref(
+        (1, ("sigmoid",)), n, x, send, recv, mask, ((W, b, None, None),), None
+    )
+    scale_ref = float(jnp.abs(ref).max()) or 1.0
+    assert float(jnp.abs(out_skip - ref).max()) / scale_ref < 1e-4
+
+    def loss(x, W, b, real_edges):
+        o = fused_conv(
+            x, send, recv, mask, n,
+            branches=((W, b, None, None),), acts=("sigmoid",),
+            real_edges=real_edges,
+        )
+        return (o * o).sum()
+
+    g_skip = jax.grad(loss, argnums=(0, 1, 2))(x, W, b, re_)
+    g_full = jax.grad(loss, argnums=(0, 1, 2))(x, W, b, None)
+    for a, bb, name in zip(g_skip, g_full, ("x", "W", "b")):
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(bb), err_msg=f"grad {name}"
+        )
+
+
+def pytest_occupancy_zero_is_exact_zeros(occ_case, monkeypatch):
+    """A real_edges=0 batch (the loader's filler shape) must produce
+    exact zeros even through a biased+activated edge network."""
+    monkeypatch.setenv("HYDRAGNN_PALLAS", "interpret")
+    x, send, recv, _, n, _ = occ_case
+    W, b = _mlp_params(x.shape[1])
+    out = fused_conv(
+        x, send, recv, jnp.zeros(send.shape[0], bool), n,
+        branches=((W, jnp.ones_like(b), None, None),), acts=("softplus",),
+        real_edges=jnp.asarray(0, jnp.int32),
+    )
+    assert float(jnp.abs(out).max()) == 0.0
+
+
+def pytest_occupancy_skip_narrow_lane(monkeypatch):
+    """Non-128 widths lane-pad into the kernel; the occupancy bound
+    must stay bit-exact through that padding (identity mode + VJP)."""
+    monkeypatch.setenv("HYDRAGNN_PALLAS", "interpret")
+    rng = np.random.default_rng(4)
+    e, n, h, real = 1100, 70, 40, 600
+    recv = jnp.asarray(np.sort(rng.integers(0, n, e)).astype(np.int32))
+    send = jnp.asarray(rng.integers(0, n, e).astype(np.int32))
+    mask = np.asarray(rng.random(e) > 0.25)
+    mask[real:] = False
+    mask = jnp.asarray(mask)
+    x = jnp.asarray(rng.normal(size=(n, h)).astype(np.float32))
+    re_ = jnp.asarray(real, jnp.int32)
+    out_skip = fused_conv(x, send, recv, mask, n, real_edges=re_)
+    out_full = fused_conv(x, send, recv, mask, n)
+    np.testing.assert_array_equal(np.asarray(out_skip), np.asarray(out_full))
+    g1 = jax.grad(
+        lambda x: (fused_conv(x, send, recv, mask, n, real_edges=re_) ** 2).sum()
+    )(x)
+    g2 = jax.grad(
+        lambda x: (fused_conv(x, send, recv, mask, n) ** 2).sum()
+    )(x)
+    np.testing.assert_array_equal(np.asarray(g1), np.asarray(g2))
+
+
+def pytest_occupancy_skip_bf16_path(occ_case, monkeypatch):
+    """bf16 activations + occupancy skip: skip vs no-skip stays
+    bit-identical (same arithmetic, fewer chunks), and the bf16 result
+    sits within the documented 5e-2 relative bound of the f32
+    reference (one bf16 rounding on the streamed operands; f32 MXU
+    accumulation — docs/PERF.md r08)."""
+    monkeypatch.setenv("HYDRAGNN_PALLAS", "interpret")
+    x, send, recv, mask, n, re_ = occ_case
+    xb = x.astype(jnp.bfloat16)
+    out_skip = fused_conv(xb, send, recv, mask, n, real_edges=re_)
+    out_full = fused_conv(xb, send, recv, mask, n)
+    np.testing.assert_array_equal(np.asarray(out_skip), np.asarray(out_full))
+    ref = _fused_ref((0, ()), n, x, send, recv, mask, (), None)
+    scale_ref = float(jnp.abs(ref).max()) or 1.0
+    assert float(jnp.abs(out_skip - ref).max()) / scale_ref < 5e-2
+
+
+def _loop_stack(x, send, recv, mask, n, Ws, bs, real_edges=None):
+    """The per-layer composition fused_conv_stack's resident kernel
+    must reproduce bit-for-bit: sigmoid edge act, relu between layers."""
+    h = x
+    out = None
+    for l in range(Ws.shape[0]):
+        out = fused_conv(
+            h, send, recv, mask, n,
+            branches=((Ws[l], bs[l], None, None),), acts=("sigmoid",),
+            real_edges=real_edges,
+        )
+        if l + 1 < Ws.shape[0]:
+            h = jax.nn.relu(out).astype(x.dtype)
+    return out
+
+
+def pytest_resident_stack_bit_exact_vs_loop(occ_case, monkeypatch):
+    """The cross-layer VMEM-resident kernel vs the per-layer loop it
+    replaces: bit-identical forward and grads (x, W, b) in f32 — the
+    residency optimisation moves bytes, never bits."""
+    monkeypatch.setenv("HYDRAGNN_PALLAS", "interpret")
+    x, send, recv, mask, n, re_ = occ_case
+    h = x.shape[1]
+    rng = np.random.default_rng(9)
+    L = 2
+    Ws = jnp.asarray((rng.normal(size=(L, h, h)) * 0.1).astype(np.float32))
+    bs = jnp.asarray((rng.normal(size=(L, h)) * 0.1).astype(np.float32))
+    assert residency_vmem_bytes(n, h) <= residency_vmem_budget_bytes()
+
+    out_res = fused_conv_stack(
+        x, send, recv, mask, n, Ws, bs,
+        edge_act="sigmoid", inter_act="relu", real_edges=re_,
+    )
+    out_loop = _loop_stack(x, send, recv, mask, n, Ws, bs, real_edges=re_)
+    np.testing.assert_array_equal(np.asarray(out_res), np.asarray(out_loop))
+
+    def loss_res(x, Ws, bs):
+        o = fused_conv_stack(
+            x, send, recv, mask, n, Ws, bs,
+            edge_act="sigmoid", inter_act="relu", real_edges=re_,
+        )
+        return (o * o).sum()
+
+    def loss_loop(x, Ws, bs):
+        o = _loop_stack(x, send, recv, mask, n, Ws, bs, real_edges=re_)
+        return (o * o).sum()
+
+    g1 = jax.grad(loss_res, argnums=(0, 1, 2))(x, Ws, bs)
+    g2 = jax.grad(loss_loop, argnums=(0, 1, 2))(x, Ws, bs)
+    for a, bb, name in zip(g1, g2, ("x", "W", "b")):
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(bb), err_msg=f"grad {name}"
+        )
+
+
+def pytest_resident_stack_budget_fallback(occ_case, monkeypatch):
+    """A VMEM budget too small for the footprint must fall back to the
+    per-layer path with identical results — the decision rule is an
+    implementation detail, never a numerics change."""
+    monkeypatch.setenv("HYDRAGNN_PALLAS", "interpret")
+    x, send, recv, mask, n, re_ = occ_case
+    h = x.shape[1]
+    rng = np.random.default_rng(10)
+    Ws = jnp.asarray((rng.normal(size=(2, h, h)) * 0.1).astype(np.float32))
+    bs = jnp.asarray((rng.normal(size=(2, h)) * 0.1).astype(np.float32))
+    kw = dict(edge_act="sigmoid", inter_act="relu", real_edges=re_)
+    out_res = fused_conv_stack(x, send, recv, mask, n, Ws, bs, **kw)
+    monkeypatch.setenv("HYDRAGNN_RESIDENCY_VMEM_MB", "0.01")
+    assert residency_vmem_bytes(n, h) > residency_vmem_budget_bytes()
+    out_fb = fused_conv_stack(x, send, recv, mask, n, Ws, bs, **kw)
+    np.testing.assert_array_equal(np.asarray(out_res), np.asarray(out_fb))
+
+
+def pytest_stack_validates_inputs():
+    x = jnp.zeros((8, 16))
+    ids = jnp.zeros((4,), jnp.int32)
+    mask = jnp.ones((4,), bool)
+    with pytest.raises(ValueError, match="square"):
+        fused_conv_stack(x, ids, ids, mask, 8, jnp.zeros((2, 16, 8)))
+    with pytest.raises(ValueError, match="width"):
+        fused_conv_stack(x, ids, ids, mask, 8, jnp.zeros((2, 8, 8)))
+    with pytest.raises(ValueError, match="num_segments"):
+        fused_conv_stack(x, ids, ids, mask, 6, jnp.zeros((2, 16, 16)))
+    with pytest.raises(ValueError, match="activation"):
+        fused_conv_stack(
+            x, ids, ids, mask, 8, jnp.zeros((2, 16, 16)), inter_act="nope"
+        )
+
+
+def _tiny_loader(batch_size=4):
+    from hydragnn_tpu.data.ingest import prepare_dataset
+    from hydragnn_tpu.data.loader import GraphLoader
+    from hydragnn_tpu.data.synthetic import deterministic_graph_data
+    from hydragnn_tpu.flagship import flagship_config
+    from hydragnn_tpu.utils.config import update_config
+
+    cfg = flagship_config(hidden_dim=8, num_conv_layers=2, batch_size=batch_size)
+    samples = deterministic_graph_data(
+        number_configurations=8,
+        unit_cell_x_range=(2, 3),
+        unit_cell_y_range=(2, 3),
+        unit_cell_z_range=(2, 3),
+        seed=0,
+    )
+    train, val, test, _, _ = prepare_dataset(samples, cfg)
+    cfg = update_config(cfg, train, val, test)
+    return cfg, GraphLoader(train, batch_size, shuffle=False)
+
+
+def pytest_filler_batch_advertises_zero_cost(monkeypatch):
+    """The loader's all-padding filler batches (partial final device
+    rounds) must carry edge_occupancy == 0 / n_real_nodes == 0, so the
+    fused kernel's chunk loop runs ZERO iterations on that device slot
+    — and the conv output is exact zeros."""
+    from hydragnn_tpu.data.loader import _mask_out
+
+    monkeypatch.setenv("HYDRAGNN_PALLAS", "interpret")
+    _, loader = _tiny_loader()
+    batch = next(iter(loader))
+    assert batch.edge_occupancy is not None and batch.n_real_nodes is not None
+    assert int(batch.edge_occupancy) > 0
+
+    filler = _mask_out(batch)
+    assert int(filler.edge_occupancy) == 0
+    assert int(filler.n_real_nodes) == 0
+    assert not np.asarray(filler.edge_mask).any()
+    # the kernel's chunk-loop bound: ceil(occupancy / CE) chunks run
+    assert -(-int(filler.edge_occupancy) // CE) == 0
+    x = jnp.asarray(
+        np.random.default_rng(0)
+        .normal(size=(filler.nodes.shape[0], 32))
+        .astype(np.float32)
+    )
+    out = fused_conv(
+        x,
+        filler.senders,
+        filler.receivers,
+        filler.edge_mask,
+        int(filler.nodes.shape[0]),
+        real_edges=filler.edge_occupancy,
+    )
+    assert float(jnp.abs(out).max()) == 0.0
+
+
+def pytest_pad_waste_from_batch_consistent():
+    """pad_waste_from_batch must agree with the batch's own masks and
+    occupancy fields (the bench/manifest accounting input)."""
+    from hydragnn_tpu.obs.introspect import pad_waste_from_batch
+
+    _, loader = _tiny_loader()
+    batch = next(iter(loader))
+    waste = pad_waste_from_batch(batch)
+    assert waste["edge_pad"] == int(np.asarray(batch.senders).shape[-1])
+    assert waste["node_pad"] == int(np.asarray(batch.node_mask).shape[-1])
+    assert waste["real_edges_mean"] == pytest.approx(
+        float(np.asarray(batch.edge_occupancy)), abs=0.1
+    )
+    assert 0.0 <= waste["edge_waste_frac"] < 1.0
+    assert 0.0 <= waste["node_waste_frac"] < 1.0
+    # the occupancy bound can sit ABOVE the real-edge count (run_align
+    # interleaves masked self-loops below it) but never above the pad
+    assert waste["real_edges_mean"] <= waste["edge_pad"]
+    assert float(np.asarray(batch.edge_mask).sum()) <= waste["real_edges_mean"]
+
+
+def pytest_traffic_model_large_graph_drop():
+    """The acceptance headline: on the large-graph bench shape the
+    analytic cost model must show >=30% bytes/step off the padded
+    fused path with occupancy skip + bf16 activations."""
+    from hydragnn_tpu.flagship import build_flagship
+    from hydragnn_tpu.obs.introspect import (
+        conv_traffic_model,
+        pad_waste_from_batch,
+    )
+
+    _, _, _, loader = build_flagship(
+        n_samples=12, hidden_dim=16, num_conv_layers=2, batch_size=4,
+        unit_cells=(4, 5),
+    )
+    batch = next(iter(loader))
+    waste = pad_waste_from_batch(batch)
+    for hidden, layers in ((16, 2), (128, 6)):  # smoke + full bench shape
+        m = conv_traffic_model(
+            waste["node_pad"], waste["edge_pad"], hidden, layers,
+            real_edges=waste["real_edges_mean"],
+        )
+        bps = m["bytes_per_step"]
+        assert bps["fused_skip"] <= bps["fused_padded"] <= bps["xla_unfused"]
+        assert bps["resident_skip"] < bps["fused_skip_bf16"]
+        assert m["drop_vs_fused_padded"]["fused_skip_bf16"] >= 0.30, m
+
+
+def pytest_model_level_conv_bf16(monkeypatch):
+    """Architecture.conv_bf16 through the real chassis: loss and grads
+    finite and within bf16 tolerance of the f32 path, same params.
+    Runs the XLA conv path (fast on CPU) — the knob casts the same
+    streamed operands in both paths, and kernel-vs-fallback bf16
+    equivalence is already pinned at the op level above."""
+    from hydragnn_tpu.models.base import model_loss
+    from hydragnn_tpu.models.create import create_model_config
+
+    monkeypatch.setenv("HYDRAGNN_PALLAS", "0")
+    cfg, loader = _tiny_loader()
+    batch = next(iter(loader))
+    arch = cfg["NeuralNetwork"]["Architecture"]
+
+    results = {}
+    for bf16 in (False, True):
+        arch["conv_bf16"] = bf16
+        model, variables = create_model_config(cfg["NeuralNetwork"], batch)
+        assert model.cfg.conv_bf16 is bf16
+
+        def loss(params):
+            outs = model.apply(
+                {
+                    "params": params,
+                    "batch_stats": variables.get("batch_stats", {}),
+                },
+                batch,
+                train=False,
+            )
+            total, _ = model_loss(model.cfg, outs, batch)
+            return total
+
+        results[bf16] = jax.value_and_grad(loss)(variables["params"])
+
+    l0, g0 = results[False]
+    l1, g1 = results[True]
+    assert np.isfinite(float(l1))
+    assert abs(float(l1) - float(l0)) <= 5e-2 * max(abs(float(l0)), 1.0)
+    leaves0 = jax.tree_util.tree_leaves(g0)
+    leaves1 = jax.tree_util.tree_leaves(g1)
+    gmax = max(float(jnp.abs(a).max()) for a in leaves0)
+    gerr = max(
+        float(jnp.abs(a - b).max()) for a, b in zip(leaves0, leaves1)
+    )
+    assert np.isfinite(gerr)
+    assert gerr / max(gmax, 1e-9) < 8e-2

@@ -15,15 +15,24 @@ Protocol:
   - FAIL (exit 2) when throughput drops more than ``--tolerance``
     (default 15%) below baseline; on TPU, MFU (from the XLA cost model
     + the chip peak table) gates with the same tolerance;
+  - the step's COST-MODEL bytes ("bytes accessed" of the compiled
+    step) gate alongside: more than ``--tolerance`` ABOVE baseline
+    fails — a PR that silently re-materializes an [E, H] intermediate
+    regresses traffic long before a tiny CI box can measure it as time
+    (ISSUE 10 satellite). Bytes are deterministic per build, so this
+    arm is noise-free;
   - a machine with no recorded baseline WRITES one and passes (prints
     a notice) — the committed file carries this container's key; other
     machines self-baseline on first run. ``--update-baseline`` forces a
     rewrite (use after an intentional perf change, and commit it).
 
-Self-test hook: ``--inject-slowdown-ms F`` sleeps F ms inside the timed
-loop after every step — a genuine measured slowdown, not a doctored
-number — so ci.sh can assert the gate demonstrably fails on a slow
-build (the acceptance criterion).
+Self-test hooks: ``--inject-slowdown-ms F`` sleeps F ms inside the
+timed loop after every step — a genuine measured slowdown, not a
+doctored number — so ci.sh can assert the gate demonstrably fails on a
+slow build. ``--inject-traffic-mb M`` adds the cost-model bytes of a
+REAL compiled executable over an M-MiB array to the measured step
+bytes (genuine extra cost-model traffic, not an arithmetic fudge) so
+the traffic arm's failure path is demonstrable the same way.
 """
 
 from __future__ import annotations
@@ -40,7 +49,7 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def _measure(inject_ms: float, steps: int) -> dict:
+def _measure(inject_ms: float, steps: int, inject_traffic_mb: float = 0.0) -> dict:
     import jax
     import numpy as np
 
@@ -70,7 +79,22 @@ def _measure(inject_ms: float, steps: int) -> dict:
     )
     batches = list(loader)
     compiled = step.lower(state, batches[0]).compile()
-    flops, _ = cost_analysis(compiled)
+    flops, nbytes = cost_analysis(compiled)
+
+    # traffic-arm self-test: the extra bytes come from the XLA cost
+    # model of a REAL compiled executable over an M-MiB array — the
+    # same pricing path as the gated number, not an arithmetic fudge
+    if inject_traffic_mb > 0 and nbytes:
+        import jax.numpy as jnp
+
+        n = max(1, int(inject_traffic_mb * (1 << 20)) // 4)
+        ballast = (
+            jax.jit(lambda x: x + 1.0)
+            .lower(jnp.zeros((n,), jnp.float32))
+            .compile()
+        )
+        _, extra = cost_analysis(ballast)
+        nbytes += extra or inject_traffic_mb * (1 << 20) * 2
 
     state, loss, _ = compiled(state, batches[0])  # warmup execution
     np.asarray(loss)
@@ -102,7 +126,25 @@ def _measure(inject_ms: float, steps: int) -> dict:
             if flops and peak and on_tpu
             else None
         ),
+        "bytes_per_step_costmodel": round(nbytes) if nbytes else None,
     }
+    # the analytic conv-traffic modes for THIS fixed config (informational
+    # in the baseline: a change here is a deliberate kernel-mode change,
+    # reviewed via the committed diff rather than a numeric tolerance)
+    try:
+        from hydragnn_tpu.obs.introspect import (
+            conv_traffic_model,
+            pad_waste_from_batch,
+        )
+
+        waste = pad_waste_from_batch(batches[0])
+        out["conv_traffic_model"] = conv_traffic_model(
+            waste["node_pad"], waste["edge_pad"], 16, 2,
+            real_edges=waste["real_edges_mean"],
+        )["bytes_per_step"]
+        out["pad_waste"] = waste
+    except Exception:
+        pass
     return out
 
 
@@ -126,14 +168,22 @@ def main() -> int:
         default=0.0,
         help="self-test: sleep this many ms per step inside the timed loop",
     )
+    ap.add_argument(
+        "--inject-traffic-mb",
+        type=float,
+        default=0.0,
+        help="self-test: add a real compiled executable's cost-model "
+        "bytes over an array of this many MiB to the step's bytes",
+    )
     args = ap.parse_args()
 
-    cur = _measure(args.inject_slowdown_ms, args.steps)
+    cur = _measure(args.inject_slowdown_ms, args.steps, args.inject_traffic_mb)
     key = f"{cur['backend']}:{cur['device_kind']}"
     print(
         f"bench gate [{key}]: {cur['graphs_per_sec']} graphs/sec "
         f"(step {cur['step_ms_median']} ms, segments "
-        f"{cur['step_ms_segments']}, mfu {cur['mfu']})"
+        f"{cur['step_ms_segments']}, mfu {cur['mfu']}, "
+        f"bytes/step {cur['bytes_per_step_costmodel']})"
     )
 
     baselines = {}
@@ -143,16 +193,19 @@ def main() -> int:
     base = baselines.get(key)
 
     if base is None or args.update_baseline:
-        if args.inject_slowdown_ms > 0:
+        if args.inject_slowdown_ms > 0 or args.inject_traffic_mb > 0:
             print("bench gate: refusing to record a baseline with an "
-                  "injected slowdown")
+                  "injected slowdown/traffic")
             return 1
         baselines[key] = {
             "graphs_per_sec": cur["graphs_per_sec"],
             "step_ms_median": cur["step_ms_median"],
             "mfu": cur["mfu"],
             "steps": cur["steps"],
+            "bytes_per_step_costmodel": cur["bytes_per_step_costmodel"],
         }
+        if cur.get("conv_traffic_model"):
+            baselines[key]["conv_traffic_model"] = cur["conv_traffic_model"]
         with open(args.baseline, "w") as f:
             json.dump(baselines, f, indent=1, sort_keys=True)
             f.write("\n")
@@ -175,6 +228,18 @@ def main() -> int:
             failures.append(
                 f"MFU {cur['mfu']} < {mfu_floor:.5f} "
                 f"(baseline {base['mfu']} - {args.tolerance:.0%})"
+            )
+    # traffic arm: cost-model bytes/step are deterministic per build —
+    # MORE than tolerance above baseline is a regression (a build that
+    # re-materializes a fused intermediate shows up here even when a
+    # tiny CI box can't resolve it as wall time)
+    if cur.get("bytes_per_step_costmodel") and base.get("bytes_per_step_costmodel"):
+        ceil_b = base["bytes_per_step_costmodel"] * (1.0 + args.tolerance)
+        if cur["bytes_per_step_costmodel"] > ceil_b:
+            failures.append(
+                f"cost-model bytes/step {cur['bytes_per_step_costmodel']} > "
+                f"{ceil_b:.0f} (baseline {base['bytes_per_step_costmodel']} "
+                f"+ {args.tolerance:.0%})"
             )
     if failures:
         for msg in failures:
