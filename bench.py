@@ -315,8 +315,42 @@ def _bench_one(
         raise RuntimeError(f"empty bench loader for config {name}")
 
     # AOT-compile once: the same executable serves the cost analysis and
-    # the timed loop (no double jit-cache compilation).
-    compiled = step.lower(state, batches[0]).compile()
+    # the timed loop (no double jit-cache compilation). With
+    # HYDRAGNN_EXEC_CACHE set, the persistent executable cache
+    # (utils/exec_cache.py) replaces a repeated round's lowering+compile
+    # with a disk deserialize; without the env var this is byte-for-byte
+    # the old path.
+    from hydragnn_tpu.utils.exec_cache import (
+        ExecCache,
+        abstract_fingerprint,
+        compat_manifest,
+        fingerprint,
+    )
+
+    ecache = ExecCache.from_env(consumer="bench")
+    exec_cache_hit = False
+    if ecache.enabled:
+        # cache the donation-free twin of the step — a deserialized
+        # DONATED executable is unsound (utils/exec_cache.py docstring)
+        import jax
+
+        body = getattr(step, "__wrapped__", None)
+        cache_step = jax.jit(body) if body is not None else step
+        compiled, exec_cache_hit, _ = ecache.get_or_compile(
+            fingerprint(
+                "bench_step",
+                name,
+                abstract_fingerprint((state, batches[0])),
+                body is None,
+            ),
+            cache_step,
+            (state, batches[0]),
+            compat_manifest(compute_dtype=compute_dtype),
+            donated=body is None,
+            label=name,
+        )
+    else:
+        compiled = step.lower(state, batches[0]).compile()
     flops, nbytes = _cost_analysis(compiled)
 
     import numpy as np
@@ -468,6 +502,8 @@ def _bench_one(
         "pad_waste": pad_waste,
         "conv_traffic_model": conv_traffic,
     }
+    if ecache.enabled:
+        out["exec_cache_hit"] = bool(exec_cache_hit)
     if not scan:
         out["step_ms_median"] = round(statistics.median(seg_ms), 3)
         out["step_ms_segments"] = [round(t, 2) for t in seg_ms]

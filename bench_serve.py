@@ -20,6 +20,16 @@ sized model; the same knobs scale it to a real chip. Knobs:
 SERVE_REQUESTS, SERVE_THREADS, SERVE_MAX_BATCH, SERVE_DELAY_MS,
 SERVE_BUCKETS, SERVE_SAMPLES, SERVE_HIDDEN, SERVE_LAYERS.
 
+Cold-vs-warm mode (``python bench_serve.py --cold-warm``, or
+SERVE_COLD_WARM=1): the r09 cold-start headline. Starts TWO sequential
+servers against the same persistent executable cache directory
+(utils/exec_cache.py; SERVE_EXEC_CACHE overrides the default fresh temp
+dir): the first (cold) pays the AOT bucket-ladder compiles and stores
+every executable, the second (warm) must deserialize the whole ladder
+from disk — ``compile_warmup == 0`` is asserted, the record reports
+``startup_cold_s`` / ``startup_warm_s`` plus compile and exec-cache
+counts, and both servers prove the ladder actually serves traffic.
+
 Chaos mode (``python bench_serve.py --chaos``, or SERVE_CHAOS=1): the
 committed self-healing acceptance run (docs/RESILIENCE.md "Serving
 resilience"). Against live traffic it injects a raise-in-forward poison
@@ -172,6 +182,113 @@ def main() -> None:
             "steady-state traffic recompiled",
             file=sys.stderr,
         )
+        raise SystemExit(1)
+
+
+def cold_warm() -> None:
+    """Cold vs warm serve startup against one persistent executable
+    cache dir (see module docstring). Exit 1 if the warm start paid ANY
+    live warmup compile — the zero-compile second replica is the
+    acceptance bar, not an aspiration."""
+    from bench import init_device_with_flight, open_bench_flight
+
+    metric = "serve_cold_vs_warm_startup"
+    flight = open_bench_flight("BENCH_SERVE_WARM_FLIGHT.jsonl")
+    device, init_retries = init_device_with_flight(metric, flight)
+
+    import tempfile
+
+    from hydragnn_tpu.flagship import build_flagship
+    from hydragnn_tpu.serve import ModelRegistry, ModelServer, ServeConfig
+
+    max_batch = int(os.environ.get("SERVE_MAX_BATCH", 8))
+    num_buckets = int(os.environ.get("SERVE_BUCKETS", 3))
+    n_samples = int(os.environ.get("SERVE_SAMPLES", 64))
+    hidden = int(os.environ.get("SERVE_HIDDEN", 16))
+    layers = int(os.environ.get("SERVE_LAYERS", 2))
+    cache_dir = os.environ.get("SERVE_EXEC_CACHE") or tempfile.mkdtemp(
+        prefix="serve_exec_cache_"
+    )
+
+    _, model, variables, loader = build_flagship(
+        n_samples=n_samples,
+        hidden_dim=hidden,
+        num_conv_layers=layers,
+        batch_size=max(max_batch, 2),
+        unit_cells=(2, 4),
+    )
+    requests = list(loader.all_samples)
+    registry = ModelRegistry()
+
+    def one_start(tag: str) -> dict:
+        # a fresh registration per start = a fresh jitted forward, so
+        # the warm server cannot lean on the cold server's in-process
+        # jit cache — its zero-compile startup is the DISK cache's work
+        served = registry.register(f"bench_serve_{tag}", model, variables)
+        server = ModelServer(
+            served,
+            requests,
+            ServeConfig(
+                max_batch=max_batch,
+                num_buckets=num_buckets,
+                exec_cache_dir=cache_dir,
+            ),
+            flight=flight,
+        )
+        t0 = time.perf_counter()
+        server.start()
+        startup_s = time.perf_counter() - t0
+        # the deserialized ladder must actually serve, not just load
+        for s in requests[: min(max_batch, len(requests))]:
+            server.predict(s, timeout=60)
+        snap = server.metrics_snapshot()
+        ladder = len(server.buckets)
+        server.stop()
+        return {
+            "startup_s": round(startup_s, 3),
+            "buckets": ladder,
+            "compile_warmup": snap["compile_warmup"],
+            "compile_misses": snap["compile_misses"],
+            "exec_cache_hits": snap["exec_cache_hits"],
+            "exec_cache_misses": snap["exec_cache_misses"],
+            "exec_cache_miss_reasons": snap["exec_cache_miss_reasons"],
+        }
+
+    cold = one_start("cold")
+    warm = one_start("warm")
+
+    failures = []
+    if warm["compile_warmup"] != 0:
+        failures.append(
+            f"warm start paid {warm['compile_warmup']} live warmup "
+            "compiles — the persistent cache did not cover the ladder"
+        )
+    if warm["exec_cache_hits"] < warm["buckets"]:
+        failures.append(
+            f"warm exec_cache_hits={warm['exec_cache_hits']} below the "
+            f"ladder size {warm['buckets']} — some bucket recompiled"
+        )
+    record = {
+        "metric": metric,
+        "value": warm["startup_s"],
+        "unit": "s_warm_startup",
+        "init_retries": init_retries,
+        "startup_cold_s": cold["startup_s"],
+        "startup_warm_s": warm["startup_s"],
+        "warm_over_cold": round(
+            warm["startup_s"] / max(cold["startup_s"], 1e-9), 3
+        ),
+        "cache_dir": cache_dir,
+        "cold": cold,
+        "warm": warm,
+        "failures": failures,
+    }
+    flight.record("bench_result", record=record, passed=not failures)
+    flight.close()
+    print(json.dumps(record))
+    if failures:
+        for f in failures:
+            print(f"FAIL: {f}", file=sys.stderr)
         raise SystemExit(1)
 
 
@@ -363,5 +480,7 @@ def chaos() -> None:
 if __name__ == "__main__":
     if "--chaos" in sys.argv or os.environ.get("SERVE_CHAOS") == "1":
         chaos()
+    elif "--cold-warm" in sys.argv or os.environ.get("SERVE_COLD_WARM") == "1":
+        cold_warm()
     else:
         main()

@@ -46,7 +46,11 @@
 #                         supervisor (tools/supervise.py) resumes it to
 #                         completion, and the merged flight record must
 #                         validate with exactly one preempted run_end +
-#                         one resumed event (docs/RESILIENCE.md).
+#                         one resumed event (docs/RESILIENCE.md). The run
+#                         shares a persistent executable cache
+#                         (HYDRAGNN_EXEC_CACHE survives the restart), so
+#                         the resumed segment must reach first-step-ready
+#                         as a cache HIT with 0 new compiles.
 #   7. serve-chaos      — a tiny trained run is served; a poison request
 #      smoke               is injected (raise-in-forward), then the
 #                         checkpoint is HOT-reloaded into the running
@@ -56,29 +60,38 @@
 #                         tools/serve_probe.py must exit 0 on the
 #                         exported Prometheus textfile
 #                         (docs/RESILIENCE.md "Serving resilience").
-#   8. perf gate        — tools/bench_gate.py: a tiny fixed-config bench
+#   8. exec-cache smoke — persistent AOT executable cache (docs/PERF.md
+#                         "r09 cold start"): train a tiny model once,
+#                         start TWO servers (separate processes) against
+#                         one cache dir — the second must perform 0 AOT
+#                         compiles (every bucket a disk hit) — then
+#                         corrupt one entry and require a LOUD
+#                         single-entry eviction + recompile, not a crash.
+#   9. perf gate        — tools/bench_gate.py: a tiny fixed-config bench
 #                         measured with D2H-fenced segments and compared
 #                         against the committed BENCH_CI_BASELINE.json
 #                         (>15% graphs/sec regression fails; MFU too on
 #                         TPU; >15% cost-model bytes/step INCREASE
 #                         fails), then self-tests proving the gate fails
 #                         on an injected slowdown and on injected
-#                         cost-model traffic.
-#   9. full matrix      — opt-in (CI_FULL=1): all 7 models x head configs
+#                         cost-model traffic; plus the warm-start arm —
+#                         a warm executable-cache start must cost <50%
+#                         of the cold start and 0 compiles.
+#  10. full matrix      — opt-in (CI_FULL=1): all 7 models x head configs
 #                         trained to the reference accuracy thresholds
 #                         (HYDRAGNN_FULL_MATRIX=1, ~15 min).
-#  10. TPU kernel suite — opt-in (CI_TPU=1, needs a real TPU):
+#  11. TPU kernel suite — opt-in (CI_TPU=1, needs a real TPU):
 #                         HYDRAGNN_TPU_TESTS=1 on-chip kernel-vs-XLA
 #                         checks, budgeted under the tunnel's dispatch
 #                         throttle (tests/test_tpu_chip.py).
 #
-# Usage: ./ci.sh            # stages 1-8 (the default CI gate)
+# Usage: ./ci.sh            # stages 1-9 (the default CI gate)
 #        CI_FULL=1 ./ci.sh  # + acceptance matrix
 #        CI_TPU=1  ./ci.sh  # + real-chip kernel suite
 set -euo pipefail
 cd "$(dirname "$0")"
 
-echo "== [1/10] format gate =="
+echo "== [1/11] format gate =="
 if python -m black --version >/dev/null 2>&1; then
     python -m black --check .
 elif command -v black >/dev/null 2>&1; then
@@ -88,13 +101,13 @@ else
     python -m compileall -q hydragnn_tpu tests examples tools bench.py bench_scaling.py bench_serve.py __graft_entry__.py
 fi
 
-echo "== [2/10] chip hygiene report =="
+echo "== [2/11] chip hygiene report =="
 python tools/chip_hygiene.py || true
 
-echo "== [3/10] serial suite (virtual 8-device CPU mesh, incl. 2-process pass) =="
+echo "== [3/11] serial suite (virtual 8-device CPU mesh, incl. 2-process pass) =="
 python -m pytest tests/ -q
 
-echo "== [4/10] partitioner smoke (Mesh( grep gate; fsdp=2 train == fsdp=1, flight parallel block) =="
+echo "== [4/11] partitioner smoke (Mesh( grep gate; fsdp=2 train == fsdp=1, flight parallel block) =="
 # Train, serve, and bench obtain meshes/shardings exclusively through the
 # Partitioner: no module outside hydragnn_tpu/parallel/ may construct a
 # jax.sharding.Mesh directly. tests/ are exempt (they build adversarial
@@ -183,7 +196,7 @@ echo "$PART_OUT" | grep -q "parallel: mesh=" || {
     echo "FAIL: --validate did not surface the parallel block"; exit 1; }
 rm -rf "$PART_DIR"
 
-echo "== [5/10] telemetry smoke (tiny 2-head training -> schema-valid v2 flight record with head diagnostics + MFU ledger) =="
+echo "== [5/11] telemetry smoke (tiny 2-head training -> schema-valid v2 flight record with head diagnostics + MFU ledger) =="
 SMOKE_DIR="$(mktemp -d)"
 JAX_PLATFORMS=cpu python - "$SMOKE_DIR" <<'EOF'
 import sys
@@ -243,7 +256,7 @@ print("introspection smoke: OK (v2 record, head diagnostics + MFU ledger present
 EOF
 rm -rf "$SMOKE_DIR"
 
-echo "== [6/10] fault-injection smoke (SIGTERM mid-epoch -> supervisor resume) =="
+echo "== [6/11] fault-injection smoke (SIGTERM mid-epoch -> supervisor resume) =="
 FAULT_DIR="$(mktemp -d)"
 cat > "$FAULT_DIR/child.py" <<'EOF'
 import sys
@@ -255,6 +268,11 @@ from hydragnn_tpu.flagship import flagship_config
 
 cfg = flagship_config(hidden_dim=8, num_conv_layers=2, batch_size=5, num_epoch=2)
 cfg["NeuralNetwork"]["Training"]["checkpoint_every"] = 1
+# pin per-step dispatch in BOTH segments: the injection env forces
+# per_step in segment 1 but is stripped on restart, and the executable
+# cache key includes the dispatch mode — the resumed segment must ask
+# for the SAME program to warm-start from the cache
+cfg["NeuralNetwork"]["Training"]["scan_epoch"] = False
 samples = deterministic_graph_data(
     number_configurations=20,
     unit_cell_x_range=(2, 3),
@@ -267,7 +285,11 @@ with run_guard():
 EOF
 # PYTHONPATH: the child script lives in the temp dir, so the repo must
 # reach its sys.path through the environment
+# HYDRAGNN_EXEC_CACHE is NOT an injection var, so it survives the
+# supervisor's restart env-strip: the resumed segment finds the
+# executable segment 1 stored and must not recompile it
 JAX_PLATFORMS=cpu PYTHONPATH="$PWD" HYDRAGNN_INJECT_SIGTERM_STEP=2 \
+    HYDRAGNN_EXEC_CACHE="$FAULT_DIR/exec_cache" \
     python tools/supervise.py \
     --flight "$FAULT_DIR/supervisor.jsonl" -- \
     python "$FAULT_DIR/child.py" "$FAULT_DIR"
@@ -285,11 +307,24 @@ assert [e["status"] for e in ends] == ["preempted", "completed"], ends
 assert sum(1 for e in ev if e.get("kind") == "resumed") == 1, [
     e.get("kind") for e in ev
 ]
-print("fault-injection smoke: OK (one preempted + one resumed, run completed)")
+# warm auto-resume: segment 1 compiled+stored the train step (miss),
+# segment 2 must reach first-step-ready as a cache HIT with 0 compiles
+ready = [
+    e
+    for e in ev
+    if e.get("kind") == "exec_cache" and e.get("event") == "train_ready"
+]
+assert len(ready) == 2, ready
+assert ready[0]["hit"] is False, ready[0]
+assert ready[1]["hit"] is True and ready[1]["compiles"] == 0, ready[1]
+print(
+    "fault-injection smoke: OK (one preempted + one resumed, run completed; "
+    f"resume warm-started from the exec cache in {ready[1]['build_s']}s, 0 compiles)"
+)
 EOF
 rm -rf "$FAULT_DIR"
 
-echo "== [7/10] serve-chaos smoke (poison request -> quarantine; hot reload from the saved checkpoint; health probe) =="
+echo "== [7/11] serve-chaos smoke (poison request -> quarantine; hot reload from the saved checkpoint; health probe) =="
 SERVE_DIR="$(mktemp -d)"
 JAX_PLATFORMS=cpu python - "$SERVE_DIR" <<'EOF'
 import glob
@@ -377,7 +412,90 @@ python tools/obs_report.py --faults "$SERVE_DIR/serve_flight.jsonl"
 python tools/serve_probe.py --prom "$SERVE_DIR/serve.prom" --verbose
 rm -rf "$SERVE_DIR"
 
-echo "== [8/10] perf gate (tiny fixed-config bench vs committed baseline) =="
+echo "== [8/11] exec-cache smoke (train once; two server starts vs one cache dir; corrupt entry -> loud eviction) =="
+EXEC_DIR="$(mktemp -d)"
+cat > "$EXEC_DIR/serve_once.py" <<'EOF'
+import sys
+
+out = sys.argv[1]
+expect = sys.argv[2]  # cold | warm | corrupt
+
+from hydragnn_tpu.api import run_training, serve_model
+from hydragnn_tpu.data.synthetic import deterministic_graph_data
+from hydragnn_tpu.flagship import flagship_config
+from hydragnn_tpu.serve import ServeConfig
+
+
+def cfg():
+    return flagship_config(hidden_dim=8, num_conv_layers=2, batch_size=5, num_epoch=1)
+
+
+def data():
+    return deterministic_graph_data(
+        number_configurations=20,
+        unit_cell_x_range=(2, 3),
+        unit_cell_y_range=(2, 3),
+        unit_cell_z_range=(2, 3),
+        seed=0,
+    )
+
+
+if expect == "cold":
+    run_training(cfg(), samples=data(), log_dir=out + "/logs/")
+
+server = serve_model(
+    cfg(),
+    samples=data(),
+    log_dir=out + "/logs/",
+    serve_config=ServeConfig(
+        max_batch=4, max_delay_ms=5.0, exec_cache_dir=out + "/exec_cache"
+    ),
+)
+snap = server.metrics_snapshot()
+n = len(server.buckets)
+server.stop()
+print(
+    f"{expect} start: buckets={n} warmup_compiles={snap['compile_warmup']} "
+    f"cache_hits={snap['exec_cache_hits']} "
+    f"miss_reasons={snap['exec_cache_miss_reasons']}"
+)
+if expect == "cold":
+    assert snap["compile_warmup"] == n and snap["exec_cache_misses"] == n, snap
+elif expect == "warm":
+    # the second-replica criterion: 0 AOT compiles, every bucket from disk
+    assert snap["compile_warmup"] == 0, f"warm start recompiled: {snap}"
+    assert snap["exec_cache_hits"] == n, snap
+else:  # corrupt: ONE loud eviction + recompile of that bucket, rest hit
+    assert snap["exec_cache_miss_reasons"] == {"corrupt": 1}, snap
+    assert snap["compile_warmup"] == 1 and snap["exec_cache_hits"] == n - 1, snap
+EOF
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" python "$EXEC_DIR/serve_once.py" "$EXEC_DIR" cold
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" python "$EXEC_DIR/serve_once.py" "$EXEC_DIR" warm
+# flip bytes inside one entry: the next start must evict LOUDLY (stderr
+# names the entry), recompile just that bucket, and serve normally
+python - "$EXEC_DIR/exec_cache" <<'EOF'
+import glob
+import sys
+
+path = sorted(glob.glob(sys.argv[1] + "/*.bin"))[0]
+with open(path, "r+b") as f:
+    f.seek(30)
+    f.write(b"\xde\xad\xbe\xef")
+EOF
+if ! JAX_PLATFORMS=cpu PYTHONPATH="$PWD" python "$EXEC_DIR/serve_once.py" "$EXEC_DIR" corrupt \
+        2>"$EXEC_DIR/corrupt.err"; then
+    echo "FAIL: server start over a corrupt cache entry crashed"
+    cat "$EXEC_DIR/corrupt.err"
+    exit 1
+fi
+grep -q "exec_cache: evicted entry" "$EXEC_DIR/corrupt.err" || {
+    echo "FAIL: corruption eviction was not loud on stderr"
+    cat "$EXEC_DIR/corrupt.err"
+    exit 1
+}
+rm -rf "$EXEC_DIR"
+
+echo "== [9/11] perf gate (tiny fixed-config bench vs committed baseline) =="
 # fails on a >15% graphs/sec regression (and MFU regression on TPU)
 # against BENCH_CI_BASELINE.json, keyed per backend:device so every CI
 # machine gates against its own recorded number (tools/bench_gate.py)
@@ -400,19 +518,22 @@ if JAX_PLATFORMS=cpu python tools/bench_gate.py --inject-traffic-mb 64 >/tmp/_ga
 else
     echo "bench gate self-test: injected traffic correctly rejected"
 fi
+# warm-start arm: same executable through a fresh cache — the warm start
+# must cost <50% of the cold compile and perform 0 XLA compiles
+JAX_PLATFORMS=cpu python tools/bench_gate.py --warm-start-arm
 
 if [ "${CI_FULL:-0}" = "1" ]; then
-    echo "== [9/10] full acceptance matrix (reference thresholds) =="
+    echo "== [10/11] full acceptance matrix (reference thresholds) =="
     HYDRAGNN_FULL_MATRIX=1 python -m pytest tests/test_train_matrix.py -q
 else
-    echo "== [9/10] full acceptance matrix: skipped (set CI_FULL=1) =="
+    echo "== [10/11] full acceptance matrix: skipped (set CI_FULL=1) =="
 fi
 
 if [ "${CI_TPU:-0}" = "1" ]; then
-    echo "== [10/10] real-chip TPU kernel suite =="
+    echo "== [11/11] real-chip TPU kernel suite =="
     HYDRAGNN_TPU_TESTS=1 python -m pytest tests/test_tpu_chip.py -q
 else
-    echo "== [10/10] real-chip TPU kernel suite: skipped (set CI_TPU=1, needs a TPU) =="
+    echo "== [11/11] real-chip TPU kernel suite: skipped (set CI_TPU=1, needs a TPU) =="
 fi
 
 echo "CI protocol complete."

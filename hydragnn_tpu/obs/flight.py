@@ -62,6 +62,10 @@ _REQUIRED: Dict[str, tuple] = {
     "dispatch_restart": ("attempt", "cause"),
     "reload": ("source",),
     "reload_failed": ("source", "error"),
+    # persistent AOT executable cache (hydragnn_tpu/utils/exec_cache.py):
+    # one event per cache interaction — hit / miss (with reason) /
+    # store / evict / store_failed
+    "exec_cache": ("event",),
 }
 
 # the fault-history subset tools/obs_report.py --faults narrates

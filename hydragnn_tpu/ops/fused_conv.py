@@ -57,9 +57,12 @@ path via the shared ``HYDRAGNN_PALLAS`` knob machinery
 
 Knob contract: ``HYDRAGNN_PALLAS`` as in ``segment_pallas`` (auto =
 kernel on TPU, ``interpret`` forces interpret mode on any backend for
-CPU tests, ``0`` forces XLA). Widths are lane-padded to 128 in and
-sliced back out. Output is float32 (the segment-sum accumulation
-contract); callers cast.
+CPU tests, ``0`` forces XLA). The BN/CE block/chunk sizes are imported
+from ``segment_pallas``, whose import-time defaults come from the
+committed sweep table ``TUNE_TILES.json`` (``tools/tune_tiles.py
+--save``; explicit HYDRAGNN_BN/CE env knobs always win). Widths are
+lane-padded to 128 in and sliced back out. Output is float32 (the
+segment-sum accumulation contract); callers cast.
 """
 
 from __future__ import annotations

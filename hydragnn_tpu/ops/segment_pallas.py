@@ -78,14 +78,54 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 # larger tiles amortize per-grid-step overhead (the r04 flagship trace
 # shows ~1 ms kernel calls moving only ~0.2 GB — overhead-bound), at the
 # cost of VMEM and wasted work on boundary blocks.
-BN = int(os.environ.get("HYDRAGNN_BN", 128))  # output rows (nodes) per grid step
-CE = int(os.environ.get("HYDRAGNN_CE", 512))  # edges DMA'd per inner chunk
+
+
+def _tile_defaults() -> dict:
+    """Block/chunk defaults from the committed sweep table
+    (``TUNE_TILES.json`` at the repo root, written by
+    ``tools/tune_tiles.py --save``): ``{shape_tag: {device_kind:
+    {"BN", "CE", "BCAST_CE"}}}``. Selection keys come from env —
+    ``HYDRAGNN_TILE_SHAPE`` then ``HYDRAGNN_DEVICE_KIND``, each falling
+    back to the table's ``"default"`` row — NOT from ``jax.devices()``:
+    importing this module must never trigger backend init ahead of the
+    platform pinning entry scripts rely on. The explicit
+    ``HYDRAGNN_BN`` / ``HYDRAGNN_CE`` / ``HYDRAGNN_BCAST_CE`` env knobs
+    always win over the table; any read/parse failure falls back to the
+    baked r05-measured defaults, so a missing or mangled table can
+    never change kernel behavior."""
+    out = {"BN": 128, "CE": 512, "BCAST_CE": 1024}
+    try:
+        import json
+
+        path = os.path.join(
+            os.path.dirname(
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+            ),
+            "TUNE_TILES.json",
+        )
+        with open(path) as f:
+            table = json.load(f)
+        shape = os.environ.get("HYDRAGNN_TILE_SHAPE", "default")
+        kind = os.environ.get("HYDRAGNN_DEVICE_KIND", "default")
+        by_shape = table.get(shape) or table.get("default") or {}
+        entry = by_shape.get(kind) or by_shape.get("default") or {}
+        for k in out:
+            if k in entry:
+                out[k] = int(entry[k])
+    except Exception:
+        pass
+    return out
+
+
+_TILE_DEFAULTS = _tile_defaults()
+BN = int(os.environ.get("HYDRAGNN_BN", _TILE_DEFAULTS["BN"]))  # output rows (nodes) per grid step
+CE = int(os.environ.get("HYDRAGNN_CE", _TILE_DEFAULTS["CE"]))  # edges DMA'd per inner chunk
 # Gather-kernel chunk: the bcast kernel has no cross-chunk accumulator,
 # so it tolerates bigger chunks than the family/sum kernels' CE —
 # measured on v5e (r05 flagship trace): 512 -> 77.8 ms/step, 1024 ->
 # 75.9, 2048 -> 79.7 (wider chunks span more BW-windows and the stray
 # re-reads win back the overhead). Default 1024.
-_BCAST_CE = int(os.environ.get("HYDRAGNN_BCAST_CE", 1024))
+_BCAST_CE = int(os.environ.get("HYDRAGNN_BCAST_CE", _TILE_DEFAULTS["BCAST_CE"]))
 if BN % 16 or CE % 16 or BN <= 0 or CE <= 0 or _BCAST_CE % 16 or _BCAST_CE <= 0:
     raise ValueError(
         f"HYDRAGNN_BN={BN} / HYDRAGNN_CE={CE} / HYDRAGNN_BCAST_CE={_BCAST_CE} "

@@ -22,6 +22,13 @@ per supervised run.
   HYDRAGNN_INJECT_STALL_LOADER=B:S   the loader's producer sleeps S seconds
                                      before building batch B of an epoch
                                      (drives the hang watchdog)
+  HYDRAGNN_INJECT_DONATION_CHECK_    force the persistent executable cache's
+  FAIL=1                             donation round-trip gate to report
+                                     failure (checked directly in
+                                     utils/exec_cache.py:donation_roundtrip_ok)
+                                     — a donated cached executable is then
+                                     EVICTED with a ``donation_check_failed``
+                                     miss and the consumer live-compiles
   =================================  ==========================================
 
 Serving-side faults (docs/RESILIENCE.md "Serving resilience"; request

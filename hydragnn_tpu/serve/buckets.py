@@ -105,51 +105,139 @@ def route(
 class BucketCompileCache:
     """AOT-compiled forward executable per bucket.
 
-    ``warmup`` compiles the whole ladder up front (startup cost, recorded
-    as ``compile_warmup`` in the metrics); after that, :meth:`executable`
-    is a dict lookup — a serving dispatch can only recompile by going
-    through the eager fallback, which the server counts as a miss."""
+    ``warmup`` materializes the whole ladder up front; after that,
+    :meth:`executable` is a dict lookup — a serving dispatch can only
+    recompile by going through the eager fallback, which the server
+    counts as a miss.
 
-    def __init__(self, forward, variables, build_warm_batch, metrics=None):
+    With an :class:`~hydragnn_tpu.utils.exec_cache.ExecCache` attached,
+    warmup first tries the persistent on-disk executable cache: a disk
+    hit deserializes in milliseconds with ZERO XLA compiles (a second
+    replica or a post-restart server starts warm), and every live
+    compile is stored back so the NEXT process hits. ``compile_warmup``
+    counts only LIVE compiles — a fully warm start reports
+    ``compile_warmup == 0``, which bench_serve.py and the ci.sh warm
+    stage pin."""
+
+    def __init__(
+        self,
+        forward,
+        variables,
+        build_warm_batch,
+        metrics=None,
+        exec_cache=None,
+        identity=None,
+        compat=None,
+    ):
         """``forward`` is the jitted forward fn (variables, batch) ->
         outputs; ``build_warm_batch(bucket)`` builds a structurally
-        representative all-padding batch at the bucket's plan."""
+        representative all-padding batch at the bucket's plan.
+        ``identity`` is the model-architecture half of the disk-cache
+        key (the bucket pad plan is mixed in per bucket); ``compat`` is
+        the environment manifest (versions, device_kind, layout) the
+        disk cache validates entries against."""
         self._forward = forward
         self._variables = variables
         self._build_warm_batch = build_warm_batch
         self._metrics = metrics
+        self._exec_cache = exec_cache
+        self._identity = identity
+        self._compat = compat or {}
         self._compiled = {}
+        # armed by rebind(require_canary=True) after a hot reload: an
+        # on-demand compile against the NEW variables must pass the same
+        # all-finite gate the reload canary applied to the warm ladder
+        self._post_rebind_gate = False
+
+    def _key(self, b: Bucket) -> Optional[str]:
+        if self._exec_cache is None or not self._exec_cache.enabled:
+            return None
+        from hydragnn_tpu.utils.exec_cache import fingerprint
+
+        return fingerprint(
+            "serve_bucket",
+            self._identity,
+            (b.node_pad, b.edge_pad, b.graph_pad, b.max_batch),
+        )
+
+    def _load_disk(self, b: Bucket):
+        key = self._key(b)
+        if key is None:
+            return None
+        return self._exec_cache.load(key, self._compat, label=f"bucket_{b.index}")
+
+    def _store_disk(self, b: Bucket, exe) -> None:
+        key = self._key(b)
+        if key is not None:
+            self._exec_cache.store(key, exe, self._compat, label=f"bucket_{b.index}")
 
     def warmup(self, buckets: Sequence[Bucket]) -> None:
         for b in buckets:
             if b.index in self._compiled:
                 continue
+            exe = self._load_disk(b)
+            if exe is not None:
+                # disk hit: no XLA compile happened, so compile_warmup
+                # stays untouched (the exec-cache hit counter carries it)
+                self._compiled[b.index] = exe
+                continue
             warm = self._build_warm_batch(b)
-            self._compiled[b.index] = self._forward.lower(
-                self._variables, warm
-            ).compile()
+            exe = self._forward.lower(self._variables, warm).compile()
+            self._compiled[b.index] = exe
+            self._store_disk(b, exe)
             if self._metrics is not None:
                 self._metrics.record_compile(hit=False, warmup=True)
 
-    def rebind(self, variables) -> None:
+    def rebind(self, variables, require_canary: bool = False) -> None:
         """Point future on-demand compiles at new weights (hot reload).
         Existing executables are shape-specialized, not value-
-        specialized — they serve the new variables unchanged."""
+        specialized — they serve the new variables unchanged.
+        ``require_canary=True`` additionally routes every FUTURE
+        on-demand :meth:`executable` materialization through the
+        all-finite gate the reload canary applied to the warm ladder —
+        without it, a bucket first compiled after a reload would serve
+        the new weights unvetted."""
         self._variables = variables
+        if require_canary:
+            self._post_rebind_gate = True
 
     def executable(self, bucket: Bucket):
-        """The pre-built executable for ``bucket``; compiles on demand
-        (recorded as a MISS — this only happens if warmup was skipped)."""
+        """The pre-built executable for ``bucket``; materializes on
+        demand — disk cache first, else a live compile (recorded as a
+        MISS: this only happens if warmup was skipped)."""
         exe = self._compiled.get(bucket.index)
         if exe is None:
-            warm = self._build_warm_batch(bucket)
-            exe = self._forward.lower(self._variables, warm).compile()
+            exe = self._load_disk(bucket)
+            hit_disk = exe is not None
+            if exe is None:
+                warm = self._build_warm_batch(bucket)
+                exe = self._forward.lower(self._variables, warm).compile()
+            if self._post_rebind_gate:
+                self._canary_gate(exe, bucket)
             self._compiled[bucket.index] = exe
-            if self._metrics is not None:
-                self._metrics.record_compile(hit=False)
+            if not hit_disk:
+                self._store_disk(bucket, exe)
+                if self._metrics is not None:
+                    self._metrics.record_compile(hit=False)
         elif self._metrics is not None:
             self._metrics.record_compile(hit=True)
         return exe
+
+    def _canary_gate(self, exe, bucket: Bucket) -> None:
+        """The reload canary's all-finite check, applied to an
+        executable materialized AFTER a hot reload: run it on the
+        bucket's warm batch against the current (post-reload) variables
+        and reject non-finite outputs before it ever serves traffic."""
+        import numpy as np
+
+        outs = exe(self._variables, self._build_warm_batch(bucket))
+        for i, o in enumerate(outs):
+            if not np.all(np.isfinite(np.asarray(o))):
+                raise RuntimeError(
+                    f"post-reload canary gate: on-demand executable for "
+                    f"bucket {bucket.index} produced non-finite outputs "
+                    f"(head {i}) against the reloaded weights"
+                )
 
     def __len__(self) -> int:
         return len(self._compiled)

@@ -87,6 +87,14 @@ class ServeMetrics:
         self._compile_warmup = r.counter(f"{p}.compile_warmup")
         self._compile_hits = r.counter(f"{p}.compile_hits")
         self._compile_misses = r.counter(f"{p}.compile_misses")
+        # persistent AOT executable cache (utils/exec_cache.py): a disk
+        # HIT replaces an XLA compile with a deserialize — the warm
+        # cold-start. Miss REASONS are kept per-class because an
+        # `absent` (first boot) and a `version_skew` (silent fleet
+        # drift) demand different operator responses.
+        self._exec_cache_hits = r.counter(f"{p}.exec_cache_hits")
+        self._exec_cache_misses = r.counter(f"{p}.exec_cache_misses")
+        self._exec_cache_miss_reasons: Dict[str, object] = {}
         # resilience surface (docs/RESILIENCE.md "Serving resilience"):
         # quarantined = requests failed with the typed RequestFailed
         # (poison isolation), poison_retries = multi-request batches
@@ -161,6 +169,20 @@ class ServeMetrics:
             self._compile_hits.inc()
         else:
             self._compile_misses.inc()
+
+    def record_exec_cache(self, *, hit: bool, reason: Optional[str] = None) -> None:
+        """One persistent-executable-cache interaction: a hit (disk
+        deserialize instead of compile) or a classified miss."""
+        if hit:
+            self._exec_cache_hits.inc()
+            return
+        self._exec_cache_misses.inc()
+        reason = reason or "absent"
+        c = self._exec_cache_miss_reasons.get(reason)
+        if c is None:
+            c = self.registry.counter(f"{self.prefix}.exec_cache_miss_{reason}")
+            self._exec_cache_miss_reasons[reason] = c
+        c.inc()
 
     def record_error(self, n: int = 1) -> None:
         self._errors.inc(n)
@@ -237,6 +259,14 @@ class ServeMetrics:
             "compile_warmup": self._compile_warmup.snapshot(),
             "compile_hits": self._compile_hits.snapshot(),
             "compile_misses": self._compile_misses.snapshot(),
+            # additive keys (the pre-existing key set above is a parse
+            # contract): the persistent executable cache's counters
+            "exec_cache_hits": self._exec_cache_hits.snapshot(),
+            "exec_cache_misses": self._exec_cache_misses.snapshot(),
+            "exec_cache_miss_reasons": {
+                reason: c.snapshot()
+                for reason, c in sorted(self._exec_cache_miss_reasons.items())
+            },
             "latency": latency_percentiles(self._latency.values()),
             "buckets": buckets,
         }

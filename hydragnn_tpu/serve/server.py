@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import dataclasses
 import itertools
+import os
 import threading
 import time
 from concurrent.futures import Future
@@ -125,6 +126,12 @@ class ServeConfig:
     prometheus_path: when set, the supervisor's monitor thread writes
       the health + metrics textfile there every prometheus_every_s —
       the file ``tools/serve_probe.py`` probes.
+    exec_cache_dir: persistent AOT executable cache directory
+      (``utils/exec_cache.py``) — the bucket ladder deserializes from
+      here instead of compiling when a previous process already paid
+      (warm cold-start: a second replica or post-restart server starts
+      with 0 compiles). Default: the ``HYDRAGNN_EXEC_CACHE`` env var;
+      unset -> the cache is inert and startup compiles as before.
     """
 
     max_batch: int = 8
@@ -144,6 +151,7 @@ class ServeConfig:
     ready_queue_highwater: float = 0.9
     prometheus_path: Optional[str] = None
     prometheus_every_s: float = 5.0
+    exec_cache_dir: Optional[str] = None
 
 
 def request_to_dict(sample: Any) -> Dict[str, Any]:
@@ -232,11 +240,49 @@ class ModelServer:
                 int(ref_ea.shape[-1]) if ref_ea is not None and ref_ea.ndim > 1 else (1 if ref_ea is not None else 0)
             ),
         }
+        # optional run flight recorder (hydragnn_tpu/obs/flight.py):
+        # start() logs a serving manifest (bucket ladder, request spec),
+        # stop() the final metrics snapshot — bench_serve.py passes one
+        # so a serving bench leaves the same evidence artifact training
+        # runs do. None -> an inert recorder; no call site needs a gate.
+        # (Built BEFORE the compile cache so exec-cache events land in it.)
+        if flight is None:
+            from hydragnn_tpu.obs import FlightRecorder
+
+            flight = FlightRecorder(None, enabled=False)
+        self.flight = flight
+        # persistent AOT executable cache (utils/exec_cache.py): keyed
+        # by architecture + bucket pad plan, validated against versions /
+        # device_kind / the partitioner layout. Serving forwards used
+        # here are donation-free on CPU and value-independent, so they
+        # cache unconditionally.
+        from hydragnn_tpu.utils.exec_cache import (
+            ExecCache,
+            abstract_fingerprint,
+            compat_manifest,
+        )
+
+        pcfg = self.partitioner.config
+        self._exec_cache = ExecCache(
+            self.config.exec_cache_dir or os.environ.get("HYDRAGNN_EXEC_CACHE"),
+            flight=self.flight,
+            metrics=self.metrics,
+            consumer="serve",
+        )
         self._cache = BucketCompileCache(
             served.forward,
             served.variables,
             self._build_warm_batch,
             metrics=self.metrics,
+            exec_cache=self._exec_cache,
+            identity=(
+                served.nn_config
+                if getattr(served, "nn_config", None) is not None
+                else repr(served.cfg),
+                abstract_fingerprint(served.variables),
+                dict(self._spec),
+            ),
+            compat=compat_manifest(layout=(pcfg.data, pcfg.fsdp, pcfg.edge)),
         )
         self._queue = MicroBatchQueue(
             len(self.buckets),
@@ -253,16 +299,6 @@ class ModelServer:
         self._reload_lock = threading.Lock()
         self._supervisor = None  # built in start()
         self.log_dir = "./logs/"  # reload()'s default checkpoint root
-        # optional run flight recorder (hydragnn_tpu/obs/flight.py):
-        # start() logs a serving manifest (bucket ladder, request spec),
-        # stop() the final metrics snapshot — bench_serve.py passes one
-        # so a serving bench leaves the same evidence artifact training
-        # runs do. None -> an inert recorder; no call site needs a gate.
-        if flight is None:
-            from hydragnn_tpu.obs import FlightRecorder
-
-            flight = FlightRecorder(None, enabled=False)
-        self.flight = flight
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -292,6 +328,10 @@ class ModelServer:
                     for b in self.buckets
                 ],
                 "warmup_compile_s": round(time.monotonic() - t0, 3),
+                # persistent-executable-cache outcome of this warmup: a
+                # warm start shows hits == len(buckets) and 0 live
+                # compiles (compile_warmup in the metrics snapshot)
+                "exec_cache": self._exec_cache.manifest(),
                 # which mesh the ladder compiled under + the served
                 # parameter sharding summary (fsdp serving)
                 "parallel": self.partitioner.manifest(
@@ -526,7 +566,10 @@ class ModelServer:
             # the swap: one reference store the dispatch thread picks up
             # on its next batch (in-flight batches finish on old weights)
             self.served.variables = new_vars
-            self._cache.rebind(new_vars)
+            # require_canary: buckets compiled on demand AFTER this
+            # reload must pass the same all-finite gate the canary just
+            # applied to the warm ladder (serve/buckets.py)
+            self._cache.rebind(new_vars, require_canary=True)
             self.metrics.record_reload(ok=True)
             info = {
                 "source": source,

@@ -270,6 +270,49 @@ def _finalize_scan(losses, tasks, counts) -> Tuple[float, np.ndarray]:
     )
 
 
+def _landing_checked(cached, fresh, ecache, key, expected_delta, label):
+    """Wrap a CACHED (deserialized) donated executable with a one-time
+    landing check: the first real execution's output ``state.step`` must
+    equal input ``step + expected_delta`` (1 for a per-step executable,
+    num_batches for a scan-epoch one). A round-trip that dropped
+    donation metadata produces an optimizer update that never lands —
+    the exact silent-staleness failure mode the exec-cache donation gate
+    exists for (utils/exec_cache.py module docstring) — so a failed
+    check EVICTS the entry (``donation_check_failed``) and replays the
+    step through the fresh jitted ``fresh`` on a pre-copy of the inputs
+    (the cached executable may have consumed the donated originals)."""
+    holder = {"fn": cached, "checked": False}
+
+    def _copy(tree):
+        return jax.tree_util.tree_map(
+            lambda x: x.copy() if hasattr(x, "copy") else x, tree
+        )
+
+    def step(*args):
+        if holder["checked"]:
+            return holder["fn"](*args)
+        saved = _copy(args)
+        in_step = int(jax.device_get(args[0].step))
+        try:
+            out = holder["fn"](*args)
+            out_step = int(jax.device_get(out[0].step))
+            if out_step != in_step + expected_delta:
+                raise RuntimeError(
+                    f"cached {label} executable landed step {out_step}, "
+                    f"expected {in_step + expected_delta}"
+                )
+            holder["checked"] = True
+            return out
+        except Exception:
+            ecache._evict(key, "donation_check_failed")
+            ecache._miss(key, "donation_check_failed", label=label)
+            holder["fn"] = fresh
+            holder["checked"] = True
+            return fresh(*saved)
+
+    return step
+
+
 def train_epoch_scan(
     loader, state: TrainState, scan_fn, epoch: int, diag=None, sentry=None
 ) -> Tuple[TrainState, float, np.ndarray]:
@@ -933,6 +976,133 @@ def train_validate_test(
         # story ("one preempted + one resumed") is then readable from
         # the merged flight record alone
         flight.record("resumed", epoch=resumed_from)
+
+    # Persistent AOT executable cache (utils/exec_cache.py): with
+    # HYDRAGNN_EXEC_CACHE set — an env var strip_injection_env
+    # deliberately preserves, so supervisor auto-resume restarts keep it
+    # — the loop-owned train executable (per-step OR scan-epoch) is
+    # deserialized from disk instead of recompiled. The loop caches a
+    # DONATION-FREE twin of the step (a plain jit of the same body): a
+    # deserialized donated executable is unsound inside a full training
+    # process on this jax/jaxlib (scrambled output pytrees, runtime
+    # aborts — utils/exec_cache.py module docstring), and the failure
+    # escapes any same-process probe. Warm loads additionally ride a
+    # first-execution landing check: the cached step's output
+    # ``state.step`` must equal input ``step + delta`` (1 per-step,
+    # num_batches for scan), else the entry is evicted with a
+    # ``donation_check_failed`` miss and the fresh jitted step takes
+    # over on a saved copy of the inputs.
+    # Placed AFTER start_run (the --require-complete validator demands
+    # run_start first) and after the ledger lowered the RAW jitted step.
+    if loop_owned and start_epoch < num_epoch:
+        try:
+            from hydragnn_tpu.utils.exec_cache import (
+                ExecCache,
+                abstract_fingerprint,
+                compat_manifest,
+                fingerprint,
+            )
+
+            _ecache = ExecCache.from_env(flight=flight, consumer="train")
+        except Exception:
+            _ecache = None
+        if _ecache is not None and _ecache.enabled:
+            try:
+                _pc = partitioner.config if partitioner is not None else None
+                _compat = compat_manifest(
+                    layout=(_pc.data, _pc.fsdp, _pc.edge) if _pc is not None else (1, 1, 1),
+                    compute_dtype=compute_dtype,
+                )
+                # resume bookkeeping (auto_resume_config flips
+                # Training.continue/startfrom on a supervisor restart)
+                # selects WHICH checkpoint restores, not what compiles —
+                # it must not change the key or no resume ever hits
+                _cfg_key = dict(config)
+                _tr_parent = _cfg_key
+                if "Training" not in _tr_parent and isinstance(
+                    _cfg_key.get("NeuralNetwork"), dict
+                ):
+                    _nn_key = dict(_cfg_key["NeuralNetwork"])
+                    _cfg_key["NeuralNetwork"] = _nn_key
+                    _tr_parent = _nn_key
+                if isinstance(_tr_parent.get("Training"), dict):
+                    _tr_key = dict(_tr_parent["Training"])
+                    for _vol in ("continue", "startfrom"):
+                        _tr_key.pop(_vol, None)
+                    _tr_parent["Training"] = _tr_key
+                _arch = fingerprint(_cfg_key, abstract_fingerprint(state))
+                _is_scan = scan_fn is not None
+                if _is_scan:
+                    _stacked0 = train_loader.stacked_device_batches(0)
+                    _order0 = jnp.arange(len(train_loader), dtype=jnp.int32)
+                    _cargs = (
+                        (state, _stacked0, _order0, jnp.zeros((), jnp.int32))
+                        if guard_nonfinite
+                        else (state, _stacked0, _order0)
+                    )
+                    _label, _delta, _raw = (
+                        "scan_epoch", int(_order0.shape[0]), scan_fn,
+                    )
+                else:
+                    _example0 = next(iter(train_loader))
+                    _cargs = (
+                        (state, _example0, jnp.zeros((), jnp.int32))
+                        if guard_nonfinite
+                        else (state, _example0)
+                    )
+                    _label, _delta, _raw = "train_step", 1, train_step
+                # the donation-free twin: jit of the same body without
+                # donate_argnums. Costs one extra state-sized buffer
+                # while the cache is enabled; buys executables that
+                # survive the serialize round trip. Donation-ness is
+                # part of the key — the two programs are not the same
+                # executable.
+                _body = getattr(_raw, "__wrapped__", None)
+                _cache_fn = jax.jit(_body) if _body is not None else _raw
+                _donated = _body is None
+                _ckey = fingerprint(
+                    _label, _arch, abstract_fingerprint(_cargs), _donated
+                )
+                # marked AFTER arg construction: the eager jnp.arange
+                # / jnp.zeros scalars above cost one tiny compile each
+                # per process and would pollute the zero-compile number
+                if cmon is not None:
+                    cmon.mark("exec_cache_build")
+                _exe, _hit, _build_s = _ecache.get_or_compile(
+                    _ckey, _cache_fn, _cargs, _compat,
+                    donated=_donated, label=_label,
+                )
+                if _hit:
+                    _exe = _landing_checked(
+                        _exe, _cache_fn, _ecache, _ckey,
+                        expected_delta=_delta, label=_label,
+                    )
+                if _is_scan:
+                    scan_fn = _exe
+                else:
+                    train_step = _exe
+                # the scoped zero-compile evidence the fault-injection
+                # smoke pins: how many XLA compiles the build took (0 on
+                # a warm hit) and how long restart-to-ready cost
+                flight.record(
+                    "exec_cache",
+                    event="train_ready",
+                    hit=_hit,
+                    compiles=(
+                        cmon.count_since("exec_cache_build")
+                        if cmon is not None
+                        else None
+                    ),
+                    build_s=round(_build_s, 3),
+                    mode="scan_epoch" if scan_fn is not None else "per_step",
+                )
+            except Exception as exc:
+                # cache wiring must never take training down: fall back
+                # to the live jitted path and say so in the record
+                flight.record(
+                    "exec_cache", event="wiring_failed",
+                    error=str(exc)[-200:],
+                )
 
     # Visualization (reference: Visualizer wiring, train_validate_test.py:
     # 71-97,90-96: initial-solution scatter, per-epoch histograms, final

@@ -1,0 +1,494 @@
+"""Persistent AOT executable cache (hydragnn_tpu/utils/exec_cache.py):
+round-trip equivalence (a served bucket ladder and a donation-guarded
+train step both bit-match their fresh compiles), corruption/truncated-
+sidecar eviction, version-skew vs layout-changed classification, LRU
+eviction order, two-process concurrent-writer atomicity, the donation
+gate (pass + injected failure -> evict-and-recompile), and the train
+loop's first-execution landing check. All CPU (conftest pins the
+8-device virtual mesh); models are smoke-sized."""
+
+import json
+import os
+import pickle
+import subprocess
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hydragnn_tpu.utils.exec_cache import (
+    ExecCache,
+    MISS_REASONS,
+    _serialize_mod,
+    abstract_fingerprint,
+    compat_manifest,
+    donation_roundtrip_ok,
+    fingerprint,
+)
+
+pytestmark = pytest.mark.skipif(
+    _serialize_mod() is None,
+    reason="this jax cannot serialize executables (cache is inert)",
+)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _f():
+    return jax.jit(lambda x: x * 2.0 + 1.0)
+
+
+def _x():
+    return jnp.arange(8.0, dtype=jnp.float32)
+
+
+def _compile_into(cache, key=None, compat=None):
+    f, x = _f(), _x()
+    key = key or fingerprint("t", abstract_fingerprint((x,)))
+    compat = compat or compat_manifest()
+    exe, hit, _ = cache.get_or_compile(key, f, (x,), compat)
+    return key, compat, exe
+
+
+# ---------------------------------------------------------------------------
+# core round trip + miss accounting
+# ---------------------------------------------------------------------------
+
+
+def test_roundtrip_hit_bitmatches_fresh_compile(tmp_path):
+    cache = ExecCache(str(tmp_path))
+    f, x = _f(), _x()
+    key = fingerprint("t", abstract_fingerprint((x,)))
+    compat = compat_manifest()
+    exe, hit, _ = cache.get_or_compile(key, f, (x,), compat)
+    assert not hit and cache.stats["miss_reasons"] == {"absent": 1}
+    exe2, hit2, _ = cache.get_or_compile(key, f, (x,), compat)
+    assert hit2 and cache.stats["hits"] == 1
+    np.testing.assert_array_equal(np.asarray(exe(x)), np.asarray(exe2(x)))
+
+
+def test_disabled_cache_is_inert(tmp_path):
+    cache = ExecCache(None)
+    assert not cache.enabled
+    assert cache.load("deadbeef", compat_manifest()) is None
+    assert not cache.store("deadbeef", object(), compat_manifest())
+    # and no stats were recorded: no dir means no interaction happened
+    assert cache.stats["misses"] == 0
+
+
+# ---------------------------------------------------------------------------
+# corruption -> single-entry eviction, never a crash
+# ---------------------------------------------------------------------------
+
+
+def test_corrupt_payload_evicts_single_entry(tmp_path):
+    cache = ExecCache(str(tmp_path))
+    key, compat, _ = _compile_into(cache)
+    path = cache._path(key)
+    with open(path, "r+b") as f:
+        f.seek(20)
+        f.write(b"\xff\xff\xff\xff")
+    assert cache.load(key, compat) is None
+    assert cache.stats["miss_reasons"]["corrupt"] == 1
+    assert not os.path.exists(path) and not os.path.exists(path + ".sha256")
+
+
+def test_truncated_sidecar_evicts(tmp_path):
+    cache = ExecCache(str(tmp_path))
+    key, compat, _ = _compile_into(cache)
+    path = cache._path(key)
+    with open(path + ".sha256", "w") as f:
+        f.write("abc123")  # truncated/garbage digest
+    assert cache.load(key, compat) is None
+    assert cache.stats["miss_reasons"]["corrupt"] == 1
+    assert not os.path.exists(path)
+
+
+def test_unpicklable_entry_evicts(tmp_path):
+    cache = ExecCache(str(tmp_path))
+    key, compat, _ = _compile_into(cache)
+    path = cache._path(key)
+    data = b"not a pickle at all"
+    with open(path, "wb") as f:
+        f.write(data)
+    import hashlib
+
+    with open(path + ".sha256", "w") as f:
+        f.write(hashlib.sha256(data).hexdigest())  # digest VALID, pickle not
+    assert cache.load(key, compat) is None
+    assert cache.stats["miss_reasons"]["corrupt"] == 1
+    assert not os.path.exists(path)
+
+
+# ---------------------------------------------------------------------------
+# compat classification: loud, and NOT an eviction
+# ---------------------------------------------------------------------------
+
+
+def test_version_skew_classified_without_eviction(tmp_path):
+    cache = ExecCache(str(tmp_path))
+    key, compat, _ = _compile_into(cache)
+    want = dict(compat, jax="0.0.0-other")
+    assert cache.load(key, want) is None
+    assert cache.stats["miss_reasons"] == {"absent": 1, "version_skew": 1}
+    # the entry is valid for the environment that wrote it: still there
+    assert os.path.exists(cache._path(key))
+
+
+def test_layout_change_classified_over_version_skew(tmp_path):
+    cache = ExecCache(str(tmp_path))
+    key, compat, _ = _compile_into(cache)
+    want = dict(compat, layout=(1, 4, 2), jax="0.0.0-other")
+    assert cache.load(key, want) is None
+    # layout wins the classification even when versions ALSO differ —
+    # resharding is the operator-actionable cause
+    assert cache.stats["miss_reasons"]["layout_changed"] == 1
+    assert os.path.exists(cache._path(key))
+
+
+def test_compute_dtype_is_part_of_compat(tmp_path):
+    cache = ExecCache(str(tmp_path))
+    key, compat, _ = _compile_into(
+        cache, compat=compat_manifest(compute_dtype=jnp.bfloat16)
+    )
+    assert cache.load(key, compat_manifest()) is None  # f32 vs bf16
+    assert cache.stats["miss_reasons"]["version_skew"] == 1
+
+
+# ---------------------------------------------------------------------------
+# LRU bound
+# ---------------------------------------------------------------------------
+
+
+def test_lru_evicts_oldest_first(tmp_path):
+    cache = ExecCache(str(tmp_path), max_bytes=1 << 60)
+    f = _f()
+    compat = compat_manifest()
+    keys = []
+    for n in (8, 16, 24):
+        x = jnp.arange(float(n), dtype=jnp.float32)
+        key = fingerprint("lru", n)
+        cache.get_or_compile(key, f, (x,), compat)
+        keys.append(key)
+    # age the first entry far into the past, then shrink the bound so
+    # only ~2 entries fit and re-run enforcement via a fresh store
+    old = time.time() - 10_000
+    os.utime(cache._path(keys[0]), (old, old))
+    sizes = [
+        os.path.getsize(cache._path(k))
+        + os.path.getsize(cache._path(k) + ".sha256")
+        for k in keys
+    ]
+    cache.max_bytes = sizes[1] + sizes[2] + 1
+    cache._enforce_lru()
+    assert not os.path.exists(cache._path(keys[0]))  # oldest gone
+    assert os.path.exists(cache._path(keys[1]))
+    assert os.path.exists(cache._path(keys[2]))
+    assert cache.stats["evictions"] == 1
+
+
+def test_lru_touches_on_hit(tmp_path):
+    cache = ExecCache(str(tmp_path))
+    key, compat, _ = _compile_into(cache)
+    old = time.time() - 10_000
+    os.utime(cache._path(key), (old, old))
+    assert cache.load(key, compat) is not None
+    assert os.path.getmtime(cache._path(key)) > old + 5_000
+
+
+# ---------------------------------------------------------------------------
+# donation gate
+# ---------------------------------------------------------------------------
+
+
+def test_donation_probe_passes_and_persists(tmp_path):
+    assert donation_roundtrip_ok(str(tmp_path))
+    verdict = json.load(open(tmp_path / "donation_probe.json"))
+    assert all(v is True for v in verdict.values())
+
+
+def test_injected_donation_failure_evicts_and_recompiles(tmp_path, monkeypatch):
+    cache = ExecCache(str(tmp_path))
+    f, x = _f(), _x()
+    key = fingerprint("don", abstract_fingerprint((x,)))
+    compat = compat_manifest()
+    exe, hit, _ = cache.get_or_compile(key, f, (x,), compat, donated=True)
+    assert not hit and os.path.exists(cache._path(key))
+    monkeypatch.setenv("HYDRAGNN_INJECT_DONATION_CHECK_FAIL", "1")
+    # the warm load must now EVICT the entry and fall through to a live
+    # compile — the forced-failure driver for the jax<0.5 staleness story
+    exe2, hit2, _ = cache.get_or_compile(key, f, (x,), compat, donated=True)
+    assert not hit2
+    assert cache.stats["miss_reasons"]["donation_check_failed"] == 1
+    # and the failing gate also blocks RE-storing the donated executable
+    assert not os.path.exists(cache._path(key))
+    np.testing.assert_array_equal(np.asarray(exe(x)), np.asarray(exe2(x)))
+
+
+def test_undonated_load_ignores_donation_gate(tmp_path, monkeypatch):
+    cache = ExecCache(str(tmp_path))
+    key, compat, _ = _compile_into(cache)
+    monkeypatch.setenv("HYDRAGNN_INJECT_DONATION_CHECK_FAIL", "1")
+    # serving forwards are donation-free: the gate must not touch them
+    assert cache.load(key, compat) is not None
+
+
+# ---------------------------------------------------------------------------
+# concurrent writers (two processes, same key, same dir)
+# ---------------------------------------------------------------------------
+
+_WRITER = r"""
+import sys
+sys.path.insert(0, {repo!r})
+from __graft_entry__ import _load_platform_module
+_load_platform_module().pin_virtual_cpu_mesh(1)
+import jax, jax.numpy as jnp
+from hydragnn_tpu.utils.exec_cache import ExecCache, compat_manifest
+
+cache = ExecCache(sys.argv[1])
+f = jax.jit(lambda x: x * 2.0 + 1.0)
+compiled = f.lower(jnp.arange(8.0, dtype=jnp.float32)).compile()
+for _ in range(8):
+    assert cache.store("cafef00d", compiled, compat_manifest())
+print("WRITER-DONE")
+"""
+
+
+def test_concurrent_writers_leave_valid_entry(tmp_path):
+    script = tmp_path / "writer.py"
+    script.write_text(_WRITER.format(repo=_REPO))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(tmp_path)],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for _ in range(2)
+    ]
+    for p in procs:
+        out, _ = p.communicate(timeout=240)
+        assert p.returncode == 0 and "WRITER-DONE" in out, out[-2000:]
+    # whatever interleaving happened, the published entry is COMPLETE:
+    # digest sidecar matches and the payload unpickles + deserializes
+    cache = ExecCache(str(tmp_path))
+    assert cache.load("cafef00d", compat_manifest()) is not None
+    assert not [n for n in os.listdir(tmp_path) if n.endswith(".tmp")]
+
+
+# ---------------------------------------------------------------------------
+# round-trip equivalence on the real consumers
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_flagship():
+    from hydragnn_tpu.flagship import build_flagship
+    from hydragnn_tpu.train import create_train_state, select_optimizer
+
+    config, model, variables, loader = build_flagship(
+        n_samples=24,
+        hidden_dim=8,
+        num_conv_layers=2,
+        batch_size=4,
+        unit_cells=(2, 3),
+    )
+    tx = select_optimizer(config["NeuralNetwork"]["Training"])
+    state = create_train_state(variables, tx)
+    return config, model, variables, loader, tx, state
+
+
+def _copy(tree):
+    return jax.tree_util.tree_map(lambda x: x.copy(), tree)
+
+
+def _assert_trees_bitmatch(a, b):
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_guarded_train_step_roundtrip_bitmatch(tmp_path, tiny_flagship):
+    """The donation-guarded train step through the cache computes the
+    BIT-identical update the fresh compile computes — the staleness
+    failure mode the donation gate guards against would show here."""
+    from hydragnn_tpu.train import make_train_step
+
+    _, model, _, loader, tx, state = tiny_flagship
+    step = make_train_step(model, tx, guard_nonfinite=True)
+    batch = next(iter(loader))
+    consec = jnp.zeros((), jnp.int32)
+    cache = ExecCache(str(tmp_path))
+    key = fingerprint("step", abstract_fingerprint((state, batch, consec)))
+    compat = compat_manifest()
+    fresh, hit, _ = cache.get_or_compile(
+        key, step, (state, batch, consec), compat, donated=True
+    )
+    assert not hit
+    cached, hit2, _ = cache.get_or_compile(
+        key, step, (state, batch, consec), compat, donated=True
+    )
+    assert hit2
+    out_fresh = fresh(_copy(state), batch, consec)
+    out_cached = cached(_copy(state), batch, consec)
+    _assert_trees_bitmatch(out_fresh, out_cached)
+    # the cached step LANDS: optimizer step advanced by exactly one
+    assert int(jax.device_get(out_cached[0].step)) == int(
+        jax.device_get(state.step)
+    ) + 1
+
+
+def test_served_ladder_warm_start_zero_compiles_and_bitmatch(tmp_path, tiny_flagship):
+    """Second server against the same cache dir: 0 warmup compiles,
+    every bucket a disk hit, and predictions bit-match the cold
+    server's — the second-replica acceptance criterion."""
+    from hydragnn_tpu.serve import ModelRegistry, ModelServer, ServeConfig
+
+    _, model, variables, loader, _, _ = tiny_flagship
+    samples = list(loader.all_samples)[:6]
+    registry = ModelRegistry()
+
+    def start_and_predict(tag):
+        served = registry.register(f"exec_cache_{tag}", model, variables)
+        server = ModelServer(
+            served,
+            samples,
+            ServeConfig(
+                max_batch=4,
+                num_buckets=2,
+                exec_cache_dir=str(tmp_path),
+            ),
+        )
+        server.start()
+        preds = [server.predict(s, timeout=60) for s in samples]
+        snap = server.metrics_snapshot()
+        n_buckets = len(server.buckets)
+        server.stop()
+        return preds, snap, n_buckets
+
+    cold_preds, cold_snap, n_buckets = start_and_predict("cold")
+    assert cold_snap["compile_warmup"] == n_buckets
+    assert cold_snap["exec_cache_misses"] == n_buckets
+    warm_preds, warm_snap, _ = start_and_predict("warm")
+    assert warm_snap["compile_warmup"] == 0
+    assert warm_snap["compile_misses"] == 0
+    assert warm_snap["exec_cache_hits"] == n_buckets
+    for c, w in zip(cold_preds, warm_preds):
+        assert sorted(c) == sorted(w)
+        for k in c:
+            np.testing.assert_array_equal(np.asarray(c[k]), np.asarray(w[k]))
+
+
+# ---------------------------------------------------------------------------
+# the train loop's first-execution landing check
+# ---------------------------------------------------------------------------
+
+
+def test_landing_check_passes_through_good_executable(tmp_path):
+    from types import SimpleNamespace
+
+    from hydragnn_tpu.train.loop import _landing_checked
+
+    cache = ExecCache(str(tmp_path))
+    calls = []
+
+    def good(state, batch):
+        calls.append("cached")
+        return (SimpleNamespace(step=state.step + 1), 0.5)
+
+    wrapped = _landing_checked(good, None, cache, "k", 1, "train_step")
+    out = wrapped(SimpleNamespace(step=np.int32(7)), "b")
+    assert int(out[0].step) == 8 and calls == ["cached"]
+    wrapped(SimpleNamespace(step=np.int32(8)), "b")
+    assert calls == ["cached", "cached"]
+    assert cache.stats["misses"] == 0
+
+
+def test_landing_check_evicts_and_falls_back_on_stale_step(tmp_path):
+    """A cached executable whose update never lands (output step ==
+    input step: dropped donation metadata) must be evicted with
+    ``donation_check_failed`` and replaced by the fresh step, which
+    replays on the saved pre-execution copy."""
+    from types import SimpleNamespace
+
+    from hydragnn_tpu.train.loop import _landing_checked
+
+    cache = ExecCache(str(tmp_path))
+    key, compat, _ = _compile_into(cache, key="stalekey")
+    assert os.path.exists(cache._path("stalekey"))
+
+    def stale(state, batch):
+        return (SimpleNamespace(step=state.step), 0.5)  # never lands
+
+    fresh_calls = []
+
+    def fresh(state, batch):
+        fresh_calls.append(int(state.step))
+        return (SimpleNamespace(step=state.step + 1), 0.5)
+
+    wrapped = _landing_checked(stale, fresh, cache, "stalekey", 1, "train_step")
+    out = wrapped(SimpleNamespace(step=np.int32(3)), "b")
+    assert int(out[0].step) == 4  # the fresh replay's answer
+    assert fresh_calls == [3]  # replayed on the saved copy
+    assert cache.stats["miss_reasons"]["donation_check_failed"] == 1
+    assert not os.path.exists(cache._path("stalekey"))  # evicted
+    # permanently switched: later calls go straight to fresh
+    wrapped(SimpleNamespace(step=np.int32(4)), "b")
+    assert fresh_calls == [3, 4]
+
+
+# ---------------------------------------------------------------------------
+# observability plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_flight_events_validate(tmp_path):
+    from hydragnn_tpu.obs.flight import FlightRecorder, validate_flight_record
+
+    fpath = tmp_path / "flight.jsonl"
+    flight = FlightRecorder(str(fpath))
+    cache = ExecCache(str(tmp_path / "cache"), flight=flight, consumer="test")
+    key, compat, _ = _compile_into(cache)
+    cache.load(key, dict(compat, jax="other"))  # version_skew miss
+    flight.close()
+    assert validate_flight_record(str(fpath)) == []
+    kinds = [
+        (e["kind"], e.get("event"))
+        for e in map(json.loads, open(fpath))
+    ]
+    assert ("exec_cache", "miss") in kinds and ("exec_cache", "store") in kinds
+
+
+def test_serve_metrics_counters(tmp_path):
+    from hydragnn_tpu.serve.metrics import ServeMetrics
+
+    m = ServeMetrics(num_buckets=1)
+    cache = ExecCache(str(tmp_path), metrics=m, consumer="serve")
+    key, compat, _ = _compile_into(cache)  # absent miss, then store
+    cache.load(key, compat)  # hit
+    cache.load(key, dict(compat, jax="other"))  # version_skew miss
+    snap = m.snapshot()
+    assert snap["exec_cache_hits"] == 1
+    assert snap["exec_cache_misses"] == 2
+    assert snap["exec_cache_miss_reasons"] == {"absent": 1, "version_skew": 1}
+    assert cache.manifest()["enabled"] is True
+
+
+def test_miss_reasons_are_the_documented_set(tmp_path):
+    # docs/PERF.md documents this table; a new reason must be added
+    # there (and to obs_report's rendering) deliberately
+    assert set(MISS_REASONS) == {
+        "absent",
+        "corrupt",
+        "version_skew",
+        "layout_changed",
+        "donation_check_failed",
+        "unavailable",
+    }

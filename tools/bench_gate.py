@@ -26,6 +26,16 @@ Protocol:
     machines self-baseline on first run. ``--update-baseline`` forces a
     rewrite (use after an intentional perf change, and commit it).
 
+Warm-start arm (``--warm-start-arm``, run as its own invocation): the
+persistent-executable-cache gate (utils/exec_cache.py). Builds the same
+fixed tiny step twice against a fresh cache dir — the first build pays
+lower+compile+store (cold), the second must come back as a disk
+deserialize (warm) — and FAILS unless the warm build is a hit, paid
+zero XLA compiles, and took under 50% of the cold build. Self-contained
+ratio: no committed baseline, so it gates identically on any machine.
+Refuses to run with any ``HYDRAGNN_INJECT_*`` set (an injected
+donation-gate failure would turn the expected hit into a miss).
+
 Self-test hooks: ``--inject-slowdown-ms F`` sleeps F ms inside the
 timed loop after every step — a genuine measured slowdown, not a
 doctored number — so ci.sh can assert the gate demonstrably fails on a
@@ -148,6 +158,103 @@ def _measure(inject_ms: float, steps: int, inject_traffic_mb: float = 0.0) -> di
     return out
 
 
+def _warm_start_arm() -> int:
+    """Cold vs warm executable build through the persistent exec cache
+    (module docstring). Returns the process exit code."""
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from hydragnn_tpu.flagship import build_flagship
+    from hydragnn_tpu.obs import CompileMonitor
+    from hydragnn_tpu.train import (
+        create_train_state,
+        make_train_step,
+        select_optimizer,
+    )
+    from hydragnn_tpu.utils.exec_cache import (
+        ExecCache,
+        abstract_fingerprint,
+        compat_manifest,
+        fingerprint,
+    )
+
+    injected = sorted(
+        k for k in os.environ if k.startswith("HYDRAGNN_INJECT_")
+    )
+    if injected:
+        print(
+            f"bench gate warm-start arm: refusing to gate with {injected} "
+            "set (injected faults would fail the cache on purpose)"
+        )
+        return 1
+
+    config, model, variables, loader = build_flagship(
+        n_samples=80,
+        hidden_dim=16,
+        num_conv_layers=2,
+        batch_size=16,
+        unit_cells=(2, 3),
+    )
+    tx = select_optimizer(config["NeuralNetwork"]["Training"])
+    state = create_train_state(variables, tx)
+    # the donation-free twin, matching what train/loop.py caches — a
+    # deserialized DONATED executable is unsound (utils/exec_cache.py)
+    step = make_train_step(model, tx)
+    body = getattr(step, "__wrapped__", None)
+    if body is not None:
+        step = jax.jit(body)
+    batch = next(iter(loader))
+
+    cache = ExecCache(
+        tempfile.mkdtemp(prefix="bench_gate_exec_cache_"),
+        consumer="bench_gate",
+    )
+    key = fingerprint(
+        "bench_gate_step", abstract_fingerprint((state, batch))
+    )
+    compat = compat_manifest()
+    cmon = CompileMonitor().start()
+    exe, hit_cold, cold_s = cache.get_or_compile(
+        key, step, (state, batch), compat, donated=body is None, label="gate_cold"
+    )
+    cmon.mark("warm")
+    exe2, hit_warm, warm_s = cache.get_or_compile(
+        key, step, (state, batch), compat, donated=body is None, label="gate_warm"
+    )
+    warm_compiles = cmon.count_since("warm")
+    cmon.stop()
+    # both executables must actually run (the warm one on a copy: the
+    # step donates its state argument)
+    st = jax.tree_util.tree_map(lambda x: x.copy(), state)
+    _, loss, _ = exe2(st, batch)
+    np.asarray(loss)
+
+    ratio = warm_s / max(cold_s, 1e-9)
+    print(
+        f"bench gate warm-start arm: cold build {cold_s:.3f}s -> warm "
+        f"build {warm_s:.3f}s (ratio {ratio:.3f}, warm compiles "
+        f"{warm_compiles}, hit {hit_warm})"
+    )
+    failures = []
+    if hit_cold:
+        failures.append("cold build unexpectedly HIT a fresh cache dir")
+    if not hit_warm:
+        reasons = cache.stats["miss_reasons"]
+        failures.append(f"warm build MISSED the cache ({reasons})")
+    if warm_compiles:
+        failures.append(f"warm build paid {warm_compiles} XLA compiles")
+    if ratio >= 0.5:
+        failures.append(
+            f"warm build took {ratio:.0%} of cold — the cache saved "
+            "nothing (gate: < 50%)"
+        )
+    for msg in failures:
+        print(f"bench gate warm-start FAIL: {msg}")
+    return 2 if failures else 0
+
+
 def main() -> int:
     here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     ap = argparse.ArgumentParser(description=__doc__)
@@ -175,7 +282,16 @@ def main() -> int:
         help="self-test: add a real compiled executable's cost-model "
         "bytes over an array of this many MiB to the step's bytes",
     )
+    ap.add_argument(
+        "--warm-start-arm",
+        action="store_true",
+        help="run ONLY the persistent-exec-cache cold/warm gate "
+        "(self-contained ratio; no committed baseline)",
+    )
     args = ap.parse_args()
+
+    if args.warm_start_arm:
+        return _warm_start_arm()
 
     cur = _measure(args.inject_slowdown_ms, args.steps, args.inject_traffic_mb)
     key = f"{cur['backend']}:{cur['device_kind']}"

@@ -60,6 +60,43 @@ def _first(events: List[dict], kind: str) -> Optional[dict]:
     return None
 
 
+def _exec_cache_summary(events: List[dict]) -> Optional[str]:
+    """One line over the run's ``exec_cache`` events (persistent AOT
+    executable cache, hydragnn_tpu/utils/exec_cache.py): hit / miss /
+    store / evict counts with the miss-reason breakdown. None when the
+    record has no cache traffic (cache disabled or pre-r09 record)."""
+    counts: Dict[str, int] = {}
+    reasons: Dict[str, int] = {}
+    for e in events:
+        if e.get("kind") != "exec_cache":
+            continue
+        ev = str(e.get("event"))
+        counts[ev] = counts.get(ev, 0) + 1
+        if ev in ("miss", "evict"):
+            r = str(e.get("reason") or "absent")
+            reasons[r] = reasons.get(r, 0) + 1
+    if not counts:
+        return None
+    parts = [f"{counts.get(k, 0)} {k}" for k in ("hit", "miss", "store", "evict")]
+    line = " / ".join(parts)
+    if reasons:
+        line += " (" + ", ".join(
+            f"{k}={v}" for k, v in sorted(reasons.items())
+        ) + ")"
+    ready = [
+        e
+        for e in events
+        if e.get("kind") == "exec_cache" and e.get("event") == "train_ready"
+    ]
+    if ready:
+        r = ready[-1]
+        line += (
+            f"; train_ready hit={r.get('hit')} compiles={r.get('compiles')} "
+            f"build_s={r.get('build_s')} mode={r.get('mode')}"
+        )
+    return line
+
+
 def render_report(events: List[dict]) -> str:
     """One run's story as text: manifest, epoch table, incidents,
     summary."""
@@ -114,6 +151,10 @@ def render_report(events: List[dict]) -> str:
                 f"{_fmt(st.get('device_wait_ms_mean', '-'), 4):>10} "
                 f"{comp.get('count', '-'):>8}{flag}"
             )
+    ecache = _exec_cache_summary(events)
+    if ecache:
+        lines.append("== exec cache ==")
+        lines.append(f"  {ecache}")
     incidents = [
         e for e in events if e.get("kind") in ("retry", "error", "_unparseable")
     ]
@@ -496,6 +537,9 @@ def main(argv=None) -> int:
                     )
 
                     print(f"  parallel: {parallel_manifest_summary(par)}")
+                ecache = _exec_cache_summary(events)
+                if ecache:
+                    print(f"  exec_cache: {ecache}")
             _print_warnings(events)
         else:
             if len(args.records) > 1:

@@ -3,8 +3,18 @@ HYDRAGNN_BCAST_CE — the gather kernel's chunk reads only the latter)
 on the flagship step, traced device time per setting (subprocess per
 setting — the constants bake at import).
 
-Usage: python tools/tune_tiles.py [BNxCE[xBCE] ...]
-(BCE defaults to the package default when omitted)"""
+Usage: python tools/tune_tiles.py [--save] [BNxCE[xBCE] ...]
+(BCE defaults to the package default when omitted)
+
+``--save`` persists the sweep's best setting (minimum traced device
+ms) into the committed ``TUNE_TILES.json`` at the repo root, keyed
+``(shape_tag, device_kind)`` — shape_tag is ``TUNE_CONFIG`` (default
+"flagship"), device_kind is what the child measured on.
+``hydragnn_tpu/ops/segment_pallas.py`` (and through it
+``ops/fused_conv.py``, which imports BN/CE from there) reads its
+import-time tile defaults from that table via ``HYDRAGNN_TILE_SHAPE``
+/ ``HYDRAGNN_DEVICE_KIND``; the explicit HYDRAGNN_BN/CE/BCAST_CE env
+knobs always win. Commit the updated JSON."""
 
 import json
 import os
@@ -60,7 +70,8 @@ for r in tab["rows"]:
     tot += t
     if (r["c"][i_c] or {}).get("v") == "custom-call":
         pall += t
-print(f"RESULT device={tot/3e3:.2f} pallas={pall/3e3:.2f} loss={float(loss):.5f}")
+kind = getattr(jax.devices()[0], "device_kind", "unknown").replace(" ", "_")
+print(f"RESULT device={tot/3e3:.2f} pallas={pall/3e3:.2f} loss={float(loss):.5f} kind={kind}")
 """
 
 
@@ -76,11 +87,54 @@ def run(bn, ce, bce=None):
     for line in out.stdout.splitlines():
         if line.startswith("RESULT"):
             print(f"{tag}: {line[7:]}", flush=True)
-            return
+            try:
+                fields = dict(p.split("=", 1) for p in line[7:].split())
+                return {
+                    "BN": bn,
+                    "CE": ce,
+                    "BCAST_CE": bce,
+                    "device_ms": float(fields["device"]),
+                    "kind": fields.get("kind", "unknown"),
+                }
+            except (KeyError, ValueError):
+                return None
     print(f"{tag}: FAILED\n{out.stderr[-500:]}", flush=True)
+    return None
+
+
+def save_best(results) -> None:
+    """Merge the sweep's best (min traced device ms) setting into the
+    committed TUNE_TILES.json under (shape_tag, device_kind)."""
+    best = min(results, key=lambda r: r["device_ms"])
+    shape_tag = os.environ.get("TUNE_CONFIG") or "flagship"
+    path = os.path.join(HERE, "TUNE_TILES.json")
+    table = {}
+    if os.path.exists(path):
+        with open(path) as f:
+            table = json.load(f)
+    entry = {
+        "BN": best["BN"],
+        "CE": best["CE"],
+        "device_ms": best["device_ms"],
+    }
+    if best["BCAST_CE"] is not None:
+        entry["BCAST_CE"] = best["BCAST_CE"]
+    table.setdefault(shape_tag, {})[best["kind"]] = entry
+    with open(path, "w") as f:
+        json.dump(table, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(
+        f"saved best setting BN={best['BN']} CE={best['CE']} "
+        f"BCE={best['BCAST_CE']} ({best['device_ms']} ms) -> {path} "
+        f"[{shape_tag}:{best['kind']}] — commit it; consumers select it "
+        f"via HYDRAGNN_TILE_SHAPE={shape_tag} "
+        f"HYDRAGNN_DEVICE_KIND={best['kind']}"
+    )
 
 
 if __name__ == "__main__":
+    argv = [a for a in sys.argv[1:] if a != "--save"]
+    save = len(argv) != len(sys.argv) - 1
     # r05-measured gather-chunk sweep included: 512/1024/2048 traced
     # 77.8 / 75.9 / 79.7 ms on the flagship (docs/PERF.md)
     settings = [
@@ -90,10 +144,14 @@ if __name__ == "__main__":
         (128, 512, 2048),
         (128, 1024, None),
     ]
-    if len(sys.argv) > 1:
+    if argv:
         settings = []
-        for s in sys.argv[1:]:
+        for s in argv:
             parts = list(map(int, s.split("x")))
             settings.append(tuple(parts) if len(parts) == 3 else (*parts, None))
-    for bn, ce, bce in settings:
-        run(bn, ce, bce)
+    results = [r for r in (run(bn, ce, bce) for bn, ce, bce in settings) if r]
+    if save:
+        if not results:
+            print("no successful settings — nothing to save")
+            sys.exit(1)
+        save_best(results)
