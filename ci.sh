@@ -9,12 +9,23 @@
 #                         baked TPU image ships no formatter, so the gate
 #                         degrades to a full-tree syntax check (compileall)
 #                         and prints which gate ran.
-#   2. chip hygiene     — tools/chip_hygiene.py reports processes holding
+#   2. graftlint        — tools/graftlint.py (docs/LINT.md): the AST
+#                         invariant linter over the whole tree (HG001
+#                         host-sync-in-hot-path ... HG008 tracer-leak)
+#                         with an empty committed baseline, JSON findings
+#                         artifact on failure, flight-artifact schema
+#                         validation (--artifacts over BENCH_*.jsonl),
+#                         and a self-test that injects one violation per
+#                         guarded rule (HG001/HG002/HG005/HG006 —
+#                         including the aliased `from jax.sharding
+#                         import Mesh as M` case the old grep missed)
+#                         and requires the linter to fail on each.
+#   3. chip hygiene     — tools/chip_hygiene.py reports processes holding
 #                         accelerator devices/lockfiles (informational:
 #                         a lingering holder from a dead run is the
 #                         transient-init failure class bench.py retries
 #                         through; VERDICT r05 next-round #1).
-#   3. serial suite     — python -m pytest tests/ -q on the virtual
+#   4. serial suite     — python -m pytest tests/ -q on the virtual
 #                         8-device CPU mesh (conftest pins it). This
 #                         INCLUDES the 2-OS-process distributed pass: the
 #                         reference re-runs its whole suite under
@@ -23,8 +34,8 @@
 #                         spawns 2 python processes with a shared
 #                         coordinator itself (TPU-native launch shape —
 #                         jax.distributed, not MPI).
-#   4. partitioner      — unified-Partitioner gate (docs/PARALLELISM.md):
-#      smoke               (a) grep gate — no module outside
+#   5. partitioner      — unified-Partitioner gate (docs/PARALLELISM.md):
+#      smoke               (a) graftlint rule HG002 — no module outside
 #                         hydragnn_tpu/parallel/ may construct a
 #                         jax.sharding.Mesh directly (train/serve/bench
 #                         obtain meshes exclusively through Partitioner);
@@ -34,14 +45,14 @@
 #                         sharded param/opt leaves and a per-device byte
 #                         drop, and the loss history must equal the
 #                         fsdp=1 data-parallel run's.
-#   5. telemetry smoke  — one tiny training through api.run_training,
+#   6. telemetry smoke  — one tiny training through api.run_training,
 #                         then the emitted flight record is schema-
 #                         validated (tools/obs_report.py --validate
 #                         --require-complete) and pretty-printed: the
 #                         committed proof that a default run leaves a
 #                         parseable evidence artifact
 #                         (docs/OBSERVABILITY.md).
-#   6. fault-injection  — a tiny run is SIGTERM-killed mid-epoch via
+#   7. fault-injection  — a tiny run is SIGTERM-killed mid-epoch via
 #      smoke               HYDRAGNN_INJECT_SIGTERM_STEP, the restart
 #                         supervisor (tools/supervise.py) resumes it to
 #                         completion, and the merged flight record must
@@ -51,7 +62,7 @@
 #                         (HYDRAGNN_EXEC_CACHE survives the restart), so
 #                         the resumed segment must reach first-step-ready
 #                         as a cache HIT with 0 new compiles.
-#   7. serve-chaos      — a tiny trained run is served; a poison request
+#   8. serve-chaos      — a tiny trained run is served; a poison request
 #      smoke               is injected (raise-in-forward), then the
 #                         checkpoint is HOT-reloaded into the running
 #                         server; the server must answer identically
@@ -60,14 +71,14 @@
 #                         tools/serve_probe.py must exit 0 on the
 #                         exported Prometheus textfile
 #                         (docs/RESILIENCE.md "Serving resilience").
-#   8. exec-cache smoke — persistent AOT executable cache (docs/PERF.md
+#   9. exec-cache smoke — persistent AOT executable cache (docs/PERF.md
 #                         "r09 cold start"): train a tiny model once,
 #                         start TWO servers (separate processes) against
 #                         one cache dir — the second must perform 0 AOT
 #                         compiles (every bucket a disk hit) — then
 #                         corrupt one entry and require a LOUD
 #                         single-entry eviction + recompile, not a crash.
-#   9. perf gate        — tools/bench_gate.py: a tiny fixed-config bench
+#  10. perf gate        — tools/bench_gate.py: a tiny fixed-config bench
 #                         measured with D2H-fenced segments and compared
 #                         against the committed BENCH_CI_BASELINE.json
 #                         (>15% graphs/sec regression fails; MFU too on
@@ -77,21 +88,21 @@
 #                         cost-model traffic; plus the warm-start arm —
 #                         a warm executable-cache start must cost <50%
 #                         of the cold start and 0 compiles.
-#  10. full matrix      — opt-in (CI_FULL=1): all 7 models x head configs
+#  11. full matrix      — opt-in (CI_FULL=1): all 7 models x head configs
 #                         trained to the reference accuracy thresholds
 #                         (HYDRAGNN_FULL_MATRIX=1, ~15 min).
-#  11. TPU kernel suite — opt-in (CI_TPU=1, needs a real TPU):
+#  12. TPU kernel suite — opt-in (CI_TPU=1, needs a real TPU):
 #                         HYDRAGNN_TPU_TESTS=1 on-chip kernel-vs-XLA
 #                         checks, budgeted under the tunnel's dispatch
 #                         throttle (tests/test_tpu_chip.py).
 #
-# Usage: ./ci.sh            # stages 1-9 (the default CI gate)
+# Usage: ./ci.sh            # stages 1-10 (the default CI gate)
 #        CI_FULL=1 ./ci.sh  # + acceptance matrix
 #        CI_TPU=1  ./ci.sh  # + real-chip kernel suite
 set -euo pipefail
 cd "$(dirname "$0")"
 
-echo "== [1/11] format gate =="
+echo "== [1/12] format gate =="
 if python -m black --version >/dev/null 2>&1; then
     python -m black --check .
 elif command -v black >/dev/null 2>&1; then
@@ -101,23 +112,70 @@ else
     python -m compileall -q hydragnn_tpu tests examples tools bench.py bench_scaling.py bench_serve.py __graft_entry__.py
 fi
 
-echo "== [2/11] chip hygiene report =="
+echo "== [2/12] graftlint (AST invariant linter, docs/LINT.md) =="
+# Full tree, all rules, empty committed baseline. On failure the JSON
+# findings artifact is left at /tmp/graftlint_findings.json for CI to
+# collect.
+python tools/graftlint.py --json /tmp/graftlint_findings.json || {
+    echo "FAIL: graftlint found violations (JSON artifact: /tmp/graftlint_findings.json)"
+    exit 1
+}
+# committed flight artifacts must validate against obs/flight.py's schema
+python tools/graftlint.py --artifacts
+# Self-test: the linter must FAIL on an injected violation of each
+# statically-guarded invariant. HG002's fixture is specifically the
+# aliased import the old grep gate could not see.
+LINT_ST="$(mktemp -d)"
+cat > "$LINT_ST/hg001_hot_sync.py" <<'EOF'
+def make_train_step(model):
+    def step(state, batch):
+        return float(state.loss)
+
+    return step
+EOF
+cat > "$LINT_ST/hg002_aliased_mesh.py" <<'EOF'
+from jax.sharding import Mesh as M
+
+
+def build(devices):
+    return M(devices, ("data",))
+EOF
+cat > "$LINT_ST/hg005_unknown_kind.py" <<'EOF'
+def emit(flight):
+    flight.record("totally_unknown_kind", x=1)
+EOF
+cat > "$LINT_ST/hg006_rogue_knob.py" <<'EOF'
+import os
+
+
+def read():
+    return os.environ.get("HYDRAGNN_NOT_A_KNOB")
+EOF
+for rule in HG001 HG002 HG005 HG006; do
+    fixture="$(ls "$LINT_ST"/$(echo "$rule" | tr '[:upper:]' '[:lower:]')_*.py)"
+    if python tools/graftlint.py --rule "$rule" --strict --no-baseline "$fixture" >/dev/null 2>&1; then
+        echo "FAIL: graftlint self-test — $rule did not flag $fixture"
+        exit 1
+    fi
+done
+echo "graftlint self-test: HG001/HG002/HG005/HG006 each reject their injected violation"
+rm -rf "$LINT_ST"
+
+echo "== [3/12] chip hygiene report =="
 python tools/chip_hygiene.py || true
 
-echo "== [3/11] serial suite (virtual 8-device CPU mesh, incl. 2-process pass) =="
+echo "== [4/12] serial suite (virtual 8-device CPU mesh, incl. 2-process pass) =="
 python -m pytest tests/ -q
 
-echo "== [4/11] partitioner smoke (Mesh( grep gate; fsdp=2 train == fsdp=1, flight parallel block) =="
+echo "== [5/12] partitioner smoke (HG002 mesh gate; fsdp=2 train == fsdp=1, flight parallel block) =="
 # Train, serve, and bench obtain meshes/shardings exclusively through the
 # Partitioner: no module outside hydragnn_tpu/parallel/ may construct a
 # jax.sharding.Mesh directly. tests/ are exempt (they build adversarial
-# meshes on purpose).
-MESH_HITS="$(grep -rn 'Mesh(' --include='*.py' hydragnn_tpu bench.py bench_scaling.py bench_serve.py tools examples __graft_entry__.py | grep -v '^hydragnn_tpu/parallel/' || true)"
-if [ -n "$MESH_HITS" ]; then
-    echo "FAIL: direct Mesh( construction outside hydragnn_tpu/parallel/:"
-    echo "$MESH_HITS"
-    exit 1
-fi
+# meshes on purpose). AST-accurate gate (graftlint HG002, docs/LINT.md):
+# unlike the old `grep -rn 'Mesh('`, it also catches aliased imports
+# (`from jax.sharding import Mesh as M`) and `jax.sharding.Mesh(...)`.
+python tools/graftlint.py --rule HG002 --strict \
+    hydragnn_tpu bench.py bench_scaling.py bench_serve.py tools examples __graft_entry__.py
 PART_DIR="$(mktemp -d)"
 XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
     python - "$PART_DIR" <<'EOF'
@@ -196,7 +254,7 @@ echo "$PART_OUT" | grep -q "parallel: mesh=" || {
     echo "FAIL: --validate did not surface the parallel block"; exit 1; }
 rm -rf "$PART_DIR"
 
-echo "== [5/11] telemetry smoke (tiny 2-head training -> schema-valid v2 flight record with head diagnostics + MFU ledger) =="
+echo "== [6/12] telemetry smoke (tiny 2-head training -> schema-valid v2 flight record with head diagnostics + MFU ledger) =="
 SMOKE_DIR="$(mktemp -d)"
 JAX_PLATFORMS=cpu python - "$SMOKE_DIR" <<'EOF'
 import sys
@@ -256,7 +314,7 @@ print("introspection smoke: OK (v2 record, head diagnostics + MFU ledger present
 EOF
 rm -rf "$SMOKE_DIR"
 
-echo "== [6/11] fault-injection smoke (SIGTERM mid-epoch -> supervisor resume) =="
+echo "== [7/12] fault-injection smoke (SIGTERM mid-epoch -> supervisor resume) =="
 FAULT_DIR="$(mktemp -d)"
 cat > "$FAULT_DIR/child.py" <<'EOF'
 import sys
@@ -324,7 +382,7 @@ print(
 EOF
 rm -rf "$FAULT_DIR"
 
-echo "== [7/11] serve-chaos smoke (poison request -> quarantine; hot reload from the saved checkpoint; health probe) =="
+echo "== [8/12] serve-chaos smoke (poison request -> quarantine; hot reload from the saved checkpoint; health probe) =="
 SERVE_DIR="$(mktemp -d)"
 JAX_PLATFORMS=cpu python - "$SERVE_DIR" <<'EOF'
 import glob
@@ -412,7 +470,7 @@ python tools/obs_report.py --faults "$SERVE_DIR/serve_flight.jsonl"
 python tools/serve_probe.py --prom "$SERVE_DIR/serve.prom" --verbose
 rm -rf "$SERVE_DIR"
 
-echo "== [8/11] exec-cache smoke (train once; two server starts vs one cache dir; corrupt entry -> loud eviction) =="
+echo "== [9/12] exec-cache smoke (train once; two server starts vs one cache dir; corrupt entry -> loud eviction) =="
 EXEC_DIR="$(mktemp -d)"
 cat > "$EXEC_DIR/serve_once.py" <<'EOF'
 import sys
@@ -495,7 +553,7 @@ grep -q "exec_cache: evicted entry" "$EXEC_DIR/corrupt.err" || {
 }
 rm -rf "$EXEC_DIR"
 
-echo "== [9/11] perf gate (tiny fixed-config bench vs committed baseline) =="
+echo "== [10/12] perf gate (tiny fixed-config bench vs committed baseline) =="
 # fails on a >15% graphs/sec regression (and MFU regression on TPU)
 # against BENCH_CI_BASELINE.json, keyed per backend:device so every CI
 # machine gates against its own recorded number (tools/bench_gate.py)
@@ -523,17 +581,17 @@ fi
 JAX_PLATFORMS=cpu python tools/bench_gate.py --warm-start-arm
 
 if [ "${CI_FULL:-0}" = "1" ]; then
-    echo "== [10/11] full acceptance matrix (reference thresholds) =="
+    echo "== [11/12] full acceptance matrix (reference thresholds) =="
     HYDRAGNN_FULL_MATRIX=1 python -m pytest tests/test_train_matrix.py -q
 else
-    echo "== [10/11] full acceptance matrix: skipped (set CI_FULL=1) =="
+    echo "== [11/12] full acceptance matrix: skipped (set CI_FULL=1) =="
 fi
 
 if [ "${CI_TPU:-0}" = "1" ]; then
-    echo "== [11/11] real-chip TPU kernel suite =="
+    echo "== [12/12] real-chip TPU kernel suite =="
     HYDRAGNN_TPU_TESTS=1 python -m pytest tests/test_tpu_chip.py -q
 else
-    echo "== [11/11] real-chip TPU kernel suite: skipped (set CI_TPU=1, needs a TPU) =="
+    echo "== [12/12] real-chip TPU kernel suite: skipped (set CI_TPU=1, needs a TPU) =="
 fi
 
 echo "CI protocol complete."

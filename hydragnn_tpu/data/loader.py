@@ -27,6 +27,7 @@ import numpy as np
 
 from hydragnn_tpu.graph.batch import GraphBatch, batch_graphs
 from hydragnn_tpu.data.dataset import GraphSample, samples_to_graph_dicts
+from hydragnn_tpu.utils import knobs
 
 
 def _round_up(x: int, m: int) -> int:
@@ -174,7 +175,7 @@ class GraphLoader:
         self.scan_reshuffle_every = scan_reshuffle_every
         # an explicit argument wins; HYDRAGNN_NUM_PREFETCH sets the default
         if prefetch is None:
-            raw = os.environ.get("HYDRAGNN_NUM_PREFETCH", "2")
+            raw = knobs.get_str("HYDRAGNN_NUM_PREFETCH", "2")
             try:
                 prefetch = int(raw)
             except ValueError:
@@ -369,7 +370,7 @@ class GraphLoader:
         # targeting, window coverage) on every host batch — meant for
         # debugging external/custom sample producers; off by default
         # because it walks every edge array on the host per batch.
-        if os.environ.get("HYDRAGNN_DEBUG_BATCH", "0") == "1":
+        if knobs.get_bool("HYDRAGNN_DEBUG_BATCH", False):
             batch.check_invariants()
         return batch
 

@@ -357,9 +357,8 @@ def generate_graphdata_from_smilestr(
     x[:, n_types + 5] = num_hs
 
     if atomic_descriptors is not None:
-        assert atomic_descriptors.shape[0] == N, (
-            "atomic descriptor rows must equal atom count"
-        )
+        if atomic_descriptors.shape[0] != N:
+            raise ValueError("atomic descriptor rows must equal atom count")
         x = np.concatenate([x, atomic_descriptors.astype(np.float32)], axis=1)
 
     y = np.atleast_1d(np.asarray(ytarget, dtype=np.float32))

@@ -75,6 +75,18 @@ def _block_windows(
     return np.stack([lo, hi]).astype(np.int32)
 
 
+class BatchInvariantError(AssertionError):
+    """A loader-layout contract was violated (GraphBatch.check_invariants).
+
+    Subclasses AssertionError for caller compatibility, but is raised
+    explicitly so the checks survive ``python -O`` (graftlint HG007)."""
+
+
+def _invariant(cond, message: str) -> None:
+    if not cond:
+        raise BatchInvariantError(message)
+
+
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass(frozen=True)
 class GraphBatch:
@@ -191,8 +203,8 @@ class GraphBatch:
 
     def check_invariants(self) -> None:
         """Validate the loader contracts the model chassis SILENTLY
-        relies on (r03 advisor): raises AssertionError with a named
-        violation. Host-side debug helper — call it on batches built
+        relies on (r03 advisor): raises :class:`BatchInvariantError`
+        (an AssertionError subclass) with a named violation. Host-side debug helper — call it on batches built
         outside :func:`batch_graphs`/:func:`pad_batch` (which maintain
         these by construction); never inside jit.
 
@@ -211,7 +223,9 @@ class GraphBatch:
         send = np_.asarray(self.senders)
         emask = np_.asarray(self.edge_mask)
         nmask = np_.asarray(self.node_mask)
-        assert np_.all(recv[:-1] <= recv[1:]), "receivers not sorted ascending"
+        _invariant(
+            np_.all(recv[:-1] <= recv[1:]), "receivers not sorted ascending"
+        )
         masked_idx = np_.flatnonzero(~emask)
         if masked_idx.size:
             to_real = nmask[recv[masked_idx]]
@@ -220,38 +234,45 @@ class GraphBatch:
                 # SELF-LOOPS (they then cannot corrupt any masked
                 # aggregation, and sender locality is preserved)
                 bad = to_real & (send[masked_idx] != recv[masked_idx])
-                assert not bad.any(), (
+                _invariant(
+                    not bad.any(),
                     "masked edge targets a real node without being a "
-                    "self-loop (run_align contract)"
+                    "self-loop (run_align contract)",
                 )
             else:
-                assert not to_real.any(), (
+                _invariant(
+                    not to_real.any(),
                     "masked edge targets a REAL node (degree shortcut + "
                     "dense map assume padding edges only ever point at "
-                    "padding nodes)"
+                    "padding nodes)",
                 )
         if self.edge_occupancy is not None:
             occ = int(np_.asarray(self.edge_occupancy))
             real_pos = np_.flatnonzero(emask)
-            assert not real_pos.size or int(real_pos.max()) < occ, (
+            _invariant(
+                not real_pos.size or int(real_pos.max()) < occ,
                 "unmasked edge at position >= edge_occupancy (the fused "
-                "kernel skips all chunks past the occupancy bound)"
+                "kernel skips all chunks past the occupancy bound)",
             )
-            assert int(np_.asarray(self.n_real_nodes)) == int(nmask.sum()), (
-                "n_real_nodes != node_mask.sum()"
+            _invariant(
+                int(np_.asarray(self.n_real_nodes)) == int(nmask.sum()),
+                "n_real_nodes != node_mask.sum()",
             )
         if self.sender_perm is not None:
             sp = np_.asarray(self.sender_perm)
-            assert np_.all(send[sp][:-1] <= send[sp][1:]), (
-                "sender_perm does not sort senders"
+            _invariant(
+                np_.all(send[sp][:-1] <= send[sp][1:]),
+                "sender_perm does not sort senders",
             )
         if self.in_degree is not None:
             deg = np_.asarray(self.in_degree)
             real = recv[emask]
             ref = np_.bincount(real, minlength=real.max() + 1 if real.size else 0)
-            assert np_.array_equal(deg[: ref.shape[0]], ref) and not deg[
-                ref.shape[0]:
-            ].any(), "in_degree != bincount(real receivers)"
+            _invariant(
+                np_.array_equal(deg[: ref.shape[0]], ref)
+                and not deg[ref.shape[0]:].any(),
+                "in_degree != bincount(real receivers)",
+            )
         for ids, win, label in (
             (send, self.sender_win, "sender_win"),
             (
@@ -271,8 +292,9 @@ class GraphBatch:
             blk = ids // b_eff
             pos = np_.arange(ids.shape[0])
             lo, hi = w[0][blk], w[1][blk]
-            assert np_.all((pos >= lo) & (pos < hi)), (
-                f"{label} does not cover every position of its id block"
+            _invariant(
+                np_.all((pos >= lo) & (pos < hi)),
+                f"{label} does not cover every position of its id block",
             )
 
 

@@ -1,0 +1,10 @@
+"""graftlint — the repo's AST-based invariant linter.
+
+``core`` holds the framework (Finding/Rule/runner/suppressions/
+baseline), ``rules`` the HG001–HG008 rule set, ``artifacts`` the
+flight-record artifact validator behind ``graftlint --artifacts``.
+docs/LINT.md is the human-facing catalog; ``tools/graftlint.py`` the
+CLI (which loads this package standalone, without importing the
+jax-heavy ``hydragnn_tpu`` root — keep this ``__init__`` free of
+submodule imports so that bootstrap stays cheap and ordering-free).
+"""

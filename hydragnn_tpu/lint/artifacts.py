@@ -1,0 +1,92 @@
+"""``graftlint --artifacts``: schema-validate committed flight records.
+
+The repo commits bench evidence as flight JSONL artifacts
+(``BENCH_FLIGHT.jsonl``, ``BENCH_SERVE_WARM_FLIGHT.jsonl``). Their
+schema lives in ``obs/flight.py`` (``_REQUIRED``), so drift between
+the tables and the checked-in records is exactly the static-vs-runtime
+gap the linter exists to close: this mode runs the real
+``validate_flight_record`` over each artifact and reports problems as
+findings. ``flight.py`` is stdlib-only by design, so it is loaded
+standalone (``importlib``, no package import, no jax init).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+from typing import List, Optional, Sequence
+
+from .core import Finding
+
+#: committed flight artifacts validated by the CI stage, repo-relative
+DEFAULT_ARTIFACTS = (
+    "BENCH_FLIGHT.jsonl",
+    "BENCH_SERVE_WARM_FLIGHT.jsonl",
+)
+
+
+def _load_flight_module(repo_root: str):
+    path = os.path.join(repo_root, "hydragnn_tpu", "obs", "flight.py")
+    spec = importlib.util.spec_from_file_location("_graftlint_flight", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def validate_artifacts(
+    repo_root: str, paths: Optional[Sequence[str]] = None
+) -> List[Finding]:
+    """Validate each artifact; returns findings (empty = all valid).
+
+    ``require_complete`` stays False: serve artifacts legitimately hold
+    several run_start/run_end pairs (cold + warm passes) and no epoch
+    events — every event must still be individually well-formed, and a
+    kind absent from ``_REQUIRED`` has no required-field coverage at
+    all, so unregistered kinds in a committed artifact are reported
+    here too.
+    """
+    flight = _load_flight_module(repo_root)
+    registered = set(flight._REQUIRED) | set(flight.FAULT_KINDS)
+    findings: List[Finding] = []
+    for rel in paths or DEFAULT_ARTIFACTS:
+        path = rel if os.path.isabs(rel) else os.path.join(repo_root, rel)
+        rel_display = rel.replace(os.sep, "/")
+        if not os.path.exists(path):
+            findings.append(
+                Finding(
+                    rule="HGART",
+                    path=rel_display,
+                    line=1,
+                    col=1,
+                    message="flight artifact missing",
+                )
+            )
+            continue
+        for problem in flight.validate_flight_record(path):
+            findings.append(
+                Finding(
+                    rule="HGART",
+                    path=rel_display,
+                    line=1,
+                    col=1,
+                    message=problem,
+                    snippet=problem,
+                )
+            )
+        for i, ev in enumerate(flight.read_flight_record(path)):
+            kind = ev.get("kind")
+            if kind and kind != "_unparseable" and kind not in registered:
+                findings.append(
+                    Finding(
+                        rule="HGART",
+                        path=rel_display,
+                        line=i + 1,
+                        col=1,
+                        message=(
+                            f"event[{i}] kind '{kind}' is not registered "
+                            "in obs/flight.py _REQUIRED/FAULT_KINDS"
+                        ),
+                        snippet=str(kind),
+                    )
+                )
+    return findings

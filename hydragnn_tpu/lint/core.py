@@ -1,0 +1,413 @@
+"""graftlint core: the rule registry, file walker, suppression and
+baseline machinery behind ``tools/graftlint.py``.
+
+The linter is a plain-AST static analyzer (no imports of the code
+under analysis, no jax, no backend init — it must run in milliseconds
+on any container), shipping the repo's hard-won JAX/TPU invariants as
+enforced rules (docs/LINT.md catalogs them; ``rules.py`` implements
+them). Design points:
+
+  - **Findings** carry (rule id, path, line, col, message, severity)
+    and a content fingerprint (rule + path + stripped source line) so
+    baseline entries survive unrelated line-number churn.
+  - **Suppressions** are inline comments — ``# graftlint:
+    disable=HG001`` (comma-separate for several, ``all`` for every
+    rule) on the offending line or the line directly above it. A
+    suppression is an explicit, reviewable decision; docs/LINT.md sets
+    the policy (always append a reason).
+  - **Baseline**: a committed JSON file of grandfathered fingerprints
+    (``tools/graftlint_baseline.json``). The shipped tree is
+    lint-clean, so the committed baseline is EMPTY — the machinery
+    exists so a future rule can land before its last true positive is
+    burned down, without blocking CI.
+  - **--changed mode** lints only files git reports modified — the
+    fast pre-commit loop. Whole-tree aggregate checks (HG006's
+    stale-registry arm) only run on full-tree scans, where the absence
+    of a reference is meaningful.
+
+This module must stay stdlib-only and must not import the rest of
+``hydragnn_tpu`` (``tools/graftlint.py`` loads the ``lint`` package
+standalone, without triggering the package root's jax imports).
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import os
+import re
+import subprocess
+from dataclasses import dataclass
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tuple
+
+SEVERITIES = ("error", "warning")
+
+#: default scan roots, relative to the repo root — everything the CI
+#: gate covers (tests included; rules opt out per-path where tests are
+#: deliberately adversarial)
+DEFAULT_ROOTS = (
+    "hydragnn_tpu",
+    "tools",
+    "examples",
+    "tests",
+    "bench.py",
+    "bench_scaling.py",
+    "bench_serve.py",
+    "__graft_entry__.py",
+)
+
+_SKIP_DIRS = {"__pycache__", ".git", ".pytest_cache", "node_modules", ".venv"}
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*disable(?:-file)?=([A-Za-z0-9_,\s]+)"
+)
+_SUPPRESS_FILE_RE = re.compile(
+    r"#\s*graftlint:\s*disable-file=([A-Za-z0-9_,\s]+)"
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str  # repo-relative, forward slashes
+    line: int
+    col: int
+    message: str
+    severity: str = "error"
+    snippet: str = ""
+
+    def fingerprint(self) -> str:
+        """Stable identity for baseline matching: content-addressed so
+        entries survive line renumbering from unrelated edits."""
+        h = hashlib.sha256(
+            f"{self.rule}|{self.path}|{self.snippet.strip()}".encode()
+        )
+        return h.hexdigest()[:20]
+
+    def render(self) -> str:
+        return (
+            f"{self.path}:{self.line}:{self.col}: "
+            f"{self.rule} [{self.severity}] {self.message}"
+        )
+
+    def to_json(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "severity": self.severity,
+            "message": self.message,
+            "fingerprint": self.fingerprint(),
+        }
+
+
+class ParsedModule:
+    """One parsed source file plus everything rules need from it."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path  # repo-relative, forward slashes
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source)
+        # line -> set of suppressed rule ids ("ALL" suppresses any)
+        self.suppressions: Dict[int, Set[str]] = {}
+        self.file_suppressions: Set[str] = set()
+        for i, line in enumerate(self.lines, start=1):
+            m = _SUPPRESS_FILE_RE.search(line)
+            if m:
+                self.file_suppressions.update(
+                    r.strip().upper() for r in m.group(1).split(",") if r.strip()
+                )
+                continue
+            m = _SUPPRESS_RE.search(line)
+            if m:
+                self.suppressions[i] = {
+                    r.strip().upper() for r in m.group(1).split(",") if r.strip()
+                }
+
+    def snippet(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1]
+        return ""
+
+    def suppressed(self, rule_id: str, line: int) -> bool:
+        rid = rule_id.upper()
+        if rid in self.file_suppressions or "ALL" in self.file_suppressions:
+            return True
+        for at in (line, line - 1):
+            ids = self.suppressions.get(at)
+            if ids and (rid in ids or "ALL" in ids):
+                return True
+        return False
+
+
+class Rule:
+    """One invariant. Subclasses set the class attributes and implement
+    :meth:`check`; aggregate rules may also implement :meth:`finalize`
+    (called once after every module has been checked, full-tree scans
+    only)."""
+
+    id: str = "HG000"
+    name: str = "unnamed"
+    severity: str = "error"
+    description: str = ""
+    #: path substrings (repo-relative, forward slashes) this rule skips
+    exclude: Tuple[str, ...] = ()
+    #: when non-empty, the rule ONLY runs on paths containing one of these
+    include: Tuple[str, ...] = ()
+
+    def applies_to(self, path: str) -> bool:
+        if any(part in path for part in self.exclude):
+            return False
+        if self.include and not any(part in path for part in self.include):
+            return False
+        return True
+
+    def check(self, module: ParsedModule) -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def finalize(self) -> Iterator[Finding]:
+        return iter(())
+
+    def finding(
+        self, module: ParsedModule, node: ast.AST, message: str
+    ) -> Finding:
+        line = getattr(node, "lineno", 1)
+        return Finding(
+            rule=self.id,
+            path=module.path,
+            line=line,
+            col=getattr(node, "col_offset", 0) + 1,
+            message=message,
+            severity=self.severity,
+            snippet=module.snippet(line),
+        )
+
+
+# -- repo-table loaders (AST, never import) --------------------------------
+
+
+def load_flight_kinds(repo_root: str) -> Set[str]:
+    """Every event kind ``obs/flight.py`` registers: the keys of its
+    ``_REQUIRED`` dict plus the ``FAULT_KINDS`` tuple, read by AST so
+    the linter never imports the package."""
+    path = os.path.join(repo_root, "hydragnn_tpu", "obs", "flight.py")
+    kinds: Set[str] = set()
+    with open(path) as f:
+        tree = ast.parse(f.read())
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+        names = {t.id for t in targets if isinstance(t, ast.Name)}
+        value = node.value
+        if "_REQUIRED" in names and isinstance(value, ast.Dict):
+            for key in value.keys:
+                if isinstance(key, ast.Constant) and isinstance(key.value, str):
+                    kinds.add(key.value)
+        if "FAULT_KINDS" in names and isinstance(value, (ast.Tuple, ast.List)):
+            for elt in value.elts:
+                if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                    kinds.add(elt.value)
+    return kinds
+
+
+def load_knob_registry(repo_root: str) -> Dict[str, int]:
+    """``{knob name: declaration line}`` from ``utils/knobs.py``,
+    keyed on its ``_K("NAME", ...)`` entry calls — again AST-only."""
+    path = os.path.join(repo_root, "hydragnn_tpu", "utils", "knobs.py")
+    out: Dict[str, int] = {}
+    with open(path) as f:
+        tree = ast.parse(f.read())
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "_K"
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+        ):
+            out[node.args[0].value] = node.lineno
+    return out
+
+
+# -- baseline ---------------------------------------------------------------
+
+
+def load_baseline(path: Optional[str]) -> Set[str]:
+    if not path or not os.path.exists(path):
+        return set()
+    with open(path) as f:
+        data = json.load(f)
+    return {e["fingerprint"] for e in data.get("findings", [])}
+
+
+def write_baseline(path: str, findings: Sequence[Finding]) -> None:
+    data = {
+        "comment": (
+            "graftlint grandfathered findings (docs/LINT.md). The shipped "
+            "tree is lint-clean: keep this EMPTY; a non-empty baseline is "
+            "temporary debt for landing a new rule ahead of its fixes."
+        ),
+        "findings": [
+            {
+                "rule": f.rule,
+                "path": f.path,
+                "fingerprint": f.fingerprint(),
+                "message": f.message,
+            }
+            for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+        ],
+    }
+    with open(path, "w") as f:
+        json.dump(data, f, indent=2)
+        f.write("\n")
+
+
+# -- discovery --------------------------------------------------------------
+
+
+def discover_files(repo_root: str, paths: Sequence[str]) -> List[str]:
+    """Repo-relative .py files under the given paths (files or
+    directories; absolute or repo-relative)."""
+    out: List[str] = []
+    seen: Set[str] = set()
+    for p in paths:
+        absolute = p if os.path.isabs(p) else os.path.join(repo_root, p)
+        if os.path.isfile(absolute):
+            candidates = [absolute]
+        elif os.path.isdir(absolute):
+            candidates = []
+            for dirpath, dirnames, filenames in os.walk(absolute):
+                dirnames[:] = [
+                    d for d in dirnames
+                    if d not in _SKIP_DIRS and not d.startswith(".")
+                ]
+                for name in sorted(filenames):
+                    if name.endswith(".py"):
+                        candidates.append(os.path.join(dirpath, name))
+        else:
+            continue
+        for c in candidates:
+            rel = os.path.relpath(c, repo_root).replace(os.sep, "/")
+            if rel.startswith(".."):
+                rel = c.replace(os.sep, "/")  # outside the repo: keep absolute
+            if rel not in seen:
+                seen.add(rel)
+                out.append(rel)
+    return out
+
+
+def changed_paths(repo_root: str) -> List[str]:
+    """Python files git reports as modified/added/untracked vs HEAD —
+    the --changed pre-commit scan set."""
+    files: Set[str] = set()
+    for args in (
+        ["git", "diff", "--name-only", "HEAD"],
+        ["git", "diff", "--name-only", "--cached"],
+        ["git", "ls-files", "--others", "--exclude-standard"],
+    ):
+        try:
+            res = subprocess.run(
+                args, cwd=repo_root, capture_output=True, text=True, check=False
+            )
+        except OSError:
+            continue
+        for line in res.stdout.splitlines():
+            line = line.strip()
+            if line.endswith(".py"):
+                files.add(line)
+    return sorted(f for f in files if os.path.exists(os.path.join(repo_root, f)))
+
+
+# -- runner -----------------------------------------------------------------
+
+
+def run_lint(
+    repo_root: str,
+    rules: Sequence[Rule],
+    paths: Optional[Sequence[str]] = None,
+    baseline: Optional[str] = None,
+    full_tree: Optional[bool] = None,
+) -> List[Finding]:
+    """Lint ``paths`` (default: the whole tree) with ``rules``; returns
+    surviving findings (suppressions and baseline already applied).
+    ``full_tree`` controls whether aggregate ``finalize`` checks run;
+    by default they run exactly when no path restriction was given."""
+    if full_tree is None:
+        full_tree = paths is None
+    scan = discover_files(repo_root, list(paths) if paths else DEFAULT_ROOTS)
+    baseline_fps = load_baseline(baseline)
+    findings: List[Finding] = []
+    for rel in scan:
+        absolute = (
+            rel if os.path.isabs(rel) else os.path.join(repo_root, rel)
+        )
+        try:
+            with open(absolute, encoding="utf-8") as f:
+                source = f.read()
+            module = ParsedModule(rel, source)
+        except (OSError, SyntaxError, ValueError) as exc:
+            findings.append(
+                Finding(
+                    rule="HG000",
+                    path=rel,
+                    line=getattr(exc, "lineno", 1) or 1,
+                    col=1,
+                    message=f"file does not parse: {exc}",
+                )
+            )
+            continue
+        for rule in rules:
+            if not rule.applies_to(rel):
+                continue
+            for finding in rule.check(module):
+                if module.suppressed(finding.rule, finding.line):
+                    continue
+                findings.append(finding)
+    if full_tree:
+        for rule in rules:
+            findings.extend(rule.finalize())
+    if baseline_fps:
+        findings = [f for f in findings if f.fingerprint() not in baseline_fps]
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+# -- shared AST helpers -----------------------------------------------------
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def iter_calls(root: ast.AST) -> Iterator[ast.Call]:
+    for node in ast.walk(root):
+        if isinstance(node, ast.Call):
+            yield node
+
+
+def string_arg(call: ast.Call, index: int, keyword: str) -> Optional[str]:
+    """The string constant at positional ``index`` or keyword
+    ``keyword`` of a call, else None."""
+    if len(call.args) > index and isinstance(call.args[index], ast.Constant):
+        v = call.args[index].value
+        if isinstance(v, str):
+            return v
+    for kw in call.keywords:
+        if kw.arg == keyword and isinstance(kw.value, ast.Constant):
+            v = kw.value.value
+            if isinstance(v, str):
+                return v
+    return None

@@ -169,7 +169,8 @@ class HydraModel(nn.Module):
         if mt == "SAGE":
             return C.SAGEConv(out_dim, name=name)
         if mt == "MFC":
-            assert cfg.max_neighbours is not None, "MFC requires max_neighbours"
+            if cfg.max_neighbours is None:
+                raise ValueError("MFC requires max_neighbours")
             return C.MFConv(out_dim, max_degree=cfg.max_neighbours, name=name)
         if mt == "CGCNN":
             return C.CGConv(out_dim, name=name)
@@ -191,7 +192,10 @@ class HydraModel(nn.Module):
                 name=name,
             )
         if mt == "SchNet":
-            assert cfg.num_gaussians and cfg.num_filters and cfg.radius
+            if not (cfg.num_gaussians and cfg.num_filters and cfg.radius):
+                raise ValueError(
+                    "SchNet requires num_gaussians, num_filters, and radius"
+                )
             return C.CFConv(
                 out_dim,
                 num_filters=cfg.num_filters,

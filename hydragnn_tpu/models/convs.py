@@ -678,7 +678,8 @@ class CFConv(nn.Module):
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, ctx: EdgeContext) -> jnp.ndarray:
-        assert ctx.edge_weight is not None and ctx.edge_attr is not None
+        if ctx.edge_weight is None or ctx.edge_attr is None:
+            raise ValueError("CFConv requires edge_weight and edge_attr")
         d = ctx.edge_weight
         # init parity with the reference: the filter MLP is plain torch
         # Linear init (kaiming-uniform a=sqrt(5) -> var 1/(3 fan_in));

@@ -66,6 +66,12 @@ _REQUIRED: Dict[str, tuple] = {
     # one event per cache interaction — hit / miss (with reason) /
     # store / evict / store_failed
     "exec_cache": ("event",),
+    # bench evidence events: one per measured config (bench.py) and one
+    # per gate verdict (bench_serve.py warm-start check) — required here
+    # so graftlint --artifacts can hold the committed BENCH_*.jsonl
+    # records to the same schema bar as training flight logs
+    "bench_config": ("name", "result"),
+    "bench_result": ("record", "passed"),
 }
 
 # the fault-history subset tools/obs_report.py --faults narrates

@@ -28,6 +28,7 @@ import os
 import threading
 from collections import deque
 from typing import Dict, Optional
+from hydragnn_tpu.utils import knobs
 
 
 def _percentile_nearest_rank(sorted_vals, q: float) -> float:
@@ -262,11 +263,7 @@ _GLOBAL_LOCK = threading.Lock()
 def telemetry_enabled() -> bool:
     """Process-wide telemetry gate: ``HYDRAGNN_TELEMETRY`` accepts
     0/false/off (any case) to disable; default on."""
-    return os.environ.get("HYDRAGNN_TELEMETRY", "1").lower() not in (
-        "0",
-        "false",
-        "off",
-    )
+    return knobs.get_bool("HYDRAGNN_TELEMETRY", True)
 
 
 def get_registry() -> MetricsRegistry:

@@ -73,6 +73,7 @@ from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+from hydragnn_tpu.utils import knobs
 
 from hydragnn_tpu.ops.segment_pallas import (
     ALIGN,
@@ -1291,7 +1292,7 @@ def residency_vmem_budget_bytes() -> int:
     """VMEM the resident stack kernel may claim, from
     ``HYDRAGNN_RESIDENCY_VMEM_MB`` (default 12 — a TPU core has ~16MB
     and the compiler needs headroom for the pipeline's own buffers)."""
-    return int(float(os.environ.get("HYDRAGNN_RESIDENCY_VMEM_MB", "12")) * (1 << 20))
+    return int(knobs.get_float("HYDRAGNN_RESIDENCY_VMEM_MB", 12.0) * (1 << 20))
 
 
 def residency_vmem_bytes(num_nodes: int, width: int) -> int:

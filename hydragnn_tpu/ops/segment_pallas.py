@@ -74,6 +74,8 @@ import numpy as np
 from jax.experimental.custom_partitioning import custom_partitioning
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from hydragnn_tpu.utils import knobs
+
 # Grid tile sizes, env-overridable for on-chip tuning (tools/tune_tiles.py):
 # larger tiles amortize per-grid-step overhead (the r04 flagship trace
 # shows ~1 ms kernel calls moving only ~0.2 GB — overhead-bound), at the
@@ -105,8 +107,8 @@ def _tile_defaults() -> dict:
         )
         with open(path) as f:
             table = json.load(f)
-        shape = os.environ.get("HYDRAGNN_TILE_SHAPE", "default")
-        kind = os.environ.get("HYDRAGNN_DEVICE_KIND", "default")
+        shape = knobs.get_str("HYDRAGNN_TILE_SHAPE", "default")
+        kind = knobs.get_str("HYDRAGNN_DEVICE_KIND", "default")
         by_shape = table.get(shape) or table.get("default") or {}
         entry = by_shape.get(kind) or by_shape.get("default") or {}
         for k in out:
@@ -118,14 +120,14 @@ def _tile_defaults() -> dict:
 
 
 _TILE_DEFAULTS = _tile_defaults()
-BN = int(os.environ.get("HYDRAGNN_BN", _TILE_DEFAULTS["BN"]))  # output rows (nodes) per grid step
-CE = int(os.environ.get("HYDRAGNN_CE", _TILE_DEFAULTS["CE"]))  # edges DMA'd per inner chunk
+BN = knobs.get_int("HYDRAGNN_BN", _TILE_DEFAULTS["BN"])  # output rows (nodes) per grid step
+CE = knobs.get_int("HYDRAGNN_CE", _TILE_DEFAULTS["CE"])  # edges DMA'd per inner chunk
 # Gather-kernel chunk: the bcast kernel has no cross-chunk accumulator,
 # so it tolerates bigger chunks than the family/sum kernels' CE —
 # measured on v5e (r05 flagship trace): 512 -> 77.8 ms/step, 1024 ->
 # 75.9, 2048 -> 79.7 (wider chunks span more BW-windows and the stray
 # re-reads win back the overhead). Default 1024.
-_BCAST_CE = int(os.environ.get("HYDRAGNN_BCAST_CE", _TILE_DEFAULTS["BCAST_CE"]))
+_BCAST_CE = knobs.get_int("HYDRAGNN_BCAST_CE", _TILE_DEFAULTS["BCAST_CE"])
 if BN % 16 or CE % 16 or BN <= 0 or CE <= 0 or _BCAST_CE % 16 or _BCAST_CE <= 0:
     raise ValueError(
         f"HYDRAGNN_BN={BN} / HYDRAGNN_CE={CE} / HYDRAGNN_BCAST_CE={_BCAST_CE} "
@@ -1063,7 +1065,7 @@ def local_min_rows() -> int:
     fixed per-call cost (window plan + grid setup) only pays off on
     large operands (qm9's 61k-row config measured 7.5 vs 6.3 ms device
     on the local pair — docs/PERF.md r04)."""
-    return int(os.environ.get("HYDRAGNN_LOCAL_MIN_ROWS", 200_000))
+    return knobs.get_int("HYDRAGNN_LOCAL_MIN_ROWS", 200_000)
 
 
 def gather_presum_eligible(table, ids, win, k_group) -> bool:
@@ -1243,7 +1245,7 @@ def _kernel_eligible(indices_are_sorted: bool) -> bool:
     """Knob/backend part of the dispatch decision (no shape check)."""
     if _FORCE_XLA.get():
         return False
-    knob = os.environ.get("HYDRAGNN_PALLAS", "auto")
+    knob = knobs.get_str("HYDRAGNN_PALLAS", "auto")
     if knob == "0":
         return False
     if not pallas_available():
@@ -1308,7 +1310,7 @@ def _lane_pad(data: jnp.ndarray) -> jnp.ndarray:
 
 
 def _interpret_mode() -> bool:
-    return os.environ.get("HYDRAGNN_PALLAS", "auto") == "interpret"
+    return knobs.get_str("HYDRAGNN_PALLAS", "auto") == "interpret"
 
 
 def segment_sum_fast(

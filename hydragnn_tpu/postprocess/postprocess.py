@@ -58,9 +58,10 @@ def unscale_features_by_num_nodes_config(
         i for i in range(len(output_names)) if "_scaled_num_nodes" in output_names[i]
     ]
     if scaled_feature_index:
-        assert var_config[
-            "denormalize_output"
-        ], "Cannot unscale features without 'denormalize_output'"
+        if not var_config["denormalize_output"]:
+            raise ValueError(
+                "Cannot unscale features without 'denormalize_output'"
+            )
         datasets_list = unscale_features_by_num_nodes(
             datasets_list, scaled_feature_index, nodes_num_list
         )

@@ -68,9 +68,11 @@ import signal
 import time
 from typing import Optional, Tuple
 
+from hydragnn_tpu.utils import knobs
+
 
 def _spec(name: str) -> Optional[str]:
-    v = os.environ.get(name)
+    v = knobs.raw(name)
     return v if v else None
 
 
@@ -203,4 +205,6 @@ def strip_injection_env(env: dict) -> dict:
     """Copy of ``env`` without any ``HYDRAGNN_INJECT_*`` keys — what the
     restart supervisor hands to restarted children so injected faults
     fire exactly once."""
-    return {k: v for k, v in env.items() if not k.startswith("HYDRAGNN_INJECT_")}
+    return {
+        k: v for k, v in env.items() if not k.startswith(knobs.INJECT_PREFIX)
+    }

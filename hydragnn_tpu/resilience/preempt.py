@@ -31,6 +31,7 @@ import sys
 import threading
 import traceback
 from typing import Optional
+from hydragnn_tpu.utils import knobs
 
 EXIT_OK = 0
 EXIT_PREEMPTED = 75
@@ -192,7 +193,7 @@ def auto_resume_config(training: dict, log_name: str, log_dir: str) -> bool:
     ``Training.continue=1`` / ``startfrom=<log_name>`` so the restarted
     process continues instead of starting over. Returns True when the
     config was mutated."""
-    if os.environ.get("HYDRAGNN_AUTO_RESUME") != "1":
+    if knobs.raw("HYDRAGNN_AUTO_RESUME") != "1":
         return False
     from hydragnn_tpu.utils.checkpoint import checkpoint_exists
 

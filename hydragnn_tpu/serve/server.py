@@ -53,6 +53,7 @@ from hydragnn_tpu.serve.batcher import (
 )
 from hydragnn_tpu.serve.buckets import Bucket, BucketCompileCache, build_bucket_ladder, route
 from hydragnn_tpu.serve.metrics import ServeMetrics
+from hydragnn_tpu.utils import knobs
 from hydragnn_tpu.serve.registry import ServedModel
 
 
@@ -264,7 +265,7 @@ class ModelServer:
 
         pcfg = self.partitioner.config
         self._exec_cache = ExecCache(
-            self.config.exec_cache_dir or os.environ.get("HYDRAGNN_EXEC_CACHE"),
+            self.config.exec_cache_dir or knobs.raw("HYDRAGNN_EXEC_CACHE"),
             flight=self.flight,
             metrics=self.metrics,
             consumer="serve",

@@ -53,10 +53,12 @@ def compute_formation_enthalpy(
     """(composition_of_element0, total_energy, linear_mixing_energy,
     formation_enthalpy, entropy) for one binary-alloy configuration."""
     elements_list = sorted(elements_list)
-    assert len(elements_list) == 2, "binary alloys only"
+    if len(elements_list) != 2:
+        raise ValueError("binary alloys only")
     elements, counts = np.unique(atoms[:, 0], return_counts=True)
     for e in elements:
-        assert e in elements_list, f"element {e} not in binary {elements_list}"
+        if e not in elements_list:
+            raise ValueError(f"element {e} not in binary {elements_list}")
     count_map = dict(zip(elements.tolist(), counts.tolist()))
     counts_full = [count_map.get(e, 0) for e in elements_list]
 
@@ -98,7 +100,8 @@ def convert_raw_data_energy_to_gibbs(
             pure_elements_energy[float(pure[0])] = (
                 float(energy_token) / atoms.shape[0]
             )
-    assert len(pure_elements_energy) == 2, "Must have two single element files."
+    if len(pure_elements_energy) != 2:
+        raise ValueError("Must have two single element files.")
 
     comps = np.empty(len(all_files))
     totals = np.empty(len(all_files))

@@ -45,6 +45,7 @@ from hydragnn_tpu.train.state import (
     make_train_step,
 )
 from hydragnn_tpu.utils.print_utils import print_distributed, iterate_tqdm
+from hydragnn_tpu.utils import knobs
 from hydragnn_tpu.utils.time_utils import Timer
 
 
@@ -480,17 +481,12 @@ def _scan_auto_eligible(loader, partitioner=None) -> Tuple[bool, str]:
             return False, "empty loader"
     except TypeError:
         return False, "unsized loader"
-    inject = sorted(
-        k
-        for k in os.environ
-        if k.startswith("HYDRAGNN_INJECT_")
-        and not k.startswith("HYDRAGNN_INJECT_SERVE")
-    )
+    inject = knobs.active_injections(include_serve=False)
     if inject:
         # deterministic fault injection is step-indexed — it needs the
         # per-step path's batch granularity to fire at the right step
         return False, f"fault injection active ({inject[0]})"
-    if float(os.environ.get("HYDRAGNN_WATCHDOG_S", 0) or 0) > 0:
+    if knobs.get_float("HYDRAGNN_WATCHDOG_S", 0.0) > 0:
         # the watchdog heartbeats at batch granularity; a whole-epoch
         # dispatch would read as a stall
         return False, "hang watchdog active"
@@ -769,8 +765,7 @@ def train_validate_test(
     introspect_on = (
         telemetry_on
         and bool(training.get("diagnostics", True))
-        and os.environ.get("HYDRAGNN_DIAGNOSTICS", "1").lower()
-        not in ("0", "false", "off")
+        and knobs.get_bool("HYDRAGNN_DIAGNOSTICS", True)
     )
     head_names = list(cfg.output_names)
     diag = None
@@ -874,7 +869,7 @@ def train_validate_test(
     )
     stall_s = float(
         training.get("watchdog_stall_s", 0)
-        or os.environ.get("HYDRAGNN_WATCHDOG_S", 0)
+        or knobs.get_float("HYDRAGNN_WATCHDOG_S", 0.0)
         or 0
     )
     watchdog = HangWatchdog(stall_s, flight=flight).start() if stall_s > 0 else None

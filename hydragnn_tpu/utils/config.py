@@ -73,9 +73,10 @@ def check_output_dim_consistent(sample: GraphSample, config: Dict[str, Any]) -> 
         else:
             expected = ds["node_features"]["dim"][idx]
             actual = int(np.asarray(sample.node_targets[name]).shape[-1])
-        assert actual == expected, (
-            f"head {name}: packed dim {actual} != declared dim {expected}"
-        )
+        if actual != expected:
+            raise ValueError(
+                f"head {name}: packed dim {actual} != declared dim {expected}"
+            )
 
 
 def update_config(
@@ -133,9 +134,10 @@ def update_config(
     arch["edge_dim"] = None
     edge_models = ["PNA", "CGCNN", "SchNet"]
     if arch.get("edge_features"):
-        assert arch["model_type"] in edge_models, (
-            "Edge features can only be used with PNA, CGCNN, SchNet."
-        )
+        if arch["model_type"] not in edge_models:
+            raise ValueError(
+                "Edge features can only be used with PNA, CGCNN, SchNet."
+            )
         arch["edge_dim"] = len(arch["edge_features"])
     elif arch["model_type"] == "CGCNN":
         arch["edge_dim"] = 0

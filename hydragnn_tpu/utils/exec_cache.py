@@ -72,6 +72,7 @@ import pickle
 import sys
 import time
 from typing import Any, Callable, Dict, Optional, Tuple
+from hydragnn_tpu.utils import knobs
 
 #: every classification a load can record (docs/PERF.md table)
 MISS_REASONS = (
@@ -251,7 +252,7 @@ def donation_roundtrip_ok(cache_dir: Optional[str] = None) -> bool:
     ``HYDRAGNN_INJECT_DONATION_CHECK_FAIL=1`` forces a failing verdict
     without touching the persisted one — the deterministic driver for
     the evict-and-recompile path (tests/test_warm_exec_cache.py, ci.sh)."""
-    if os.environ.get("HYDRAGNN_INJECT_DONATION_CHECK_FAIL"):
+    if knobs.is_set("HYDRAGNN_INJECT_DONATION_CHECK_FAIL"):
         return False
     fp = environment_fingerprint()
     if fp in _DONATION_MEMO:
@@ -335,9 +336,7 @@ class ExecCache:
         self.metrics = metrics
         self.consumer = consumer
         if max_bytes is None:
-            max_bytes = int(
-                float(os.environ.get(_ENV_MAX_MB, "512")) * 1024 * 1024
-            )
+            max_bytes = int(knobs.get_float(_ENV_MAX_MB, 512.0) * 1024 * 1024)
         self.max_bytes = max_bytes
         self.stats: Dict[str, Any] = {
             "hits": 0,
@@ -354,7 +353,7 @@ class ExecCache:
         """The ``HYDRAGNN_EXEC_CACHE`` directory, or an inert cache.
         The env var (not ``HYDRAGNN_INJECT_*``) deliberately SURVIVES
         supervisor restarts — warm resume is its whole point."""
-        return cls(os.environ.get(_ENV_DIR) or None, **kw)
+        return cls(knobs.raw(_ENV_DIR) or None, **kw)
 
     @property
     def enabled(self) -> bool:

@@ -1,0 +1,282 @@
+"""Central registry of every ``HYDRAGNN_*`` environment knob.
+
+Nine PRs scattered ``os.environ.get("HYDRAGNN_...")`` reads across
+ops/, train/, serve/, data/, resilience/ and utils/ with no single
+place that says what exists, what type each value is, what the default
+is, or who consumes it. This module is that place: every knob is
+declared here once (name, type, default, consumer module, one doc
+line), every library read goes through the typed accessors below, and
+two enforcement arms keep it honest:
+
+  - **Static**: graftlint rule HG006 (``hydragnn_tpu/lint/rules.py``)
+    fails CI on any ``HYDRAGNN_*`` string literal in the tree that is
+    not declared here — a new knob cannot ship undocumented — and on
+    any declared knob no longer referenced anywhere (stale registry).
+  - **Runtime**: the accessors raise :class:`UndeclaredKnobError` for
+    names missing from the registry, so a typo'd read fails loudly at
+    the call site instead of silently returning the default forever.
+
+``docs/KNOBS.md`` is GENERATED from this registry
+(``python -m hydragnn_tpu.utils.knobs --write docs/KNOBS.md``);
+tests/test_graftlint.py asserts the committed file matches, so the
+docs cannot drift from the code.
+
+This module must stay stdlib-only: the linter and the docs generator
+load it without initializing jax or the rest of the package.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, NamedTuple, Optional
+
+
+class Knob(NamedTuple):
+    name: str
+    type: str  # "str" | "int" | "float" | "bool" | "flag" | "spec" | "path"
+    default: Optional[str]  # None = unset means disabled/absent
+    consumer: str  # the module that reads it
+    doc: str
+
+
+_K = Knob  # registry-entry marker the linter's AST parser keys on
+
+#: Every ``HYDRAGNN_*`` env knob the tree reads, alphabetical. A
+#: ``spec``-typed knob carries a structured value (``N``, ``N:M``, a
+#: path, ...) documented in its consumer; a ``flag`` is significant
+#: merely by being set non-empty.
+KNOBS: Dict[str, Knob] = {
+    k.name: k
+    for k in (
+        _K("HYDRAGNN_AUTO_RESUME", "flag", None, "resilience/preempt.py",
+           "Set to 1 by the restart supervisor: resume from the run's own "
+           "checkpoint instead of starting over."),
+        _K("HYDRAGNN_BCAST_CE", "int", "1024", "ops/segment_pallas.py",
+           "Edges per DMA chunk for the CSR-broadcast gather kernel "
+           "(multiple of 16; overrides the TUNE_TILES.json table)."),
+        _K("HYDRAGNN_BENCH_GATE_TOL", "float", "0.15", "tools/bench_gate.py",
+           "Fractional regression tolerance for the CI perf gate's "
+           "graphs/sec, MFU, and traffic arms."),
+        _K("HYDRAGNN_BN", "int", "128", "ops/segment_pallas.py",
+           "Output rows (nodes) per grid step in the segment kernels "
+           "(multiple of 16; overrides the TUNE_TILES.json table)."),
+        _K("HYDRAGNN_CE", "int", "512", "ops/segment_pallas.py",
+           "Edges DMA'd per inner chunk in the segment-sum kernels "
+           "(multiple of 16; overrides the TUNE_TILES.json table)."),
+        _K("HYDRAGNN_DEBUG_BATCH", "bool", "0", "data/loader.py",
+           "Validate layout contracts (sorted receivers, masked-edge "
+           "targeting, window coverage) on every host batch."),
+        _K("HYDRAGNN_DEVICE_KIND", "str", "default", "ops/segment_pallas.py",
+           "Row selector into TUNE_TILES.json for block/chunk defaults "
+           "(never read from jax.devices(): import must not init a backend)."),
+        _K("HYDRAGNN_DIAGNOSTICS", "bool", "1", "train/loop.py",
+           "Force-disable model introspection (per-head grad norms, MFU "
+           "ledger) regardless of config; the tier-1 suite sets 0."),
+        _K("HYDRAGNN_EXEC_CACHE", "path", None, "utils/exec_cache.py",
+           "Directory of the persistent AOT executable cache; unset = "
+           "inert. Deliberately survives supervisor restart env-strips."),
+        _K("HYDRAGNN_EXEC_CACHE_MAX_MB", "float", "512", "utils/exec_cache.py",
+           "LRU size bound for the executable cache directory."),
+        _K("HYDRAGNN_FULL_MATRIX", "flag", None, "tests/test_train_matrix.py",
+           "Opt into the full 7-model acceptance matrix (~15 min)."),
+        _K("HYDRAGNN_INJECT_DONATION_CHECK_FAIL", "flag", None,
+           "utils/exec_cache.py",
+           "Force the donation round-trip gate to report failure: the "
+           "cached donated executable is evicted and live-compiled."),
+        _K("HYDRAGNN_INJECT_KILL_CHECKPOINT", "spec", None,
+           "resilience/inject.py",
+           "K: during the K-th checkpoint save, write a torn file and "
+           "SIGKILL the process (integrity-validation drill)."),
+        _K("HYDRAGNN_INJECT_NAN_STEP", "spec", None, "resilience/inject.py",
+           "N[:M]: replace node features with NaN for train steps "
+           "N..N+M-1 (drives the non-finite sentry)."),
+        _K("HYDRAGNN_INJECT_SERVE_KILL_DISPATCH", "spec", None,
+           "resilience/inject.py",
+           "K: the K-th dispatched serve batch raises outside request "
+           "isolation, killing the dispatch thread."),
+        _K("HYDRAGNN_INJECT_SERVE_NAN", "spec", None, "resilience/inject.py",
+           "N: serve outputs become NaN for any batch holding request N "
+           "(silent-corruption poison)."),
+        _K("HYDRAGNN_INJECT_SERVE_RAISE", "spec", None, "resilience/inject.py",
+           "N: the serving forward raises for any batch holding request "
+           "N (poison request)."),
+        _K("HYDRAGNN_INJECT_SERVE_TORN_RELOAD", "flag", None,
+           "resilience/inject.py",
+           "Corrupt reload candidate weights before the canary (the "
+           "canary must fail and the old weights keep serving)."),
+        _K("HYDRAGNN_INJECT_SERVE_WEDGE", "spec", None,
+           "resilience/inject.py",
+           "N[:S]: the dispatch thread sleeps S seconds (default 5) in "
+           "the forward of the batch holding request N."),
+        _K("HYDRAGNN_INJECT_SIGTERM_EPOCH", "spec", None,
+           "resilience/inject.py",
+           "E: SIGTERM self-signal at the start of epoch E."),
+        _K("HYDRAGNN_INJECT_SIGTERM_STEP", "spec", None,
+           "resilience/inject.py",
+           "N: SIGTERM self-signal before train step N."),
+        _K("HYDRAGNN_INJECT_STALL_LOADER", "spec", None,
+           "resilience/inject.py",
+           "B:S: the loader's producer sleeps S seconds before building "
+           "batch B of an epoch (drives the hang watchdog)."),
+        _K("HYDRAGNN_LOCAL_MIN_ROWS", "int", "200000", "ops/segment_pallas.py",
+           "Row threshold below which the local-window kernel family "
+           "falls back (its fixed per-call cost needs large operands)."),
+        _K("HYDRAGNN_MATRIX_REPORT", "path", None, "tests/test_train_e2e.py",
+           "Write the acceptance-matrix JSON report to this path."),
+        _K("HYDRAGNN_NUM_PREFETCH", "int", "2", "data/loader.py",
+           "Default loader prefetch depth (an explicit constructor "
+           "argument wins)."),
+        _K("HYDRAGNN_PALLAS", "str", "auto", "ops/segment_pallas.py",
+           "Kernel dispatch: auto = Pallas on TPU for sorted 128-lane "
+           "data; 1 = force on TPU; interpret = interpret mode anywhere "
+           "(CPU tests); 0 = force XLA."),
+        _K("HYDRAGNN_RESIDENCY_VMEM_MB", "float", "12", "ops/fused_conv.py",
+           "VMEM budget the cross-layer resident conv-stack kernel may "
+           "claim (a TPU core has ~16 MB; the pipeline needs headroom)."),
+        _K("HYDRAGNN_TELEMETRY", "bool", "1", "obs/registry.py",
+           "Process-wide telemetry gate: 0/false/off disables the "
+           "registry, flight recorder, spans, and compile monitor."),
+        _K("HYDRAGNN_TILE_SHAPE", "str", "default", "ops/segment_pallas.py",
+           "Shape-tag selector into TUNE_TILES.json for block/chunk "
+           "defaults."),
+        _K("HYDRAGNN_TPU_TESTS", "flag", None, "tests/test_tpu_chip.py",
+           "Opt into the real-chip TPU kernel suite (needs hardware)."),
+        _K("HYDRAGNN_WATCHDOG_S", "float", "0", "train/loop.py",
+           "Hang-watchdog stall threshold in seconds; 0/unset = off. "
+           "Must be sized above the worst expected compile time."),
+    )
+}
+
+#: The injection family prefix: the restart supervisor strips matching
+#: vars from restarted children, and the scan-epoch eligibility check
+#: refuses whole-epoch dispatch while any non-serve member is set.
+INJECT_PREFIX = "HYDRAGNN_INJECT_"
+_FALSE_WORDS = ("0", "false", "off")
+
+
+class UndeclaredKnobError(KeyError):
+    """A ``HYDRAGNN_*`` name was read that the registry does not
+    declare — add a :class:`Knob` entry (and regenerate docs/KNOBS.md)
+    before wiring a new knob into code."""
+
+
+def _check_declared(name: str) -> None:
+    if name not in KNOBS:
+        raise UndeclaredKnobError(
+            f"{name} is not declared in hydragnn_tpu/utils/knobs.py; "
+            "register it (and regenerate docs/KNOBS.md) before reading it"
+        )
+
+
+def raw(name: str, default: Optional[str] = None) -> Optional[str]:
+    """The raw env string (or ``default`` when unset). The one
+    registry-validated primitive every other accessor goes through."""
+    _check_declared(name)
+    return os.environ.get(name, default)
+
+
+def get_str(name: str, default: Optional[str] = None) -> Optional[str]:
+    return raw(name, default)
+
+
+def get_int(name: str, default: int) -> int:
+    v = raw(name)
+    return default if v is None or v == "" else int(v)
+
+
+def get_float(name: str, default: float) -> float:
+    v = raw(name)
+    return default if v is None or v == "" else float(v)
+
+
+def get_bool(name: str, default: bool) -> bool:
+    """The repo's boolean-knob convention: any of 0/false/off (any
+    case) is False, everything else set is True."""
+    v = raw(name)
+    if v is None:
+        return default
+    return v.lower() not in _FALSE_WORDS
+
+
+def is_set(name: str) -> bool:
+    """Flag semantics: set to any non-empty value."""
+    return bool(raw(name))
+
+
+def active_injections(include_serve: bool = True) -> List[str]:
+    """Sorted ``HYDRAGNN_INJECT_*`` names currently set in the
+    environment. ``include_serve=False`` drops the serve-side family —
+    what the scan-epoch eligibility check cares about (train-side
+    injections are step-indexed and need per-step dispatch)."""
+    return sorted(
+        k
+        for k in os.environ
+        if k.startswith(INJECT_PREFIX)
+        and (include_serve or not k.startswith("HYDRAGNN_INJECT_SERVE"))
+    )
+
+
+def generate_docs() -> str:
+    """docs/KNOBS.md, rendered from the registry."""
+    lines = [
+        "# Environment knobs",
+        "",
+        "GENERATED from `hydragnn_tpu/utils/knobs.py` — edit the registry,",
+        "then `python -m hydragnn_tpu.utils.knobs --write docs/KNOBS.md`.",
+        "`tests/test_graftlint.py` asserts this file matches the registry,",
+        "and lint rule HG006 (docs/LINT.md) fails CI on any `HYDRAGNN_*`",
+        "read the registry does not declare.",
+        "",
+        "A `flag` knob is significant merely by being set non-empty; a",
+        "`spec` knob carries a structured value documented below; `bool`",
+        "knobs treat 0/false/off (any case) as false and anything else",
+        "set as true.",
+        "",
+        "| Knob | Type | Default | Consumer | What it does |",
+        "|---|---|---|---|---|",
+    ]
+    for k in sorted(KNOBS.values()):
+        default = "*(unset)*" if k.default is None else f"`{k.default}`"
+        lines.append(
+            f"| `{k.name}` | {k.type} | {default} | `{k.consumer}` | {k.doc} |"
+        )
+    lines += [
+        "",
+        "The `HYDRAGNN_INJECT_*` family is deterministic fault injection",
+        "(`hydragnn_tpu/resilience/inject.py`, docs/RESILIENCE.md): every",
+        "member is a no-op unless set, and the restart supervisor strips",
+        "the whole family from restarted children so each injected fault",
+        "fires exactly once per supervised run.",
+        "",
+    ]
+    return "\n".join(lines)
+
+
+def _main(argv: List[str]) -> int:
+    if argv[:1] == ["--write"] and len(argv) == 2:
+        with open(argv[1], "w") as f:
+            f.write(generate_docs())
+        print(f"wrote {argv[1]} ({len(KNOBS)} knobs)")
+        return 0
+    if argv[:1] == ["--check"] and len(argv) == 2:
+        try:
+            with open(argv[1]) as f:
+                committed = f.read()
+        except OSError:
+            committed = ""
+        if committed != generate_docs():
+            print(
+                f"{argv[1]} is stale: regenerate with "
+                "python -m hydragnn_tpu.utils.knobs --write " + argv[1]
+            )
+            return 1
+        print(f"{argv[1]} matches the registry ({len(KNOBS)} knobs)")
+        return 0
+    print(generate_docs(), end="")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    raise SystemExit(_main(sys.argv[1:]))

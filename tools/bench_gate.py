@@ -179,10 +179,9 @@ def _warm_start_arm() -> int:
         compat_manifest,
         fingerprint,
     )
+    from hydragnn_tpu.utils import knobs
 
-    injected = sorted(
-        k for k in os.environ if k.startswith("HYDRAGNN_INJECT_")
-    )
+    injected = knobs.active_injections()
     if injected:
         print(
             f"bench gate warm-start arm: refusing to gate with {injected} "
@@ -256,6 +255,8 @@ def _warm_start_arm() -> int:
 
 
 def main() -> int:
+    from hydragnn_tpu.utils import knobs
+
     here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument(
@@ -264,7 +265,7 @@ def main() -> int:
     ap.add_argument(
         "--tolerance",
         type=float,
-        default=float(os.environ.get("HYDRAGNN_BENCH_GATE_TOL", 0.15)),
+        default=knobs.get_float("HYDRAGNN_BENCH_GATE_TOL", 0.15),
         help="max fractional regression before failing (default 0.15)",
     )
     ap.add_argument("--steps", type=int, default=30)
