@@ -9,23 +9,40 @@
 #                         baked TPU image ships no formatter, so the gate
 #                         degrades to a full-tree syntax check (compileall)
 #                         and prints which gate ran.
-#   2. graftlint        — tools/graftlint.py (docs/LINT.md): the AST
-#                         invariant linter over the whole tree (HG001
-#                         host-sync-in-hot-path ... HG008 tracer-leak)
-#                         with an empty committed baseline, JSON findings
-#                         artifact on failure, flight-artifact schema
-#                         validation (--artifacts over BENCH_*.jsonl),
-#                         and a self-test that injects one violation per
-#                         guarded rule (HG001/HG002/HG005/HG006 —
-#                         including the aliased `from jax.sharding
-#                         import Mesh as M` case the old grep missed)
-#                         and requires the linter to fail on each.
-#   3. chip hygiene     — tools/chip_hygiene.py reports processes holding
+#   2. graftlint        — tools/graftlint.py (docs/LINT.md): the
+#                         `--changed` pre-commit fast path first, then
+#                         the AST invariant linter over the whole tree
+#                         (HG001 host-sync-in-hot-path ... HG008
+#                         tracer-leak) with an empty committed baseline,
+#                         JSON findings artifact, committed-artifact
+#                         schema validation (--artifacts: flight JSONLs
+#                         + the BENCH_r*/SCALING_*/MULTICHIP_*/
+#                         TUNE_TILES/BENCH_CI_BASELINE machine JSON
+#                         schemas), and a self-test that injects one
+#                         violation per guarded rule (HG001/HG002/
+#                         HG005/HG006 — including the aliased `from
+#                         jax.sharding import Mesh as M` case the old
+#                         grep missed) and requires the linter to fail
+#                         on each.
+#   3. graftcheck       — tools/graftcheck.py (docs/LINT.md, CC rules):
+#                         the compiled-IR contract checker — lowers the
+#                         hot entry points under the pure-DP and fsdp=2
+#                         layouts on the forced 8-device host mesh and
+#                         proves CC001 host-transfer freedom, CC002
+#                         bf16 dtype discipline, CC003 collective
+#                         layout, CC004 bucket-stable compiles, CC005
+#                         donation landing, and CC006 static VMEM
+#                         budgeting from the StableHLO / post-SPMD HLO
+#                         (JSON findings artifact next to graftlint's);
+#                         then a self-test injects one REAL violation
+#                         per contract (HYDRAGNN_INJECT_GRAFTCHECK) and
+#                         requires each contract to reject its own.
+#   4. chip hygiene     — tools/chip_hygiene.py reports processes holding
 #                         accelerator devices/lockfiles (informational:
 #                         a lingering holder from a dead run is the
 #                         transient-init failure class bench.py retries
 #                         through; VERDICT r05 next-round #1).
-#   4. serial suite     — python -m pytest tests/ -q on the virtual
+#   5. serial suite     — python -m pytest tests/ -q on the virtual
 #                         8-device CPU mesh (conftest pins it). This
 #                         INCLUDES the 2-OS-process distributed pass: the
 #                         reference re-runs its whole suite under
@@ -34,7 +51,7 @@
 #                         spawns 2 python processes with a shared
 #                         coordinator itself (TPU-native launch shape —
 #                         jax.distributed, not MPI).
-#   5. partitioner      — unified-Partitioner gate (docs/PARALLELISM.md):
+#   6. partitioner      — unified-Partitioner gate (docs/PARALLELISM.md):
 #      smoke               (a) graftlint rule HG002 — no module outside
 #                         hydragnn_tpu/parallel/ may construct a
 #                         jax.sharding.Mesh directly (train/serve/bench
@@ -45,14 +62,14 @@
 #                         sharded param/opt leaves and a per-device byte
 #                         drop, and the loss history must equal the
 #                         fsdp=1 data-parallel run's.
-#   6. telemetry smoke  — one tiny training through api.run_training,
+#   7. telemetry smoke  — one tiny training through api.run_training,
 #                         then the emitted flight record is schema-
 #                         validated (tools/obs_report.py --validate
 #                         --require-complete) and pretty-printed: the
 #                         committed proof that a default run leaves a
 #                         parseable evidence artifact
 #                         (docs/OBSERVABILITY.md).
-#   7. fault-injection  — a tiny run is SIGTERM-killed mid-epoch via
+#   8. fault-injection  — a tiny run is SIGTERM-killed mid-epoch via
 #      smoke               HYDRAGNN_INJECT_SIGTERM_STEP, the restart
 #                         supervisor (tools/supervise.py) resumes it to
 #                         completion, and the merged flight record must
@@ -62,7 +79,7 @@
 #                         (HYDRAGNN_EXEC_CACHE survives the restart), so
 #                         the resumed segment must reach first-step-ready
 #                         as a cache HIT with 0 new compiles.
-#   8. serve-chaos      — a tiny trained run is served; a poison request
+#   9. serve-chaos      — a tiny trained run is served; a poison request
 #      smoke               is injected (raise-in-forward), then the
 #                         checkpoint is HOT-reloaded into the running
 #                         server; the server must answer identically
@@ -71,14 +88,14 @@
 #                         tools/serve_probe.py must exit 0 on the
 #                         exported Prometheus textfile
 #                         (docs/RESILIENCE.md "Serving resilience").
-#   9. exec-cache smoke — persistent AOT executable cache (docs/PERF.md
+#  10. exec-cache smoke — persistent AOT executable cache (docs/PERF.md
 #                         "r09 cold start"): train a tiny model once,
 #                         start TWO servers (separate processes) against
 #                         one cache dir — the second must perform 0 AOT
 #                         compiles (every bucket a disk hit) — then
 #                         corrupt one entry and require a LOUD
 #                         single-entry eviction + recompile, not a crash.
-#  10. perf gate        — tools/bench_gate.py: a tiny fixed-config bench
+#  11. perf gate        — tools/bench_gate.py: a tiny fixed-config bench
 #                         measured with D2H-fenced segments and compared
 #                         against the committed BENCH_CI_BASELINE.json
 #                         (>15% graphs/sec regression fails; MFU too on
@@ -88,21 +105,21 @@
 #                         cost-model traffic; plus the warm-start arm —
 #                         a warm executable-cache start must cost <50%
 #                         of the cold start and 0 compiles.
-#  11. full matrix      — opt-in (CI_FULL=1): all 7 models x head configs
+#  12. full matrix      — opt-in (CI_FULL=1): all 7 models x head configs
 #                         trained to the reference accuracy thresholds
 #                         (HYDRAGNN_FULL_MATRIX=1, ~15 min).
-#  12. TPU kernel suite — opt-in (CI_TPU=1, needs a real TPU):
+#  13. TPU kernel suite — opt-in (CI_TPU=1, needs a real TPU):
 #                         HYDRAGNN_TPU_TESTS=1 on-chip kernel-vs-XLA
 #                         checks, budgeted under the tunnel's dispatch
 #                         throttle (tests/test_tpu_chip.py).
 #
-# Usage: ./ci.sh            # stages 1-10 (the default CI gate)
+# Usage: ./ci.sh            # stages 1-11 (the default CI gate)
 #        CI_FULL=1 ./ci.sh  # + acceptance matrix
 #        CI_TPU=1  ./ci.sh  # + real-chip kernel suite
 set -euo pipefail
 cd "$(dirname "$0")"
 
-echo "== [1/12] format gate =="
+echo "== [1/13] format gate =="
 if python -m black --version >/dev/null 2>&1; then
     python -m black --check .
 elif command -v black >/dev/null 2>&1; then
@@ -112,7 +129,16 @@ else
     python -m compileall -q hydragnn_tpu tests examples tools bench.py bench_scaling.py bench_serve.py __graft_entry__.py
 fi
 
-echo "== [2/12] graftlint (AST invariant linter, docs/LINT.md) =="
+echo "== [2/13] graftlint (AST invariant linter, docs/LINT.md) =="
+# The --changed fast path first: this is the exact pre-commit loop a
+# developer runs locally (working tree + index vs HEAD), so CI proves
+# the fast path itself stays healthy. The full-tree scan below remains
+# the authoritative gate — --changed narrows WHICH files, never WHICH
+# rules.
+python tools/graftlint.py --changed || {
+    echo "FAIL: graftlint --changed (pre-commit fast path) found violations"
+    exit 1
+}
 # Full tree, all rules, empty committed baseline. On failure the JSON
 # findings artifact is left at /tmp/graftlint_findings.json for CI to
 # collect.
@@ -161,13 +187,44 @@ done
 echo "graftlint self-test: HG001/HG002/HG005/HG006 each reject their injected violation"
 rm -rf "$LINT_ST"
 
-echo "== [3/12] chip hygiene report =="
+echo "== [3/13] graftcheck (compiled-IR contract checker, docs/LINT.md CC rules) =="
+# Lowers the registered hot entry points (train step, scan-epoch body,
+# eval/stats steps, serve bucket ladder) under BOTH CI layouts — pure-DP
+# (data=8) and fsdp=2 (data=4, fsdp=2) — on the forced 8-device host
+# mesh and proves the six compiled-IR contracts from the StableHLO /
+# post-SPMD HLO text. Empty committed baseline
+# (tools/graftcheck_baseline.json); JSON findings artifact published
+# next to graftlint's for CI to collect.
+XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+    python tools/graftcheck.py --json /tmp/graftcheck_findings.json || {
+    echo "FAIL: graftcheck found compiled-IR contract violations (JSON artifact: /tmp/graftcheck_findings.json)"
+    exit 1
+}
+# Self-test: each contract must individually reject a REAL injected
+# violation — the injection (HYDRAGNN_INJECT_GRAFTCHECK, docs/LINT.md
+# "Self-test injections") perturbs the lowered program itself (a forced
+# host callback, an f32 edge dot, a rogue collective, ...), not the
+# checker, so a pass here proves the contract detects the defect class,
+# not merely that a flag flips an exit code.
+for cc in cc001 cc002 cc003 cc004 cc005 cc006; do
+    CC="$(echo "$cc" | tr '[:lower:]' '[:upper:]')"
+    if HYDRAGNN_INJECT_GRAFTCHECK="$cc" \
+        XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        python tools/graftcheck.py --layout dp --contract "$CC" --no-baseline \
+        >/dev/null 2>&1; then
+        echo "FAIL: graftcheck self-test — $CC did not reject its injected violation"
+        exit 1
+    fi
+done
+echo "graftcheck self-test: CC001..CC006 each reject their injected violation"
+
+echo "== [4/13] chip hygiene report =="
 python tools/chip_hygiene.py || true
 
-echo "== [4/12] serial suite (virtual 8-device CPU mesh, incl. 2-process pass) =="
+echo "== [5/13] serial suite (virtual 8-device CPU mesh, incl. 2-process pass) =="
 python -m pytest tests/ -q
 
-echo "== [5/12] partitioner smoke (HG002 mesh gate; fsdp=2 train == fsdp=1, flight parallel block) =="
+echo "== [6/13] partitioner smoke (HG002 mesh gate; fsdp=2 train == fsdp=1, flight parallel block) =="
 # Train, serve, and bench obtain meshes/shardings exclusively through the
 # Partitioner: no module outside hydragnn_tpu/parallel/ may construct a
 # jax.sharding.Mesh directly. tests/ are exempt (they build adversarial
@@ -254,7 +311,7 @@ echo "$PART_OUT" | grep -q "parallel: mesh=" || {
     echo "FAIL: --validate did not surface the parallel block"; exit 1; }
 rm -rf "$PART_DIR"
 
-echo "== [6/12] telemetry smoke (tiny 2-head training -> schema-valid v2 flight record with head diagnostics + MFU ledger) =="
+echo "== [7/13] telemetry smoke (tiny 2-head training -> schema-valid v2 flight record with head diagnostics + MFU ledger) =="
 SMOKE_DIR="$(mktemp -d)"
 JAX_PLATFORMS=cpu python - "$SMOKE_DIR" <<'EOF'
 import sys
@@ -314,7 +371,7 @@ print("introspection smoke: OK (v2 record, head diagnostics + MFU ledger present
 EOF
 rm -rf "$SMOKE_DIR"
 
-echo "== [7/12] fault-injection smoke (SIGTERM mid-epoch -> supervisor resume) =="
+echo "== [8/13] fault-injection smoke (SIGTERM mid-epoch -> supervisor resume) =="
 FAULT_DIR="$(mktemp -d)"
 cat > "$FAULT_DIR/child.py" <<'EOF'
 import sys
@@ -382,7 +439,7 @@ print(
 EOF
 rm -rf "$FAULT_DIR"
 
-echo "== [8/12] serve-chaos smoke (poison request -> quarantine; hot reload from the saved checkpoint; health probe) =="
+echo "== [9/13] serve-chaos smoke (poison request -> quarantine; hot reload from the saved checkpoint; health probe) =="
 SERVE_DIR="$(mktemp -d)"
 JAX_PLATFORMS=cpu python - "$SERVE_DIR" <<'EOF'
 import glob
@@ -470,7 +527,7 @@ python tools/obs_report.py --faults "$SERVE_DIR/serve_flight.jsonl"
 python tools/serve_probe.py --prom "$SERVE_DIR/serve.prom" --verbose
 rm -rf "$SERVE_DIR"
 
-echo "== [9/12] exec-cache smoke (train once; two server starts vs one cache dir; corrupt entry -> loud eviction) =="
+echo "== [10/13] exec-cache smoke (train once; two server starts vs one cache dir; corrupt entry -> loud eviction) =="
 EXEC_DIR="$(mktemp -d)"
 cat > "$EXEC_DIR/serve_once.py" <<'EOF'
 import sys
@@ -553,7 +610,7 @@ grep -q "exec_cache: evicted entry" "$EXEC_DIR/corrupt.err" || {
 }
 rm -rf "$EXEC_DIR"
 
-echo "== [10/12] perf gate (tiny fixed-config bench vs committed baseline) =="
+echo "== [11/13] perf gate (tiny fixed-config bench vs committed baseline) =="
 # fails on a >15% graphs/sec regression (and MFU regression on TPU)
 # against BENCH_CI_BASELINE.json, keyed per backend:device so every CI
 # machine gates against its own recorded number (tools/bench_gate.py)
@@ -581,17 +638,17 @@ fi
 JAX_PLATFORMS=cpu python tools/bench_gate.py --warm-start-arm
 
 if [ "${CI_FULL:-0}" = "1" ]; then
-    echo "== [11/12] full acceptance matrix (reference thresholds) =="
+    echo "== [12/13] full acceptance matrix (reference thresholds) =="
     HYDRAGNN_FULL_MATRIX=1 python -m pytest tests/test_train_matrix.py -q
 else
-    echo "== [11/12] full acceptance matrix: skipped (set CI_FULL=1) =="
+    echo "== [12/13] full acceptance matrix: skipped (set CI_FULL=1) =="
 fi
 
 if [ "${CI_TPU:-0}" = "1" ]; then
-    echo "== [12/12] real-chip TPU kernel suite =="
+    echo "== [13/13] real-chip TPU kernel suite =="
     HYDRAGNN_TPU_TESTS=1 python -m pytest tests/test_tpu_chip.py -q
 else
-    echo "== [12/12] real-chip TPU kernel suite: skipped (set CI_TPU=1, needs a TPU) =="
+    echo "== [13/13] real-chip TPU kernel suite: skipped (set CI_TPU=1, needs a TPU) =="
 fi
 
 echo "CI protocol complete."

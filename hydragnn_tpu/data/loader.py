@@ -348,6 +348,16 @@ class GraphLoader:
     def num_samples(self) -> int:
         return len(self.samples)
 
+    def peek_batch(self) -> GraphBatch:
+        """First batch of the current epoch's order, built and placed
+        exactly as ``__iter__`` would build it — WITHOUT counting as an
+        epoch iteration. Telemetry consumers (the graftcheck manifest
+        stamp in ``train/loop.py``) peek here so loader wrappers that
+        count ``__iter__`` draws (epoch schedulers, fault-injection
+        harnesses) only ever see real epochs."""
+        order = self._order()
+        return self._place(self._make_batch(order[: self.batch_size]))
+
     def _order(self) -> np.ndarray:
         n = len(self.samples)
         if not self.shuffle:

@@ -1,20 +1,34 @@
-"""``graftlint --artifacts``: schema-validate committed flight records.
+"""``graftlint --artifacts``: schema-validate committed machine artifacts.
 
-The repo commits bench evidence as flight JSONL artifacts
-(``BENCH_FLIGHT.jsonl``, ``BENCH_SERVE_WARM_FLIGHT.jsonl``). Their
-schema lives in ``obs/flight.py`` (``_REQUIRED``), so drift between
-the tables and the checked-in records is exactly the static-vs-runtime
-gap the linter exists to close: this mode runs the real
-``validate_flight_record`` over each artifact and reports problems as
-findings. ``flight.py`` is stdlib-only by design, so it is loaded
-standalone (``importlib``, no package import, no jax init).
+The repo commits bench evidence in two shapes, and both are validated
+here so a malformed committed artifact fails CI instead of a later tool
+run:
+
+* **Flight JSONL records** (``BENCH_FLIGHT.jsonl``,
+  ``BENCH_SERVE_WARM_FLIGHT.jsonl``) — their schema lives in
+  ``obs/flight.py`` (``_REQUIRED``), so drift between the tables and
+  the checked-in records is exactly the static-vs-runtime gap the
+  linter exists to close: this mode runs the real
+  ``validate_flight_record`` over each and reports problems as
+  findings. ``flight.py`` is stdlib-only by design, so it is loaded
+  standalone (``importlib``, no package import, no jax init).
+
+* **Machine JSON artifacts** (``BENCH_r*.json``, ``SCALING_*.json``,
+  ``MULTICHIP_*.json``, ``TUNE_TILES.json``,
+  ``BENCH_CI_BASELINE.json``) — per-kind schemas below
+  (``MACHINE_SCHEMAS``), derived from the writers (bench.py,
+  tools/estimate_scaling.py, tools/tune_tiles.py, tools/bench_ci.py).
+  The checks pin the fields downstream tools actually read; extra keys
+  stay legal so a writer can grow its record without a lint dance.
 """
 
 from __future__ import annotations
 
+import fnmatch
 import importlib.util
+import json
 import os
-from typing import List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from .core import Finding
 
@@ -23,6 +37,229 @@ DEFAULT_ARTIFACTS = (
     "BENCH_FLIGHT.jsonl",
     "BENCH_SERVE_WARM_FLIGHT.jsonl",
 )
+
+
+def _require(data: Any, fields: Dict[str, tuple]) -> List[str]:
+    """Missing/mistyped required top-level fields of a dict artifact."""
+    if not isinstance(data, dict):
+        return [f"expected a JSON object, got {type(data).__name__}"]
+    problems = []
+    for name, types in fields.items():
+        if name not in data:
+            problems.append(f"missing required field '{name}'")
+        elif not isinstance(data[name], types):
+            want = "/".join(t.__name__ for t in types)
+            problems.append(
+                f"field '{name}' is {type(data[name]).__name__}, expected {want}"
+            )
+    return problems
+
+
+_NUM = (int, float)
+
+
+def _check_bench(data: Any) -> List[str]:
+    """BENCH_r*.json: one TPU-attempt record (bench driver wrapper).
+    ``parsed`` is the bench.py metric block when the run got far enough
+    to print one, else null (BENCH_r05 died at init: rc!=0, tail only)."""
+    problems = _require(
+        data, {"n": (int,), "cmd": (str,), "rc": (int,), "tail": (str,)}
+    )
+    if problems:
+        return problems
+    parsed = data.get("parsed")
+    if parsed is not None:
+        if not isinstance(parsed, dict):
+            return [f"'parsed' is {type(parsed).__name__}, expected object or null"]
+        problems += [
+            f"parsed.{p}" for p in _require(
+                parsed, {"metric": (str,), "value": _NUM, "unit": (str,)}
+            )
+        ]
+    return problems
+
+
+def _check_multichip(data: Any) -> List[str]:
+    """MULTICHIP_r*.json: one multi-chip attempt record."""
+    return _require(
+        data,
+        {
+            "n_devices": (int,),
+            "rc": (int,),
+            "ok": (bool,),
+            "skipped": (bool,),
+            "tail": (str,),
+        },
+    )
+
+
+def _check_scaling(data: Any) -> List[str]:
+    """SCALING_*.json: either a measured sweep (``sizes`` per device
+    count, SCALING_cpu8) or an analytic estimate (``mesh`` +
+    per-step collective model, SCALING_est_*)."""
+    if not isinstance(data, dict):
+        return [f"expected a JSON object, got {type(data).__name__}"]
+    if "sizes" in data:  # measured sweep
+        problems = _require(
+            data, {"metric": (str,), "unit": (str,), "steps": (int,), "sizes": (dict,)}
+        )
+        if problems:
+            return problems
+        if not data["sizes"]:
+            return ["'sizes' sweep is empty"]
+        for n, row in data["sizes"].items():
+            problems += [
+                f"sizes[{n}].{p}" for p in _require(
+                    row, {"step_ms": _NUM, "graphs_per_sec": _NUM}
+                )
+            ]
+        return problems
+    if "mesh" in data:  # analytic estimate
+        problems = _require(
+            data, {"mesh": (str,), "step_ms_device_single_chip": _NUM}
+        )
+        widths = data.get("widths")
+        if widths is not None:
+            if not isinstance(widths, dict) or not widths:
+                problems.append("'widths' must be a non-empty object")
+            else:
+                for w, row in widths.items():
+                    problems += [
+                        f"widths[{w}].{p}"
+                        for p in _require(row, {"n_devices": (int,)})
+                    ]
+        return problems
+    return ["neither 'sizes' (measured sweep) nor 'mesh' (estimate) present"]
+
+
+def _check_tune_tiles(data: Any) -> List[str]:
+    """TUNE_TILES.json: {shape_tag: {device_kind: {BN, CE, BCAST_CE}}}
+    — the committed tile sweep ops/segment_pallas.py reads its
+    import-time defaults from."""
+    problems = _require(data, {"_doc": (str,)})
+    if problems:
+        return problems
+    tags = {k: v for k, v in data.items() if k != "_doc"}
+    if not tags:
+        return ["no shape-tag entries (only _doc)"]
+    for tag, kinds in tags.items():
+        if not isinstance(kinds, dict) or not kinds:
+            problems.append(f"'{tag}' must be a non-empty object of device kinds")
+            continue
+        for kind, tiles in kinds.items():
+            problems += [
+                f"{tag}.{kind}.{p}" for p in _require(
+                    tiles, {"BN": (int,), "CE": (int,), "BCAST_CE": (int,)}
+                )
+            ]
+    return problems
+
+
+def _check_ci_baseline(data: Any) -> List[str]:
+    """BENCH_CI_BASELINE.json: {"backend:device_kind": perf row} — the
+    regression reference tools/bench_ci.py compares against."""
+    if not isinstance(data, dict):
+        return [f"expected a JSON object, got {type(data).__name__}"]
+    if not data:
+        return ["no 'backend:device_kind' entries"]
+    problems = []
+    for key, row in data.items():
+        if ":" not in key:
+            problems.append(f"key '{key}' is not 'backend:device_kind'")
+        problems += [
+            f"{key}.{p}" for p in _require(
+                row,
+                {"step_ms_median": _NUM, "graphs_per_sec": _NUM, "steps": (int,)},
+            )
+        ]
+    return problems
+
+
+#: machine-JSON artifact kinds: glob pattern -> (label, validator).
+#: Patterns with ZERO committed matches are themselves findings — these
+#: artifacts are evidence, and losing one silently is the failure mode.
+MACHINE_SCHEMAS: Dict[str, Tuple[str, Callable[[Any], List[str]]]] = {
+    "BENCH_r*.json": ("bench attempt record", _check_bench),
+    "MULTICHIP_r*.json": ("multi-chip attempt record", _check_multichip),
+    "SCALING_*.json": ("scaling sweep/estimate", _check_scaling),
+    "TUNE_TILES.json": ("kernel tile sweep", _check_tune_tiles),
+    "BENCH_CI_BASELINE.json": ("CI perf baseline", _check_ci_baseline),
+}
+
+
+def _machine_kind(name: str) -> Optional[Tuple[str, Callable[[Any], List[str]]]]:
+    for pattern, spec in MACHINE_SCHEMAS.items():
+        if fnmatch.fnmatch(name, pattern):
+            return spec
+    return None
+
+
+def validate_machine_artifact(path: str, rel_display: str) -> List[Finding]:
+    """Validate ONE committed machine JSON artifact against its kind's
+    schema (kind resolved from the file name)."""
+    spec = _machine_kind(os.path.basename(path))
+    if spec is None:
+        return [
+            Finding(
+                rule="HGART",
+                path=rel_display,
+                line=1,
+                col=1,
+                message=(
+                    "no schema registered for this artifact name "
+                    f"(known kinds: {', '.join(sorted(MACHINE_SCHEMAS))})"
+                ),
+            )
+        ]
+    label, check = spec
+    try:
+        with open(path) as fh:
+            data = json.load(fh)
+    except (OSError, ValueError) as exc:
+        return [
+            Finding(
+                rule="HGART",
+                path=rel_display,
+                line=1,
+                col=1,
+                message=f"unreadable {label}: {exc}",
+            )
+        ]
+    return [
+        Finding(
+            rule="HGART",
+            path=rel_display,
+            line=1,
+            col=1,
+            message=f"invalid {label}: {problem}",
+            snippet=problem,
+        )
+        for problem in check(data)
+    ]
+
+
+def validate_machine_artifacts(repo_root: str) -> List[Finding]:
+    """Validate every committed machine JSON artifact in the repo root;
+    a kind with no matches at all is reported (lost evidence)."""
+    findings: List[Finding] = []
+    names = sorted(os.listdir(repo_root))
+    for pattern, (label, _) in MACHINE_SCHEMAS.items():
+        matches = [n for n in names if fnmatch.fnmatch(n, pattern)]
+        if not matches:
+            findings.append(
+                Finding(
+                    rule="HGART",
+                    path=pattern,
+                    line=1,
+                    col=1,
+                    message=f"no committed {label} matches '{pattern}'",
+                )
+            )
+        for name in matches:
+            findings.extend(
+                validate_machine_artifact(os.path.join(repo_root, name), name)
+            )
+    return findings
 
 
 def _load_flight_module(repo_root: str):
@@ -44,13 +281,22 @@ def validate_artifacts(
     kind absent from ``_REQUIRED`` has no required-field coverage at
     all, so unregistered kinds in a committed artifact are reported
     here too.
+
+    With no explicit ``paths``, the committed machine JSON artifacts
+    (``MACHINE_SCHEMAS``) are validated too; an explicit ``.json`` path
+    is dispatched to its kind's schema by file name.
     """
     flight = _load_flight_module(repo_root)
     registered = set(flight._REQUIRED) | set(flight.FAULT_KINDS)
     findings: List[Finding] = []
+    if paths is None:
+        findings.extend(validate_machine_artifacts(repo_root))
     for rel in paths or DEFAULT_ARTIFACTS:
         path = rel if os.path.isabs(rel) else os.path.join(repo_root, rel)
         rel_display = rel.replace(os.sep, "/")
+        if rel_display.endswith(".json"):
+            findings.extend(validate_machine_artifact(path, rel_display))
+            continue
         if not os.path.exists(path):
             findings.append(
                 Finding(

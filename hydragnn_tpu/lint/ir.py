@@ -1,0 +1,926 @@
+"""graftcheck — compiled-IR contract checker (CC001–CC006).
+
+graftlint (``core``/``rules``) proves invariants of the SOURCE; this
+module proves invariants of the EXECUTABLE. Every speed claim since r07
+rests on properties of the compiled program — no host syncs in the hot
+path, bf16 edge streams with f32 accumulation, the exact FSDP
+all-gather/reduce-scatter pattern, donated buffers actually aliased,
+one executable per serve bucket — and none of those survive a Python
+AST walk: they only exist after ``jax.jit(...).lower()`` (and, for the
+collective layout, after XLA's SPMD partitioner runs at ``.compile()``).
+
+The checker lowers the registered hot entry points (train step, scan
+epoch, eval/stats steps, serve bucket ladder, bf16 conv forward) under
+a given :class:`~hydragnn_tpu.parallel.partitioner.Partitioner` layout
+and walks the StableHLO / post-SPMD HLO text for six contracts
+(docs/LINT.md catalogs them with their motivating incidents):
+
+  CC001  host-transfer freedom — no infeed/outfeed/host callbacks in
+         any lowered hot-path module.
+  CC002  dtype discipline — with ``Architecture.conv_bf16`` set, the
+         edge-stream dots run in bf16 (f32 accumulation allowed); a
+         silent f32 upcast refunds the ISSUE-10 bandwidth win.
+  CC003  collective audit — the compiled step's collectives must match
+         the set the ``(data, fsdp, edge)`` layout implies; an
+         unexpected all-gather refunds FSDP's memory win.
+  CC004  bucket-stable compiles — exactly one executable signature per
+         serve-ladder bucket, no shape-polymorphic leaks.
+  CC005  donation landing — donated entry points carry buffer-donation
+         markers in the lowered module and a non-empty
+         ``input_output_alias`` map in the executable (the static face
+         of the r09 ``donation_check_failed`` gate).
+  CC006  static VMEM budgeting — ``ops/fused_conv.py`` residency math
+         for every hot-path (nodes, width) shape fits
+         ``HYDRAGNN_RESIDENCY_VMEM_MB``, proven from shapes alone.
+
+Findings flow through the graftlint framework (:class:`Finding`,
+fingerprints, JSON, baseline); ``tools/graftcheck.py`` is the CLI and
+``contract_block`` the cheap in-run variant train/bench manifests stamp
+into the flight record.
+
+The text walkers at the top are pure string functions (golden-fixture
+testable, no jax); everything that traces or lowers imports jax lazily
+so importing this module stays cheap.
+
+Self-test injections: ``HYDRAGNN_INJECT_GRAFTCHECK=cc001..cc006``
+(comma-separated) plants one real violation per contract — a host
+callback in the eval step, a dropped bf16 cast, a layout-mismatched
+collective permute, a colliding bucket plan, a de-donated step, a
+starved VMEM budget — so CI can prove each contract actually rejects.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from hydragnn_tpu.lint.core import Finding
+
+SCHEMA_VERSION = 1
+
+#: contract id -> (name, one-line description)
+CONTRACTS: Dict[str, Tuple[str, str]] = {
+    "CC001": (
+        "host-transfer freedom",
+        "no infeed/outfeed/host-callback ops in a lowered hot-path module",
+    ),
+    "CC002": (
+        "dtype discipline",
+        "conv_bf16 edge-stream dots run in bf16 (f32 accumulation only)",
+    ),
+    "CC003": (
+        "collective audit",
+        "compiled collectives match the (data, fsdp, edge) layout",
+    ),
+    "CC004": (
+        "bucket-stable compiles",
+        "one executable signature per serve bucket, no dynamic shapes",
+    ),
+    "CC005": (
+        "donation landing",
+        "donated args carry aliasing markers in the lowered executable",
+    ),
+    "CC006": (
+        "static VMEM budgeting",
+        "fused-conv residency math fits HYDRAGNN_RESIDENCY_VMEM_MB",
+    ),
+}
+
+#: the injection spec values HYDRAGNN_INJECT_GRAFTCHECK accepts
+INJECTABLE = tuple(c.lower() for c in CONTRACTS)
+
+# -- pure text walkers (no jax; golden-fixture testable) --------------------
+
+#: substrings whose presence in a lowered module means the executable
+#: round-trips through the host mid-step. ``stablehlo.custom_call``
+#: callback targets cover jax.pure_callback / io_callback /
+#: debug.callback on every backend spelling jax 0.4-0.6 emits.
+HOST_TRANSFER_MARKERS = (
+    "stablehlo.infeed",
+    "stablehlo.outfeed",
+    "stablehlo.send",
+    "stablehlo.recv",
+    "xla_python_cpu_callback",
+    "xla_python_gpu_callback",
+    "xla_ffi_python_cpu_callback",
+    "xla_ffi_python_gpu_callback",
+    "xla_python_callback",
+)
+
+#: how buffer donation shows in lowered StableHLO: plain jit emits
+#: ``tf.aliasing_output``; jit-with-shardings (the partitioned steps)
+#: emits ``jax.buffer_donor`` and resolves aliases at compile time.
+DONATION_MARKERS = ("tf.aliasing_output", "jax.buffer_donor")
+
+_ALIAS_RE = re.compile(r"input_output_alias=\{\s*\{")
+_DYNAMIC_DIM_RE = re.compile(r"tensor<\?|tensor<\d*x\?")
+
+_COLLECTIVE_RE = re.compile(
+    r"=\s*\S+\s+"
+    r"(all-gather|all-reduce|reduce-scatter|collective-permute|all-to-all)"
+    r"(?:-start)?\("
+)
+_IOTA_GROUPS_RE = re.compile(r"replica_groups=\[(\d+),(\d+)\]")
+_EXPLICIT_GROUPS_RE = re.compile(r"replica_groups=\{(\{[^}]*\}(?:,\{[^}]*\})*)\}")
+
+_DOT_RE = re.compile(
+    r"stablehlo\.(dot_general|convolution)\s.*?:\s*\(([^)]*)\)\s*->\s*(tensor<[^>]+>)"
+)
+_TENSOR_RE = re.compile(r"tensor<([0-9x]+)x(f64|f32|f16|bf16)>")
+
+
+def scan_host_transfers(lowered_text: str) -> List[str]:
+    """Host-transfer markers present in a lowered module (CC001)."""
+    return sorted(m for m in HOST_TRANSFER_MARKERS if m in lowered_text)
+
+
+def scan_donation_markers(lowered_text: str) -> bool:
+    """Whether the lowered module carries buffer-donation attributes
+    on any argument (CC005)."""
+    return any(m in lowered_text for m in DONATION_MARKERS)
+
+
+def scan_compiled_aliasing(compiled_text: str) -> bool:
+    """Whether the post-compile HLO module header declares a non-empty
+    ``input_output_alias`` map — donation actually landed (CC005)."""
+    return bool(_ALIAS_RE.search(compiled_text))
+
+
+def scan_dynamic_dims(lowered_text: str) -> bool:
+    """Whether any tensor type in the module has a dynamic (``?``)
+    dimension — a shape-polymorphic leak (CC004)."""
+    return bool(_DYNAMIC_DIM_RE.search(lowered_text))
+
+
+@dataclasses.dataclass(frozen=True)
+class Collective:
+    """One collective op parsed out of post-SPMD compiled HLO."""
+
+    kind: str  # all-gather | all-reduce | reduce-scatter | ...
+    group_count: Optional[int]  # None when the op carries no groups
+    group_size: Optional[int]  # None when groups are absent/ragged
+
+
+def parse_collectives(compiled_text: str) -> List[Collective]:
+    """Every cross-device collective in a compiled HLO module, with its
+    replica-group geometry. Handles both textual forms XLA emits: the
+    iota form ``replica_groups=[G,S]<=[N]`` (G groups of S devices) and
+    the explicit form ``replica_groups={{0,1},{2,3}}``."""
+    out: List[Collective] = []
+    for line in compiled_text.splitlines():
+        m = _COLLECTIVE_RE.search(line)
+        if not m:
+            continue
+        kind = m.group(1)
+        count: Optional[int] = None
+        size: Optional[int] = None
+        mi = _IOTA_GROUPS_RE.search(line)
+        if mi:
+            count, size = int(mi.group(1)), int(mi.group(2))
+        else:
+            me = _EXPLICIT_GROUPS_RE.search(line)
+            if me:
+                groups = re.findall(r"\{([^{}]*)\}", me.group(1))
+                sizes = {
+                    len([t for t in g.split(",") if t.strip()]) for g in groups
+                }
+                count = len(groups)
+                size = sizes.pop() if len(sizes) == 1 else None
+        out.append(Collective(kind=kind, group_count=count, group_size=size))
+    return out
+
+
+def audit_collectives(
+    collectives: Sequence[Collective],
+    data: int,
+    fsdp: int,
+    zero1: bool = False,
+) -> List[str]:
+    """CC003: violation messages for collectives the ``(data, fsdp)``
+    layout does not predict.
+
+    The expected set, derived from how the Partitioner builds its
+    steps (``parallel/sharded.py`` psum/pmean over the lead axes,
+    FSDP parameter all-gathers, ZeRO-1/FSDP grad reduce-scatters):
+
+      - ``all-reduce``  — always allowed; group size must span the
+        batch axes (``data`` or ``data*fsdp``).
+      - ``all-gather``  — FSDP only; group size must equal ``fsdp``
+        (an all-gather elsewhere silently refunds FSDP's memory win).
+      - ``reduce-scatter`` — FSDP/ZeRO-1 only, same group rule.
+      - ``collective-permute`` / ``all-to-all`` — never expected in
+        these programs; halo exchanges have no place in this model.
+
+    Ops whose groups could not be parsed (``None``) are audited by
+    kind only."""
+    total = data * max(fsdp, 1)
+    problems: List[str] = []
+    for c in collectives:
+        geom = (
+            f"{c.group_count}x{c.group_size}"
+            if c.group_count is not None
+            else "?"
+        )
+        if c.kind in ("collective-permute", "all-to-all"):
+            problems.append(
+                f"unexpected {c.kind} (groups {geom}): the (data={data}, "
+                f"fsdp={fsdp}) layout implies no permutation collectives"
+            )
+        elif c.kind == "all-gather":
+            if fsdp <= 1:
+                problems.append(
+                    f"all-gather (groups {geom}) in a pure-DP program: "
+                    "parameters are replicated, nothing should gather"
+                )
+            elif c.group_size is not None and c.group_size != fsdp:
+                problems.append(
+                    f"all-gather group size {c.group_size} != fsdp={fsdp}: "
+                    "a gather over the wrong axis refunds FSDP's memory win"
+                )
+        elif c.kind == "reduce-scatter":
+            if fsdp <= 1 and not zero1:
+                problems.append(
+                    f"reduce-scatter (groups {geom}) without fsdp/zero1: "
+                    "no state shard exists to scatter into"
+                )
+            elif c.group_size is not None and c.group_size not in (fsdp, data):
+                problems.append(
+                    f"reduce-scatter group size {c.group_size} matches "
+                    f"neither fsdp={fsdp} nor data={data}"
+                )
+        elif c.kind == "all-reduce":
+            if c.group_size is not None and c.group_size not in (1, data, total):
+                problems.append(
+                    f"all-reduce group size {c.group_size} spans neither "
+                    f"data={data} nor the full mesh ({total}): a reduction "
+                    "over a partial axis is a layout mismatch"
+                )
+    return problems
+
+
+def scan_edge_f32_dots(lowered_text: str, edge_pad: int) -> List[str]:
+    """CC002: f32xf32 dot/convolution ops on the edge stream — ops whose
+    operands are all f32 and whose leading dimension equals the batch's
+    padded edge count. Node-level and head dots legitimately stay f32;
+    the contract is about the [E, *] streams whose bytes dominate."""
+    bad: List[str] = []
+    for m in _DOT_RE.finditer(lowered_text):
+        operands = _TENSOR_RE.findall(m.group(2))
+        if not operands or any(dt != "f32" for _, dt in operands):
+            continue
+        lead = operands[0][0].split("x")[0]
+        if lead == str(edge_pad):
+            bad.append(
+                f"f32 {m.group(1)} over the edge stream "
+                f"({operands[0][0]}): conv_bf16 promised bf16 operands"
+            )
+    return bad
+
+
+def count_bf16_values(lowered_text: str) -> int:
+    """Number of bf16 tensor types in a lowered module — zero under a
+    conv_bf16 config means the casts were dropped entirely (CC002)."""
+    return lowered_text.count("xbf16>")
+
+
+# -- findings ---------------------------------------------------------------
+
+
+def _finding(rule: str, entry: str, message: str, severity: str = "error") -> Finding:
+    """A graftcheck finding. ``path`` is the synthetic entry-point
+    coordinate (``graftcheck/<layout>/<entry>``); the snippet carries
+    the message head so fingerprints are content-stable across
+    line-number-free findings."""
+    return Finding(
+        rule=rule,
+        path=entry,
+        line=0,
+        col=0,
+        message=message,
+        severity=severity,
+        snippet=message.split(":")[0],
+    )
+
+
+# -- lowered entry points ---------------------------------------------------
+
+
+@dataclasses.dataclass
+class LoweredEntry:
+    """One hot entry point, lowered (and maybe compiled) for checking.
+
+    ``donated``: this entry's contract includes buffer donation (train
+    steps donate the state; serve forwards only donate off-CPU).
+    ``bf16_expected``: the entry was built under conv_bf16=True, so
+    CC002 applies. ``edge_pad`` is the padded edge count of the example
+    batch (the CC002 edge-stream scope)."""
+
+    name: str
+    lowered_text: str
+    compiled_text: Optional[str] = None
+    donated: bool = False
+    bf16_expected: bool = False
+    edge_pad: Optional[int] = None
+
+
+@dataclasses.dataclass
+class CheckSetup:
+    """Everything one graftcheck pass operates on."""
+
+    layout: str
+    data: int
+    fsdp: int
+    zero1: bool
+    entries: List[LoweredEntry]
+    #: (bucket_name, flattened (shape, dtype) signature) per serve bucket
+    bucket_signatures: List[Tuple[str, Tuple]]
+    #: (num_nodes, width) shapes the hot paths run the fused conv at
+    residency_shapes: List[Tuple[int, int]]
+    #: CC006 budget override in bytes (injection); None = the knob
+    vmem_budget_override: Optional[int] = None
+
+
+def parse_inject_spec(spec: Optional[str]) -> Set[str]:
+    """``cc001,cc004`` -> {"cc001", "cc004"}; unknown tokens raise so a
+    typo'd self-test fails loudly instead of silently passing."""
+    if not spec:
+        return set()
+    toks = {t.strip().lower() for t in spec.split(",") if t.strip()}
+    unknown = toks - set(INJECTABLE)
+    if unknown:
+        raise ValueError(
+            f"HYDRAGNN_INJECT_GRAFTCHECK: unknown contract(s) {sorted(unknown)}; "
+            f"valid: {', '.join(INJECTABLE)}"
+        )
+    return toks
+
+
+def active_injections() -> Set[str]:
+    from hydragnn_tpu.utils import knobs
+
+    return parse_inject_spec(knobs.get_str("HYDRAGNN_INJECT_GRAFTCHECK"))
+
+
+def _tiny_flagship(device_stack: int, conv_bf16: bool = False,
+                   model_type: Optional[str] = None):
+    """The ci.sh stage-5 miniature: flagship config + deterministic
+    graphs, small enough that lowering stays in the seconds range.
+    Returns (loader, nn_config, batch, model, variables)."""
+    from hydragnn_tpu.api import prepare_loaders_and_config
+    from hydragnn_tpu.data.synthetic import deterministic_graph_data
+    from hydragnn_tpu.flagship import flagship_config
+    from hydragnn_tpu.models.create import create_model_config
+    import jax
+
+    hidden = 1 if model_type == "CGCNN" else 8
+    cfg = flagship_config(
+        hidden_dim=hidden, num_conv_layers=2, batch_size=8, num_epoch=1
+    )
+    arch = cfg["NeuralNetwork"]["Architecture"]
+    if conv_bf16:
+        arch["conv_bf16"] = True
+    if model_type:
+        arch["model_type"] = model_type
+    if hidden < 2:
+        # flagship head widths scale off hidden_dim and hit zero at the
+        # width-1 CGCNN miniature; any small positive dims lower fine
+        for head in arch["output_heads"].values():
+            head["dim_headlayers"] = [4, 2]
+            if "dim_sharedlayers" in head:
+                head["dim_sharedlayers"] = 4
+    samples = deterministic_graph_data(
+        number_configurations=24,
+        unit_cell_x_range=(2, 3),
+        unit_cell_y_range=(2, 3),
+        unit_cell_z_range=(2, 3),
+        seed=0,
+    )
+    loader, _, _, config = prepare_loaders_and_config(
+        cfg, samples, device_stack=device_stack
+    )
+    nn = config["NeuralNetwork"]
+    batch = next(iter(loader))
+    example = batch
+    if device_stack > 1:
+        example = jax.tree_util.tree_map(lambda x: x[0], batch)
+    model, variables = create_model_config(nn, example)
+    return loader, nn, batch, model, variables
+
+
+def _layout_config(layout: str):
+    """Named CI layouts on the forced host mesh: ``dp`` = pure data
+    parallel over every device, ``fsdp2`` = fsdp=2 inside it."""
+    import jax
+
+    n = jax.device_count()
+    if layout == "dp":
+        return dict(data=n)
+    if layout == "fsdp2":
+        if n % 2:
+            raise ValueError(f"fsdp2 layout needs an even device count, got {n}")
+        return dict(data=n // 2, fsdp=2)
+    raise ValueError(f"unknown layout {layout!r} (expected dp or fsdp2)")
+
+
+def build_layout_setup(
+    layout: str,
+    inject: Optional[Set[str]] = None,
+    with_compile: bool = True,
+) -> CheckSetup:
+    """Lower (and, ``with_compile``, compile) the partitioned hot steps
+    under one named layout. Compilation is only needed for CC003 (the
+    SPMD partitioner inserts collectives at compile time) and the
+    executable half of CC005 — skip it when auditing other contracts."""
+    import jax
+
+    from hydragnn_tpu.parallel.partitioner import Partitioner
+    from hydragnn_tpu.train.optimizer import select_optimizer
+    from hydragnn_tpu.train.state import create_train_state
+
+    inject = inject or set()
+    part = Partitioner(**_layout_config(layout))
+    loader, nn, batch, model, variables = _tiny_flagship(
+        device_stack=jax.device_count()
+    )
+    part.attach_loader(loader)
+    tx = select_optimizer(nn["Training"])
+    state = part.shard_init(create_train_state(variables, tx))
+    placed = part.shard_batch(batch)
+
+    entries: List[LoweredEntry] = []
+    cfgp = part.config
+
+    step = part.shard_train_step(model, tx)
+    if "cc005" in inject:
+        # de-donated step: the outer jit drops the inner donation, the
+        # exact regression the r09 runtime gate caught in the wild
+        step_fn = jax.jit(lambda s, b: step(s, b))
+    elif "cc003" in inject and part.mesh is not None:
+        # layout-mismatched collective: a shard_map permute over the
+        # data axis — a collective the (data, fsdp) layout never emits
+        from functools import partial
+
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        ndata = part.mesh.shape.get("data", 1)
+        perm = [(i, (i + 1) % ndata) for i in range(ndata)]
+
+        @partial(
+            shard_map,
+            mesh=part.mesh,
+            in_specs=P(),
+            out_specs=P(),
+            check_rep=False,
+        )
+        def _leak(x):
+            return jax.lax.ppermute(x, "data", perm)
+
+        step_fn = jax.jit(
+            lambda s, b: (lambda out: (out[0], _leak(out[1]), out[2]))(step(s, b))
+        )
+    else:
+        step_fn = step
+    lowered = step_fn.lower(state, placed)
+    compiled_text = lowered.compile().as_text() if with_compile else None
+    entries.append(
+        LoweredEntry(
+            name=f"graftcheck/{layout}/train_step",
+            lowered_text=lowered.as_text(),
+            compiled_text=compiled_text,
+            donated=True,
+        )
+    )
+
+    eval_step = part.shard_eval_step(model)
+    if "cc001" in inject:
+        # planted host callback: the loss round-trips through python
+        import jax.numpy as jnp
+
+        def bad_eval(s, b):
+            loss, tasks = eval_step(s, b)
+            loss = jax.pure_callback(
+                lambda x: x, jax.ShapeDtypeStruct((), jnp.float32), loss
+            )
+            return loss, tasks
+
+        eval_lowered = jax.jit(bad_eval).lower(state, placed)
+    else:
+        eval_lowered = eval_step.lower(state, placed)
+    entries.append(
+        LoweredEntry(
+            name=f"graftcheck/{layout}/eval_step",
+            lowered_text=eval_lowered.as_text(),
+        )
+    )
+
+    stats_step = part.shard_stats_step(model)
+    entries.append(
+        LoweredEntry(
+            name=f"graftcheck/{layout}/stats_step",
+            lowered_text=stats_step.lower(state, placed).as_text(),
+        )
+    )
+
+    return CheckSetup(
+        layout=layout,
+        data=cfgp.data,
+        fsdp=cfgp.fsdp,
+        zero1=bool(cfgp.zero1),
+        entries=entries,
+        bucket_signatures=[],
+        residency_shapes=[],
+    )
+
+
+def build_global_setup(inject: Optional[Set[str]] = None) -> CheckSetup:
+    """Layout-independent entry points: the single-device scan epoch,
+    the bf16 conv forward (CC002's scope — CGCNN is the conv family
+    whose edge stream is matmul-shaped), and the serve bucket ladder
+    (CC004/CC006)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from hydragnn_tpu.train.optimizer import select_optimizer
+    from hydragnn_tpu.train.state import create_train_state, make_scan_epoch
+
+    inject = inject or set()
+    entries: List[LoweredEntry] = []
+
+    # scan epoch (single-device whole-epoch dispatch; donates state)
+    loader, nn, batch, model, variables = _tiny_flagship(device_stack=1)
+    tx = select_optimizer(nn["Training"])
+    state = create_train_state(variables, tx)
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), batch, batch)
+    order = jnp.arange(2, dtype=jnp.int32)
+    scan = make_scan_epoch(model, tx)
+    entries.append(
+        LoweredEntry(
+            name="graftcheck/global/scan_epoch",
+            lowered_text=scan.lower(state, stacked, order).as_text(),
+            donated=True,
+        )
+    )
+
+    # bf16 conv forward: CGCNN's decomposed edge-stream dots are where
+    # a silent f32 upcast costs bandwidth. The cc002 injection builds
+    # the model with the bf16 cast DROPPED while still claiming the
+    # contract — exactly the regression CC002 exists to catch.
+    _, nnc, cbatch, cmodel, cvars = _tiny_flagship(
+        device_stack=1,
+        conv_bf16=("cc002" not in inject),
+        model_type="CGCNN",
+    )
+    fwd = jax.jit(lambda v, b: cmodel.apply(v, b, train=False))
+    entries.append(
+        LoweredEntry(
+            name="graftcheck/global/conv_forward_bf16",
+            lowered_text=fwd.lower(cvars, cbatch).as_text(),
+            bf16_expected=True,
+            edge_pad=int(cbatch.senders.shape[0]),
+        )
+    )
+
+    # serve bucket ladder: lower the serving forward once per rung and
+    # record each executable signature (CC004); the pad shapes feed the
+    # CC006 residency audit.
+    from hydragnn_tpu.data.synthetic import deterministic_graph_data
+    from hydragnn_tpu.graph.batch import batch_graphs
+    from hydragnn_tpu.serve.buckets import build_bucket_ladder
+
+    # wider cells than the train miniature: the ladder needs real size
+    # spread or bucket_pad_plans dedupes it to one rung
+    samples = deterministic_graph_data(
+        number_configurations=24,
+        unit_cell_x_range=(2, 5),
+        unit_cell_y_range=(2, 5),
+        unit_cell_z_range=(2, 5),
+        seed=0,
+    )
+    buckets = build_bucket_ladder(samples, max_batch=4, num_buckets=3)
+    if "cc004" in inject and len(buckets) > 1:
+        # colliding plans: rung 1 re-uses rung 0's pad plan, so two
+        # buckets share one executable signature
+        b0, b1 = buckets[0], buckets[1]
+        buckets[1] = dataclasses.replace(
+            b1, node_pad=b0.node_pad, edge_pad=b0.edge_pad, graph_pad=b0.graph_pad
+        )
+    feat = int(batch.nodes.shape[-1])
+    serve_fwd = jax.jit(lambda v, b: model.apply(v, b, train=False))
+    signatures: List[Tuple[str, Tuple]] = []
+    hidden = int(nn["Architecture"]["hidden_dim"])
+    shapes: List[Tuple[int, int]] = []
+    for b in buckets:
+        # the server's warm-batch recipe (serve/server.py): one minimal
+        # graph matching the model's field spec, padded to the rung
+        g = {
+            "x": np.zeros((2, feat), dtype=np.float32),
+            "senders": np.zeros((1,), dtype=np.int32),
+            "receivers": np.ones((1,), dtype=np.int32),
+        }
+        if batch.pos is not None:
+            g["pos"] = np.zeros((2, batch.pos.shape[-1]), dtype=np.float32)
+        if batch.edge_attr is not None:
+            g["edge_attr"] = np.zeros(
+                (1, batch.edge_attr.shape[-1]), dtype=np.float32
+            )
+        warm = batch_graphs(
+            [g],
+            n_node_pad=b.node_pad,
+            n_edge_pad=b.edge_pad,
+            n_graph_pad=b.graph_pad,
+        )
+        low = serve_fwd.lower(variables, warm)
+        name = f"graftcheck/global/serve_bucket_{b.index}"
+        leaves = jax.tree_util.tree_leaves(warm)
+        sig = tuple(
+            (tuple(x.shape), str(x.dtype)) for x in leaves if hasattr(x, "shape")
+        )
+        signatures.append((name, sig))
+        entries.append(LoweredEntry(name=name, lowered_text=low.as_text()))
+        shapes.append((b.node_pad, hidden))
+
+    return CheckSetup(
+        layout="global",
+        data=1,
+        fsdp=1,
+        zero1=False,
+        entries=entries,
+        bucket_signatures=signatures,
+        residency_shapes=shapes,
+        vmem_budget_override=(4096 if "cc006" in inject else None),
+    )
+
+
+# -- the checks -------------------------------------------------------------
+
+
+def check_setup(
+    setup: CheckSetup, contracts: Optional[Iterable[str]] = None
+) -> List[Finding]:
+    """Run the requested contracts (default: all) over one setup."""
+    wanted = set(contracts) if contracts else set(CONTRACTS)
+    findings: List[Finding] = []
+
+    for e in setup.entries:
+        if "CC001" in wanted:
+            for marker in scan_host_transfers(e.lowered_text):
+                findings.append(
+                    _finding(
+                        "CC001",
+                        e.name,
+                        f"host transfer in lowered module: {marker} — the "
+                        "hot path must not round-trip through the host",
+                    )
+                )
+        if "CC002" in wanted and e.bf16_expected:
+            if count_bf16_values(e.lowered_text) == 0:
+                findings.append(
+                    _finding(
+                        "CC002",
+                        e.name,
+                        "conv_bf16 is set but the lowered module carries no "
+                        "bf16 values: the edge-stream casts were dropped",
+                    )
+                )
+            elif e.edge_pad:
+                for msg in scan_edge_f32_dots(e.lowered_text, e.edge_pad):
+                    findings.append(_finding("CC002", e.name, msg))
+        if "CC003" in wanted and e.compiled_text is not None:
+            colls = parse_collectives(e.compiled_text)
+            for msg in audit_collectives(
+                colls, setup.data, setup.fsdp, setup.zero1
+            ):
+                findings.append(_finding("CC003", e.name, msg))
+        if "CC004" in wanted and scan_dynamic_dims(e.lowered_text):
+            findings.append(
+                _finding(
+                    "CC004",
+                    e.name,
+                    "dynamic dimension (tensor<?xx...>) in lowered module: a "
+                    "shape-polymorphic leak defeats the bucket compile cache",
+                )
+            )
+        if "CC005" in wanted and e.donated:
+            if not scan_donation_markers(e.lowered_text):
+                findings.append(
+                    _finding(
+                        "CC005",
+                        e.name,
+                        "donated entry point has no buffer-donation marker in "
+                        "its lowered module: donation was dropped (r09 "
+                        "donation_check_failed, statically)",
+                    )
+                )
+            elif e.compiled_text is not None and not scan_compiled_aliasing(
+                e.compiled_text
+            ):
+                findings.append(
+                    _finding(
+                        "CC005",
+                        e.name,
+                        "lowered module declares donors but the executable's "
+                        "input_output_alias map is empty: donation did not land",
+                    )
+                )
+
+    if "CC004" in wanted and setup.bucket_signatures:
+        seen: Dict[Tuple, str] = {}
+        for name, sig in setup.bucket_signatures:
+            if sig in seen:
+                findings.append(
+                    _finding(
+                        "CC004",
+                        name,
+                        f"bucket signature collides with {seen[sig]}: the "
+                        "ladder must compile exactly one executable per rung",
+                    )
+                )
+            else:
+                seen[sig] = name
+
+    if "CC006" in wanted and setup.residency_shapes:
+        findings.extend(
+            check_vmem_budget(
+                setup.residency_shapes,
+                budget_bytes=setup.vmem_budget_override,
+                entry=f"graftcheck/{setup.layout}/fused_conv_residency",
+            )
+        )
+
+    return findings
+
+
+def check_vmem_budget(
+    shapes: Sequence[Tuple[int, int]],
+    budget_bytes: Optional[int] = None,
+    entry: str = "graftcheck/global/fused_conv_residency",
+) -> List[Finding]:
+    """CC006: the cross-layer resident conv stack's VMEM claim at every
+    hot-path (num_nodes, width) shape, from ``ops/fused_conv.py``'s own
+    residency arithmetic — no kernel ever executes. Also bounds the
+    configured budget by physical VMEM (a TPU core has ~16 MB and the
+    pipeline needs headroom; a budget above that is a config lie)."""
+    from hydragnn_tpu.ops.fused_conv import (
+        residency_vmem_budget_bytes,
+        residency_vmem_bytes,
+    )
+
+    budget = (
+        budget_bytes if budget_bytes is not None else residency_vmem_budget_bytes()
+    )
+    findings: List[Finding] = []
+    if budget > 16 * 2**20:
+        findings.append(
+            _finding(
+                "CC006",
+                entry,
+                f"HYDRAGNN_RESIDENCY_VMEM_MB grants {budget / 2**20:.1f} MB "
+                "but a TPU core has ~16 MB of VMEM: the budget over-promises",
+            )
+        )
+    for n, width in sorted(set(shapes)):
+        need = residency_vmem_bytes(n, width)
+        if need > budget:
+            findings.append(
+                _finding(
+                    "CC006",
+                    entry,
+                    f"resident conv stack at nodes={n} width={width} needs "
+                    f"{need / 2**20:.2f} MB VMEM > budget "
+                    f"{budget / 2**20:.2f} MB: the residency gate will "
+                    "silently fall back to the HBM path",
+                )
+            )
+    return findings
+
+
+def run_graftcheck(
+    layouts: Sequence[str] = ("dp", "fsdp2"),
+    contracts: Optional[Iterable[str]] = None,
+    inject: Optional[Set[str]] = None,
+) -> List[Finding]:
+    """The full pass ``tools/graftcheck.py`` drives: every requested
+    layout's partitioned steps plus the layout-independent entries,
+    checked under the requested contracts. Compilation (the expensive
+    arm) only happens when CC003 or CC005 are in scope."""
+    if inject is None:
+        inject = active_injections()
+    wanted = set(contracts) if contracts else set(CONTRACTS)
+    unknown = wanted - set(CONTRACTS)
+    if unknown:
+        raise ValueError(f"unknown contract id(s): {sorted(unknown)}")
+    with_compile = bool(wanted & {"CC003", "CC005"})
+    findings: List[Finding] = []
+    for layout in layouts:
+        setup = build_layout_setup(layout, inject=inject, with_compile=with_compile)
+        findings.extend(check_setup(setup, wanted))
+    setup = build_global_setup(inject=inject)
+    findings.extend(check_setup(setup, wanted))
+    findings.sort(key=lambda f: (f.path, f.rule, f.message))
+    return findings
+
+
+# -- in-run manifest stamping ----------------------------------------------
+
+
+def contract_block(
+    lowered_text: Optional[str] = None,
+    *,
+    donated: bool = False,
+    conv_bf16: bool = False,
+    edge_pad: Optional[int] = None,
+    compiled_text: Optional[str] = None,
+    data: int = 1,
+    fsdp: int = 1,
+    zero1: bool = False,
+    residency_shapes: Optional[Sequence[Tuple[int, int]]] = None,
+) -> Dict[str, Any]:
+    """The ``graftcheck`` block a run stamps into its flight manifest:
+    the cheap static contracts, checked against the run's OWN lowered
+    step (train/loop.py reuses the module it already lowers for the
+    hardware ledger; bench.py and bench_serve.py do the same), so every
+    recorded run says which contracts its executables passed.
+
+    Contracts whose evidence is not available in-run (no compiled HLO,
+    no bf16 config) report ``not_checked`` with the reason — an honest
+    manifest beats a hollow green."""
+    contracts: Dict[str, Dict[str, Any]] = {}
+    violations: List[str] = []
+
+    def mark(cid: str, status: str, detail: str = "") -> None:
+        contracts[cid] = {"status": status}
+        if detail:
+            contracts[cid]["detail"] = detail
+
+    if lowered_text is None:
+        for cid in CONTRACTS:
+            mark(cid, "not_checked", "no lowered module available")
+        return {
+            "schema": SCHEMA_VERSION,
+            "contracts": contracts,
+            "violations": violations,
+        }
+
+    markers = scan_host_transfers(lowered_text)
+    if markers:
+        mark("CC001", "fail", ", ".join(markers))
+        violations.append(f"CC001: host transfer ({', '.join(markers)})")
+    else:
+        mark("CC001", "pass")
+
+    if not conv_bf16:
+        mark("CC002", "not_checked", "conv_bf16 off")
+    else:
+        bad = scan_edge_f32_dots(lowered_text, edge_pad) if edge_pad else []
+        if count_bf16_values(lowered_text) == 0:
+            mark("CC002", "fail", "no bf16 values in lowered module")
+            violations.append("CC002: conv_bf16 set but no bf16 compute")
+        elif bad:
+            mark("CC002", "fail", bad[0])
+            violations.append(f"CC002: {bad[0]}")
+        else:
+            mark("CC002", "pass")
+
+    if compiled_text is None:
+        mark("CC003", "not_checked", "no compiled HLO in-run")
+    else:
+        problems = audit_collectives(
+            parse_collectives(compiled_text), data, fsdp, zero1
+        )
+        if problems:
+            mark("CC003", "fail", problems[0])
+            violations.extend(f"CC003: {p}" for p in problems)
+        else:
+            mark("CC003", "pass")
+
+    mark("CC004", "not_checked", "serve-ladder contract; see tools/graftcheck.py")
+
+    if not donated:
+        mark("CC005", "not_checked", "entry point does not donate")
+    elif not scan_donation_markers(lowered_text):
+        mark("CC005", "fail", "no donation marker in lowered module")
+        violations.append("CC005: donation dropped from lowered step")
+    elif compiled_text is not None and not scan_compiled_aliasing(compiled_text):
+        mark("CC005", "fail", "executable input_output_alias empty")
+        violations.append("CC005: donation did not land in the executable")
+    else:
+        mark("CC005", "pass")
+
+    if residency_shapes:
+        probs = check_vmem_budget(residency_shapes)
+        if probs:
+            mark("CC006", "fail", probs[0].message)
+            violations.extend(f"CC006: {p.message}" for p in probs)
+        else:
+            mark("CC006", "pass")
+    else:
+        mark("CC006", "not_checked", "no resident-conv shapes in this run")
+
+    return {
+        "schema": SCHEMA_VERSION,
+        "contracts": contracts,
+        "violations": violations,
+    }

@@ -261,11 +261,15 @@ class JitInLoop(Rule):
     out of the loop or reuse a cached executable. Lexical check: any
     jit/pjit call (including via ``functools.partial``) whose nearest
     enclosing statement sits in a ``for``/``while`` body.
+
+    Promoted warning -> error once the tree reached zero findings: a
+    recompile-per-iteration hazard is never acceptable on the hot path,
+    and the empty committed baseline keeps it that way.
     """
 
     id = "HG004"
     name = "jit-in-loop"
-    severity = "warning"
+    severity = "error"
     description = "jax.jit/pjit called inside a for/while body (recompile hazard)"
     exclude = ("tests/", "examples/", "lint/")
 

@@ -13,6 +13,13 @@ run that died mid-way still has every event up to the crash: each line
 is written and flushed atomically-enough that the tail is at worst one
 truncated line, which the reader skips).
 
+Every ``run_start`` manifest additionally carries a ``graftcheck``
+block — the compiled-IR contract audit (docs/LINT.md CC rules) stamped
+by the emitter at run start: ``{"schema": .., "contracts": {CC001:
+pass|fail|not_checked + why, ...}, "violations": [..]}``. Emitters that
+never lower an executable (the restart supervisor) stamp an honest
+all-``not_checked`` block so the key is universal.
+
 Schema (``SCHEMA_VERSION``): every event is one JSON object per line
 with ``v`` (schema version), ``kind``, ``t`` (unix seconds), ``rank``;
 kind-specific required fields are in ``_REQUIRED``. Validate with

@@ -1298,7 +1298,13 @@ def residency_vmem_budget_bytes() -> int:
 def residency_vmem_bytes(num_nodes: int, width: int) -> int:
     """Estimated VMEM footprint of the resident stack kernel for a
     given gather-table size — the decision rule documented in
-    docs/PERF.md r08. Dominated by the ping-pong feature pair."""
+    docs/PERF.md r08. Dominated by the ping-pong feature pair.
+
+    graftcheck contract CC006 (docs/LINT.md) re-derives this estimate
+    from the entry point's shapes and fails CI when it exceeds the
+    ``HYDRAGNN_RESIDENCY_VMEM_MB`` budget — or when the budget itself
+    over-promises physical VMEM — so keep this arithmetic and
+    ``hydragnn_tpu/lint/ir.py::check_vmem_budget`` telling one story."""
     hp = _pad128(width)
     n_pad_out = ((num_nodes + BN - 1) // BN) * BN
     n_res = max(((num_nodes + ALIGN - 1) // ALIGN) * ALIGN, BW, n_pad_out)

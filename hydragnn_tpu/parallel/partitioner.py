@@ -37,7 +37,12 @@ gradients still ``pmean`` over all of them, so an ``(data=2, fsdp=4)``
 run computes what the ``data=8`` run computes (modulo collective
 reduction order). Correctness is pinned on a forced multi-device CPU
 host mesh (``XLA_FLAGS=--xla_force_host_platform_device_count=8``) in
-``tests/test_partitioner.py``. See docs/PARALLELISM.md.
+``tests/test_partitioner.py``. The collective set this layout implies —
+all-reduce over ``data``/``data×fsdp``, all-gather/reduce-scatter only
+over ``fsdp`` (or ``data`` for ZeRO-1), nothing else — is machine-checked
+from the compiled step's HLO by graftcheck contract CC003
+(docs/LINT.md), so a change here that leaks a new collective fails CI
+before it costs wire time. See docs/PARALLELISM.md.
 """
 
 from __future__ import annotations

@@ -313,6 +313,31 @@ class ModelServer:
             raise ServerClosed("server was stopped; build a new one")
         t0 = time.monotonic()
         self._cache.warmup(self.buckets)
+        # graftcheck contract block (lint/ir.py, docs/LINT.md CC rules):
+        # audit the serve forward's OWN lowered module (smallest bucket)
+        # so the serving manifest says which compiled-IR contracts its
+        # ladder passed. One trace, no compile; HYDRAGNN_GRAFTCHECK=0
+        # skips it and any failure degrades to not_checked.
+        from hydragnn_tpu.lint.ir import contract_block
+
+        graftcheck_block = contract_block(None)
+        if knobs.get_bool("HYDRAGNN_GRAFTCHECK", True):
+            try:
+                _b0 = self.buckets[0]
+                _pcfg = self.partitioner.config
+                graftcheck_block = contract_block(
+                    self.served.forward.lower(
+                        self.served.variables, self._build_warm_batch(_b0)
+                    ).as_text(),
+                    donated=False,  # serve forwards are donation-free
+                    conv_bf16=bool(getattr(self.served.cfg, "conv_bf16", False)),
+                    edge_pad=int(_b0.edge_pad),
+                    data=int(_pcfg.data),
+                    fsdp=int(_pcfg.fsdp),
+                    zero1=bool(getattr(_pcfg, "zero1", False)),
+                )
+            except Exception:
+                pass
         self.flight.start_run(
             {
                 "mode": "serve",
@@ -338,6 +363,9 @@ class ModelServer:
                 "parallel": self.partitioner.manifest(
                     variables=self.served.variables
                 ),
+                # which compiled-IR contracts (docs/LINT.md CC rules)
+                # the serve forward's lowered module passed
+                "graftcheck": graftcheck_block,
             }
         )
         from hydragnn_tpu.resilience.supervisor import SupervisorPolicy

@@ -923,6 +923,48 @@ def train_validate_test(
             "available": False,
             "reason": "caller passed no partitioner",
         }
+    # graftcheck contract block (lint/ir.py, docs/LINT.md CC rules): the
+    # run's OWN train step, lowered and audited for the static contracts
+    # the full checker (tools/graftcheck.py) gates in CI — so every
+    # recorded run says which contracts its executable passed. Costs one
+    # trace, no compile; HYDRAGNN_GRAFTCHECK=0 skips the lowering, and
+    # any failure degrades to an all-not_checked block (stamping is
+    # telemetry and must never take the run down).
+    from hydragnn_tpu.lint.ir import contract_block
+
+    graftcheck_block = contract_block(None)
+    if telemetry_on and knobs.get_bool("HYDRAGNN_GRAFTCHECK", True):
+        try:
+            # peek_batch builds the first batch without counting as an
+            # __iter__ draw, so loader wrappers that count epochs
+            # (schedulers, fault harnesses) are unperturbed
+            _gc_example = (
+                train_loader.peek_batch()
+                if hasattr(train_loader, "peek_batch")
+                else next(iter(train_loader))
+            )
+            _gc_args = (
+                (state, _gc_example, jnp.zeros((), jnp.int32))
+                if guard_nonfinite
+                else (state, _gc_example)
+            )
+            _pcfg = partitioner.config if partitioner is not None else None
+            graftcheck_block = contract_block(
+                train_step.lower(*_gc_args).as_text(),
+                donated=True,
+                conv_bf16=bool(getattr(cfg, "conv_bf16", False)),
+                edge_pad=int(_gc_example.senders.shape[-1]),
+                data=int(getattr(_pcfg, "data", 1) or 1),
+                fsdp=int(getattr(_pcfg, "fsdp", 1) or 1),
+                zero1=bool(getattr(_pcfg, "zero1", False)),
+                residency_shapes=(
+                    [(int(_gc_example.nodes.shape[-2]), int(cfg.hidden_dim))]
+                    if getattr(cfg, "conv_residency", False)
+                    else None
+                ),
+            )
+        except Exception:
+            pass
     flight.start_run(
         {
             "run": log_name,
@@ -964,6 +1006,10 @@ def train_validate_test(
             # the hardware-efficiency ledger's run-constant half: what
             # one compiled train step costs and what the chip could do
             "hw_cost": ledger.manifest() if ledger is not None else {"available": False},
+            # which compiled-IR contracts (docs/LINT.md CC rules) this
+            # run's own lowered step passed — the in-run face of
+            # tools/graftcheck.py
+            "graftcheck": graftcheck_block,
         }
     )
     if resumed_from is not None:

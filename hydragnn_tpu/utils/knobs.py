@@ -79,10 +79,20 @@ KNOBS: Dict[str, Knob] = {
            "LRU size bound for the executable cache directory."),
         _K("HYDRAGNN_FULL_MATRIX", "flag", None, "tests/test_train_matrix.py",
            "Opt into the full 7-model acceptance matrix (~15 min)."),
+        _K("HYDRAGNN_GRAFTCHECK", "bool", "1", "train/loop.py",
+           "Stamp the compiled-IR contract block (lint/ir.py CC001-CC006) "
+           "into every run_start flight manifest; 0 skips the lowering."),
+        _K("HYDRAGNN_GRAFTCHECK_LAYOUTS", "str", "dp,fsdp2",
+           "tools/graftcheck.py",
+           "Comma-separated named Partitioner layouts the graftcheck CLI "
+           "audits by default (dp = pure data parallel, fsdp2 = fsdp=2)."),
         _K("HYDRAGNN_INJECT_DONATION_CHECK_FAIL", "flag", None,
            "utils/exec_cache.py",
            "Force the donation round-trip gate to report failure: the "
            "cached donated executable is evicted and live-compiled."),
+        _K("HYDRAGNN_INJECT_GRAFTCHECK", "spec", None, "lint/ir.py",
+           "cc001..cc006 (comma-separated): plant one real compiled-IR "
+           "violation per named contract for the graftcheck self-test."),
         _K("HYDRAGNN_INJECT_KILL_CHECKPOINT", "spec", None,
            "resilience/inject.py",
            "K: during the K-th checkpoint save, write a torn file and "

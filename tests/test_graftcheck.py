@@ -1,0 +1,363 @@
+"""graftcheck (hydragnn_tpu/lint/ir.py): per-contract true-positive /
+near-miss fixtures over the pure text walkers, deterministic tiny-jax
+lowering fixtures, the injection spec, baseline round-trip, the in-run
+``contract_block``, and the (slow) meta-test that the shipped tree
+passes all six contracts under both CI layouts.
+
+The text-walker fixtures are golden StableHLO/HLO snippets shaped like
+what jax 0.4.x emits — the walkers are pure string functions, so the
+fixtures pin the exact textual forms each contract keys on (and the
+near-misses pin what must NOT trigger it).
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from hydragnn_tpu.lint import ir
+from hydragnn_tpu.lint.core import load_baseline, write_baseline
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------- CC001 walkers
+
+
+class TestHostTransferScan:
+    def test_flags_host_callback_custom_call(self):
+        text = (
+            'stablehlo.custom_call @xla_python_cpu_callback(%arg0) '
+            '{api_version = 2 : i32} : (tensor<f32>) -> tensor<f32>'
+        )
+        assert ir.scan_host_transfers(text) == ["xla_python_cpu_callback"]
+
+    def test_flags_infeed(self):
+        assert ir.scan_host_transfers(
+            '"stablehlo.infeed"(%tok) : (!stablehlo.token) -> tensor<8xf32>'
+        ) == ["stablehlo.infeed"]
+
+    def test_clean_module_is_clean(self):
+        # a custom_call that is NOT a host callback (Sharding, pallas)
+        # must not trigger — the near-miss the r05 incident teaches
+        text = (
+            'stablehlo.custom_call @Sharding(%0) : (tensor<8xf32>) -> tensor<8xf32>\n'
+            'stablehlo.custom_call @tpu_custom_call(%1) {backend_config = ""}'
+        )
+        assert ir.scan_host_transfers(text) == []
+
+    def test_real_pure_callback_lowering_is_caught(self):
+        # deterministic tiny lowering: jax.pure_callback must land one
+        # of the registered marker strings in the StableHLO text
+        def f(x):
+            return jax.pure_callback(
+                lambda v: v, jax.ShapeDtypeStruct((), jnp.float32), x
+            )
+
+        text = jax.jit(f).lower(jnp.float32(1.0)).as_text()
+        assert ir.scan_host_transfers(text)
+
+    def test_real_clean_lowering_is_clean(self):
+        text = jax.jit(lambda x: x * 2).lower(jnp.float32(1.0)).as_text()
+        assert ir.scan_host_transfers(text) == []
+
+
+# ------------------------------------------------------- CC002 walkers
+
+
+class TestEdgeDtypeScan:
+    EDGE_PAD = 120
+
+    def test_flags_all_f32_edge_dot(self):
+        text = (
+            "%3 = stablehlo.dot_general %1, %2, contracting_dims = [1] x [0] "
+            ": (tensor<120x16xf32>, tensor<16x32xf32>) -> tensor<120x32xf32>"
+        )
+        bad = ir.scan_edge_f32_dots(text, self.EDGE_PAD)
+        assert len(bad) == 1 and "120x16" in bad[0]
+
+    def test_bf16_edge_dot_is_clean(self):
+        # the contract: STREAMED operands bf16; f32 accumulation fine
+        text = (
+            "%3 = stablehlo.dot_general %1, %2, contracting_dims = [1] x [0] "
+            ": (tensor<120x16xbf16>, tensor<16x32xbf16>) -> tensor<120x32xf32>"
+        )
+        assert ir.scan_edge_f32_dots(text, self.EDGE_PAD) == []
+
+    def test_node_level_f32_dot_is_clean(self):
+        # near-miss: an f32 dot whose leading dim is the NODE pad —
+        # head/node dots legitimately stay f32
+        text = (
+            "%3 = stablehlo.dot_general %1, %2, contracting_dims = [1] x [0] "
+            ": (tensor<64x16xf32>, tensor<16x32xf32>) -> tensor<64x32xf32>"
+        )
+        assert ir.scan_edge_f32_dots(text, self.EDGE_PAD) == []
+
+    def test_bf16_presence_counter(self):
+        assert ir.count_bf16_values("tensor<8x4xbf16> tensor<8xbf16>") == 2
+        assert ir.count_bf16_values("tensor<8x4xf32>") == 0
+
+
+# ------------------------------------------------------- CC003 walkers
+
+
+class TestCollectiveAudit:
+    def test_parses_iota_form(self):
+        text = (
+            "  %ag = bf16[2,64] all-gather(%p), replica_groups=[4,2]<=[8], "
+            "dimensions={0}"
+        )
+        (c,) = ir.parse_collectives(text)
+        assert (c.kind, c.group_count, c.group_size) == ("all-gather", 4, 2)
+
+    def test_parses_explicit_form(self):
+        text = "  %ar = f32[] all-reduce(%l), replica_groups={{0,1,2,3,4,5,6,7}}"
+        (c,) = ir.parse_collectives(text)
+        assert (c.kind, c.group_count, c.group_size) == ("all-reduce", 1, 8)
+
+    def test_flags_gather_in_pure_dp(self):
+        colls = [ir.Collective("all-gather", 1, 8)]
+        problems = ir.audit_collectives(colls, data=8, fsdp=1)
+        assert problems and "pure-DP" in problems[0]
+
+    def test_flags_permute_always(self):
+        colls = [ir.Collective("collective-permute", None, None)]
+        assert ir.audit_collectives(colls, data=8, fsdp=1)
+
+    def test_flags_wrong_gather_group_size(self):
+        colls = [ir.Collective("all-gather", 2, 4)]
+        problems = ir.audit_collectives(colls, data=4, fsdp=2)
+        assert problems and "refunds FSDP" in problems[0]
+
+    def test_expected_fsdp_pattern_is_clean(self):
+        # near-miss: exactly the layout-implied set — fsdp gathers of
+        # size fsdp, batch-axis all-reduce, fsdp reduce-scatter
+        colls = [
+            ir.Collective("all-gather", 4, 2),
+            ir.Collective("all-reduce", 1, 8),
+            ir.Collective("all-reduce", 2, 4),
+            ir.Collective("reduce-scatter", 4, 2),
+        ]
+        assert ir.audit_collectives(colls, data=4, fsdp=2) == []
+
+    def test_zero1_reduce_scatter_is_clean(self):
+        colls = [ir.Collective("reduce-scatter", 1, 8)]
+        assert ir.audit_collectives(colls, data=8, fsdp=1, zero1=True) == []
+        assert ir.audit_collectives(colls, data=8, fsdp=1, zero1=False)
+
+
+# ------------------------------------------------------- CC004 walkers
+
+
+class TestBucketStability:
+    def test_flags_dynamic_dim(self):
+        assert ir.scan_dynamic_dims("func @f(%a: tensor<?x128xf32>)")
+        assert ir.scan_dynamic_dims("-> tensor<12x?xf32>")
+
+    def test_static_dims_are_clean(self):
+        assert not ir.scan_dynamic_dims("func @f(%a: tensor<12x128xf32>)")
+
+    def _setup(self, signatures):
+        return ir.CheckSetup(
+            layout="global",
+            data=1,
+            fsdp=1,
+            zero1=False,
+            entries=[],
+            bucket_signatures=signatures,
+            residency_shapes=[],
+        )
+
+    def test_flags_signature_collision(self):
+        sig = ((( 64, 8), "float32"),)
+        findings = ir.check_setup(
+            self._setup([("b0", sig), ("b1", sig)]), ["CC004"]
+        )
+        assert [f.rule for f in findings] == ["CC004"]
+        assert "collides" in findings[0].message
+
+    def test_distinct_signatures_are_clean(self):
+        findings = ir.check_setup(
+            self._setup(
+                [("b0", (((64, 8), "f32"),)), ("b1", (((128, 8), "f32"),))]
+            ),
+            ["CC004"],
+        )
+        assert findings == []
+
+
+# ------------------------------------------------------- CC005 walkers
+
+
+class TestDonationScan:
+    def test_flags_both_marker_spellings(self):
+        assert ir.scan_donation_markers("%arg0 {tf.aliasing_output = 0 : i32}")
+        assert ir.scan_donation_markers("%arg0 {jax.buffer_donor = true}")
+
+    def test_unmarked_module_fails(self):
+        assert not ir.scan_donation_markers(
+            "func.func public @main(%arg0: tensor<8xf32>)"
+        )
+
+    def test_compiled_aliasing(self):
+        assert ir.scan_compiled_aliasing(
+            "HloModule jit_step, input_output_alias={ {0}: (0, {}, may-alias) }"
+        )
+        # near-miss: an EMPTY aliasing map means donation did not land
+        assert not ir.scan_compiled_aliasing(
+            "HloModule jit_step, input_output_alias={}"
+        )
+
+    def test_real_donated_lowering_carries_marker(self):
+        step = jax.jit(lambda s, b: s + b, donate_argnums=(0,))
+        text = step.lower(jnp.ones((4,)), jnp.ones((4,))).as_text()
+        assert ir.scan_donation_markers(text)
+        undonated = jax.jit(lambda s, b: s + b)
+        assert not ir.scan_donation_markers(
+            undonated.lower(jnp.ones((4,)), jnp.ones((4,))).as_text()
+        )
+
+
+# ------------------------------------------------------- CC006 budget
+
+
+class TestVmemBudget:
+    def test_flags_over_budget_shape(self):
+        findings = ir.check_vmem_budget([(4096, 128)], budget_bytes=4096)
+        assert findings and findings[0].rule == "CC006"
+        assert "fall back" in findings[0].message
+
+    def test_within_budget_is_clean(self):
+        assert ir.check_vmem_budget([(64, 8)], budget_bytes=12 * 2**20) == []
+
+    def test_flags_overpromised_budget(self):
+        # a >16MB budget is a config lie even when every shape fits it
+        findings = ir.check_vmem_budget([(64, 8)], budget_bytes=64 * 2**20)
+        assert [f.rule for f in findings] == ["CC006"]
+        assert "over-promises" in findings[0].message
+
+
+# ----------------------------------------------------- injection knob
+
+
+class TestInjectionSpec:
+    def test_parse_valid_spec(self):
+        assert ir.parse_inject_spec("cc001, CC004") == {"cc001", "cc004"}
+        assert ir.parse_inject_spec(None) == set()
+        assert ir.parse_inject_spec("") == set()
+
+    def test_unknown_token_raises(self):
+        with pytest.raises(ValueError, match="cc099"):
+            ir.parse_inject_spec("cc001,cc099")
+
+    def test_active_injections_reads_registered_knob(self, monkeypatch):
+        # satellite coverage: the graftcheck injection knob is part of
+        # the HYDRAGNN_INJECT_* family active_injections() reports
+        from hydragnn_tpu.utils import knobs
+
+        monkeypatch.setenv("HYDRAGNN_INJECT_GRAFTCHECK", "cc003")
+        assert ir.active_injections() == {"cc003"}
+        assert "HYDRAGNN_INJECT_GRAFTCHECK" in knobs.active_injections()
+
+    def test_no_injection_by_default(self, monkeypatch):
+        monkeypatch.delenv("HYDRAGNN_INJECT_GRAFTCHECK", raising=False)
+        assert ir.active_injections() == set()
+
+
+# --------------------------------------------------- baseline round-trip
+
+
+class TestBaselineRoundTrip:
+    def test_findings_fingerprint_through_baseline(self, tmp_path):
+        f1 = ir._finding("CC001", "graftcheck/dp/train_step", "host transfer: x")
+        f2 = ir._finding("CC005", "graftcheck/dp/train_step", "no donation marker")
+        path = str(tmp_path / "baseline.json")
+        write_baseline(path, [f1])
+        grandfathered = load_baseline(path)
+        assert f1.fingerprint() in grandfathered
+        assert f2.fingerprint() not in grandfathered
+        # the CLI's filter semantics: grandfathered findings drop
+        remaining = [
+            f for f in (f1, f2) if f.fingerprint() not in grandfathered
+        ]
+        assert remaining == [f2]
+
+    def test_committed_baseline_is_empty(self):
+        with open(os.path.join(REPO_ROOT, "tools", "graftcheck_baseline.json")) as fh:
+            data = json.load(fh)
+        assert data["findings"] == [], (
+            "tools/graftcheck_baseline.json must stay empty — the shipped "
+            "tree passes every CC contract"
+        )
+
+
+# ----------------------------------------------------- contract_block
+
+
+class TestContractBlock:
+    def test_no_module_is_all_not_checked(self):
+        block = ir.contract_block(None)
+        assert block["schema"] == ir.SCHEMA_VERSION
+        assert set(block["contracts"]) == set(ir.CONTRACTS)
+        assert all(
+            c["status"] == "not_checked" for c in block["contracts"].values()
+        )
+        assert block["violations"] == []
+
+    def test_clean_donated_module_passes(self):
+        text = "func.func public @main(%arg0 {jax.buffer_donor = true})"
+        block = ir.contract_block(text, donated=True)
+        assert block["contracts"]["CC001"]["status"] == "pass"
+        assert block["contracts"]["CC005"]["status"] == "pass"
+        assert block["contracts"]["CC002"]["status"] == "not_checked"
+        assert block["violations"] == []
+
+    def test_violations_are_reported(self):
+        text = (
+            "stablehlo.custom_call @xla_python_cpu_callback(%x)\n"
+            "func.func public @main(%arg0: tensor<8xf32>)"
+        )
+        block = ir.contract_block(text, donated=True)
+        assert block["contracts"]["CC001"]["status"] == "fail"
+        assert block["contracts"]["CC005"]["status"] == "fail"
+        assert len(block["violations"]) == 2
+
+    def test_compiled_text_enables_cc003(self):
+        compiled = (
+            "HloModule jit_step, input_output_alias={ {0}: (0, {}) }\n"
+            "  %p = f32[8] collective-permute(%x), "
+            "source_target_pairs={{0,1}}\n"
+        )
+        block = ir.contract_block(
+            "tf.aliasing_output", donated=True, compiled_text=compiled, data=8
+        )
+        assert block["contracts"]["CC003"]["status"] == "fail"
+        assert any("CC003" in v for v in block["violations"])
+
+
+# --------------------------------------------------- shipped-tree meta
+
+
+@pytest.mark.slow
+class TestShippedTree:
+    """The acceptance meta-tests: the shipped tree passes all six
+    contracts under both CI layouts, and each injection is rejected by
+    exactly its own contract. ci.sh's graftcheck stage runs the same
+    proof from the CLI; these stay importable for full (non-tier-1)
+    pytest runs."""
+
+    def test_clean_under_both_layouts(self):
+        findings = ir.run_graftcheck(
+            layouts=("dp", "fsdp2"), contracts=None, inject=set()
+        )
+        assert findings == [], "\n".join(f.render() for f in findings)
+
+    @pytest.mark.parametrize("cc", sorted(ir.INJECTABLE))
+    def test_each_injection_is_rejected(self, cc):
+        findings = ir.run_graftcheck(
+            layouts=("dp",), contracts=[cc.upper()], inject={cc}
+        )
+        assert findings, f"injection {cc} was not rejected"
+        assert {f.rule for f in findings} == {cc.upper()}

@@ -218,7 +218,9 @@ class TestJitInLoop:
             ["HG004"],
         )
         assert [f.rule for f in findings] == ["HG004"]
-        assert findings[0].severity == "warning"
+        # promoted warning -> error (ISSUE 13): a recompile-per-iteration
+        # hazard on the hot path fails CI outright
+        assert findings[0].severity == "error"
 
     def test_hoisted_jit_is_clean(self, tmp_path):
         findings = lint(
@@ -597,7 +599,9 @@ class TestCli:
         for rid in ("HG001", "HG008"):
             assert rid in listed
 
-    def test_warning_rule_passes_without_strict(self, tmp_path):
+    def test_promoted_hg004_fails_without_strict(self, tmp_path):
+        # HG004 was promoted warning -> error (ISSUE 13): a jit built per
+        # loop iteration now fails CI with or without --strict
         fixture = tmp_path / "warn.py"
         fixture.write_text(
             "import jax\n\n\ndef run(fns, x):\n"
@@ -607,7 +611,7 @@ class TestCli:
             "    return out\n"
         )
         rc = CLI.main([str(fixture), "--rule", "HG004", "--no-baseline"])
-        assert rc == 0  # warning severity: non-strict passes
+        assert rc == 1
         rc = CLI.main(
             [str(fixture), "--rule", "HG004", "--no-baseline", "--strict"]
         )

@@ -1,0 +1,167 @@
+#!/usr/bin/env python3
+"""graftcheck — the compiled-IR contract checker (docs/LINT.md CC rules).
+
+Lowers the repo's hot entry points (train step, scan epoch, eval/stats
+steps, serve bucket ladder, bf16 conv forward) under the named
+Partitioner layouts and audits the StableHLO / post-SPMD HLO for the
+six CC contracts (hydragnn_tpu/lint/ir.py). Where graftlint proves the
+SOURCE, graftcheck proves the EXECUTABLE — on any container, for any
+backend target, without running a single step.
+
+Usage:
+    python tools/graftcheck.py                         # dp + fsdp2, all contracts
+    python tools/graftcheck.py --layout dp             # one layout
+    python tools/graftcheck.py --contract CC001 --contract CC005
+    python tools/graftcheck.py --json /tmp/graftcheck.json
+    python tools/graftcheck.py --list-contracts
+
+Exit codes: 0 clean, 1 findings, 2 usage/internal error.
+
+Self-test: HYDRAGNN_INJECT_GRAFTCHECK=cc003 plants a layout-mismatched
+collective (and cc001/cc002/cc004/cc005/cc006 their own violations);
+ci.sh asserts each contract individually rejects its injection.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# The audit reasons about an 8-device mesh the way CI does (ci.sh
+# partitioner smoke): pin the forced host platform BEFORE jax loads.
+# A real accelerator run would hide host-platform forcing behind the
+# backend, so only force when nothing else chose a platform.
+if "JAX_PLATFORMS" not in os.environ:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, REPO_ROOT)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="graftcheck", description=__doc__.splitlines()[0]
+    )
+    parser.add_argument(
+        "--layout",
+        action="append",
+        default=None,
+        metavar="NAME",
+        help="named Partitioner layout to audit (dp | fsdp2; repeatable; "
+        "default: HYDRAGNN_GRAFTCHECK_LAYOUTS)",
+    )
+    parser.add_argument(
+        "--contract",
+        action="append",
+        default=None,
+        metavar="CCNNN",
+        help="run only this contract (repeatable; default: all six)",
+    )
+    parser.add_argument(
+        "--json",
+        metavar="PATH",
+        default=None,
+        help="also write findings as JSON ('-' for stdout)",
+    )
+    parser.add_argument(
+        "--baseline",
+        metavar="PATH",
+        default=os.path.join("tools", "graftcheck_baseline.json"),
+        help="baseline file of grandfathered findings "
+        "(default: tools/graftcheck_baseline.json)",
+    )
+    parser.add_argument(
+        "--no-baseline", action="store_true", help="ignore the baseline file"
+    )
+    parser.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="write current findings to the baseline file and exit 0",
+    )
+    parser.add_argument(
+        "--list-contracts", action="store_true", help="print the CC catalog"
+    )
+    args = parser.parse_args(argv)
+
+    from hydragnn_tpu.lint import ir
+    from hydragnn_tpu.lint.core import load_baseline, write_baseline
+    from hydragnn_tpu.utils import knobs
+
+    if args.list_contracts:
+        for cid, (name, desc) in ir.CONTRACTS.items():
+            print(f"{cid}  {name:24s} {desc}")
+        return 0
+
+    layouts = args.layout or [
+        t.strip()
+        for t in knobs.get_str("HYDRAGNN_GRAFTCHECK_LAYOUTS", "dp,fsdp2").split(",")
+        if t.strip()
+    ]
+    contracts = None
+    if args.contract:
+        contracts = {c.upper() for c in args.contract}
+        unknown = contracts - set(ir.CONTRACTS)
+        if unknown:
+            print(
+                f"graftcheck: unknown contract id(s): {sorted(unknown)}",
+                file=sys.stderr,
+            )
+            return 2
+
+    try:
+        findings = ir.run_graftcheck(layouts=layouts, contracts=contracts)
+    except ValueError as exc:
+        print(f"graftcheck: {exc}", file=sys.stderr)
+        return 2
+
+    baseline_path = (
+        args.baseline
+        if os.path.isabs(args.baseline)
+        else os.path.join(REPO_ROOT, args.baseline)
+    )
+    if args.write_baseline:
+        write_baseline(baseline_path, findings)
+        print(f"graftcheck: wrote {len(findings)} finding(s) to {baseline_path}")
+        return 0
+    if not args.no_baseline:
+        grandfathered = load_baseline(baseline_path)
+        if grandfathered:
+            findings = [
+                f for f in findings if f.fingerprint() not in grandfathered
+            ]
+
+    for f in findings:
+        print(f.render())
+    if args.json:
+        payload = json.dumps(
+            {
+                "version": ir.SCHEMA_VERSION,
+                "layouts": layouts,
+                "count": len(findings),
+                "findings": [f.to_json() for f in findings],
+            },
+            indent=2,
+        )
+        if args.json == "-":
+            print(payload)
+        else:
+            with open(args.json, "w") as fh:
+                fh.write(payload + "\n")
+    if findings:
+        print(f"graftcheck: {len(findings)} contract violation(s)")
+        return 1
+    scope = ",".join(sorted(contracts)) if contracts else "CC001-CC006"
+    print(f"graftcheck: clean ({scope} over {'+'.join(layouts)} + global)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -6,7 +6,7 @@ Usage:
     python tools/graftlint.py --changed             # fast pre-commit loop
     python tools/graftlint.py --rule HG002 --strict hydragnn_tpu bench.py
     python tools/graftlint.py --json /tmp/findings.json
-    python tools/graftlint.py --artifacts           # validate BENCH_*.jsonl
+    python tools/graftlint.py --artifacts           # validate committed artifacts
     python tools/graftlint.py --list-rules
 
 Exit codes: 0 clean, 1 findings, 2 usage/internal error.
@@ -99,8 +99,9 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--artifacts",
         action="store_true",
-        help="validate committed flight artifacts (BENCH_*.jsonl) instead "
-        "of linting source",
+        help="validate committed machine artifacts (flight JSONLs + "
+        "BENCH_r*/SCALING_*/MULTICHIP_*/TUNE_TILES/BENCH_CI_BASELINE "
+        "JSON schemas) instead of linting source",
     )
     parser.add_argument(
         "--list-rules", action="store_true", help="print the rule catalog"
@@ -126,7 +127,7 @@ def main(argv=None) -> int:
         if findings:
             print(f"graftlint --artifacts: {len(findings)} problem(s)")
             return 1
-        print("graftlint --artifacts: all flight artifacts valid")
+        print("graftlint --artifacts: all committed artifacts valid")
         return 0
 
     rules = all_rules

@@ -90,7 +90,19 @@ def main(argv=None) -> int:
         strip_injection=not args.keep_injection,
     )
     flight = FlightRecorder(args.flight, enabled=args.flight is not None)
-    flight.start_run({"supervisor": True, "argv": child, "policy": vars(args)})
+    # the supervisor never lowers an executable itself — the child's
+    # run_start carries the real contract audit; this one records an
+    # honest all-not_checked block so every run_start has the key
+    from hydragnn_tpu.lint.ir import contract_block
+
+    flight.start_run(
+        {
+            "supervisor": True,
+            "argv": child,
+            "policy": vars(args),
+            "graftcheck": contract_block(None),
+        }
+    )
     sup = Supervisor(child, policy=policy, env=dict(os.environ), flight=flight)
     result = sup.run()
     flight.close()
