@@ -119,7 +119,7 @@
 set -euo pipefail
 cd "$(dirname "$0")"
 
-echo "== [1/13] format gate =="
+echo "== [1/14] format gate =="
 if python -m black --version >/dev/null 2>&1; then
     python -m black --check .
 elif command -v black >/dev/null 2>&1; then
@@ -129,7 +129,7 @@ else
     python -m compileall -q hydragnn_tpu tests examples tools bench.py bench_scaling.py bench_serve.py __graft_entry__.py
 fi
 
-echo "== [2/13] graftlint (AST invariant linter, docs/LINT.md) =="
+echo "== [2/14] graftlint (AST invariant linter, docs/LINT.md) =="
 # The --changed fast path first: this is the exact pre-commit loop a
 # developer runs locally (working tree + index vs HEAD), so CI proves
 # the fast path itself stays healthy. The full-tree scan below remains
@@ -187,7 +187,7 @@ done
 echo "graftlint self-test: HG001/HG002/HG005/HG006 each reject their injected violation"
 rm -rf "$LINT_ST"
 
-echo "== [3/13] graftcheck (compiled-IR contract checker, docs/LINT.md CC rules) =="
+echo "== [3/14] graftcheck (compiled-IR contract checker, docs/LINT.md CC rules) =="
 # Lowers the registered hot entry points (train step, scan-epoch body,
 # eval/stats steps, serve bucket ladder) under BOTH CI layouts — pure-DP
 # (data=8) and fsdp=2 (data=4, fsdp=2) — on the forced 8-device host
@@ -218,13 +218,13 @@ for cc in cc001 cc002 cc003 cc004 cc005 cc006; do
 done
 echo "graftcheck self-test: CC001..CC006 each reject their injected violation"
 
-echo "== [4/13] chip hygiene report =="
+echo "== [4/14] chip hygiene report =="
 python tools/chip_hygiene.py || true
 
-echo "== [5/13] serial suite (virtual 8-device CPU mesh, incl. 2-process pass) =="
+echo "== [5/14] serial suite (virtual 8-device CPU mesh, incl. 2-process pass) =="
 python -m pytest tests/ -q
 
-echo "== [6/13] partitioner smoke (HG002 mesh gate; fsdp=2 train == fsdp=1, flight parallel block) =="
+echo "== [6/14] partitioner smoke (HG002 mesh gate; fsdp=2 train == fsdp=1, flight parallel block) =="
 # Train, serve, and bench obtain meshes/shardings exclusively through the
 # Partitioner: no module outside hydragnn_tpu/parallel/ may construct a
 # jax.sharding.Mesh directly. tests/ are exempt (they build adversarial
@@ -311,7 +311,7 @@ echo "$PART_OUT" | grep -q "parallel: mesh=" || {
     echo "FAIL: --validate did not surface the parallel block"; exit 1; }
 rm -rf "$PART_DIR"
 
-echo "== [7/13] telemetry smoke (tiny 2-head training -> schema-valid v2 flight record with head diagnostics + MFU ledger) =="
+echo "== [7/14] telemetry smoke (tiny 2-head training -> schema-valid v2 flight record with head diagnostics + MFU ledger) =="
 SMOKE_DIR="$(mktemp -d)"
 JAX_PLATFORMS=cpu python - "$SMOKE_DIR" <<'EOF'
 import sys
@@ -371,7 +371,7 @@ print("introspection smoke: OK (v2 record, head diagnostics + MFU ledger present
 EOF
 rm -rf "$SMOKE_DIR"
 
-echo "== [8/13] fault-injection smoke (SIGTERM mid-epoch -> supervisor resume) =="
+echo "== [8/14] fault-injection smoke (SIGTERM mid-epoch -> supervisor resume) =="
 FAULT_DIR="$(mktemp -d)"
 cat > "$FAULT_DIR/child.py" <<'EOF'
 import sys
@@ -439,7 +439,7 @@ print(
 EOF
 rm -rf "$FAULT_DIR"
 
-echo "== [9/13] serve-chaos smoke (poison request -> quarantine; hot reload from the saved checkpoint; health probe) =="
+echo "== [9/14] serve-chaos smoke (poison request -> quarantine; hot reload from the saved checkpoint; health probe) =="
 SERVE_DIR="$(mktemp -d)"
 JAX_PLATFORMS=cpu python - "$SERVE_DIR" <<'EOF'
 import glob
@@ -527,7 +527,182 @@ python tools/obs_report.py --faults "$SERVE_DIR/serve_flight.jsonl"
 python tools/serve_probe.py --prom "$SERVE_DIR/serve.prom" --verbose
 rm -rf "$SERVE_DIR"
 
-echo "== [10/13] exec-cache smoke (train once; two server starts vs one cache dir; corrupt entry -> loud eviction) =="
+echo "== [10/14] incident smoke (SLO triggers: clean control -> zero incidents; injected NaN train + wedged serve -> one validated bundle each) =="
+INC_DIR="$(mktemp -d)"
+# --- clean control: triggers armed + tracing on, nothing injected ->
+#     ZERO incidents and sub-1% measured trigger/capture overhead; the
+#     sampled step traces must land in the flight record and export as
+#     Chrome/Perfetto JSON
+JAX_PLATFORMS=cpu python - "$INC_DIR/clean" <<'EOF'
+import glob
+import json
+import os
+import sys
+
+from hydragnn_tpu.api import run_training
+from hydragnn_tpu.data.synthetic import deterministic_graph_data
+from hydragnn_tpu.flagship import flagship_config
+from hydragnn_tpu.obs import export_flight_chrome, read_flight_record
+
+out = sys.argv[1]
+cfg = flagship_config(hidden_dim=8, num_conv_layers=2, batch_size=5, num_epoch=2)
+cfg["NeuralNetwork"]["Training"]["slo_triggers"] = True
+cfg["NeuralNetwork"]["Training"]["scan_epoch"] = False  # the traced per-step path
+samples = deterministic_graph_data(
+    number_configurations=20,
+    unit_cell_x_range=(2, 3),
+    unit_cell_y_range=(2, 3),
+    unit_cell_z_range=(2, 3),
+    seed=0,
+)
+run_training(cfg, samples=samples, log_dir=out + "/logs/")
+flight = glob.glob(out + "/logs/*/flight.jsonl")[0]
+inc_root = os.path.join(os.path.dirname(flight), "incidents")
+bundles = sorted(os.listdir(inc_root)) if os.path.isdir(inc_root) else []
+assert bundles == [], f"clean control produced incidents: {bundles}"
+ev = read_flight_record(flight)
+trig = [e for e in ev if e.get("kind") == "run_end"][-1].get("triggers")
+assert trig is not None and trig["fired"] == 0 and trig["incidents"] == [], trig
+assert trig["overhead_frac"] < 0.01, f"trigger overhead over 1%: {trig}"
+assert any(e.get("kind") == "trace_capture" for e in ev), "no sampled step traces"
+export_flight_chrome(flight, out + "/trace.json")
+with open(out + "/trace.json") as f:
+    assert json.load(f)["traceEvents"], "empty chrome trace export"
+print(
+    "incident smoke (clean control): OK (0 incidents, "
+    f"overhead_frac={trig['overhead_frac']})"
+)
+EOF
+# --- injected NaN batch: the nonfinite sentry skips it and the
+#     train_nonfinite_burst rule turns the skip counter's delta into
+#     exactly ONE incident bundle, captured over the next epoch's steps
+JAX_PLATFORMS=cpu HYDRAGNN_INJECT_NAN_STEP=2 HYDRAGNN_INCIDENT_PROFILE_STEPS=2 \
+    python - "$INC_DIR/nan" <<'EOF'
+import glob
+import json
+import os
+import sys
+
+from hydragnn_tpu.api import run_training
+from hydragnn_tpu.data.synthetic import deterministic_graph_data
+from hydragnn_tpu.flagship import flagship_config
+from hydragnn_tpu.obs import read_flight_record
+from hydragnn_tpu.obs.triggers import list_incidents, validate_incident_bundle
+
+out = sys.argv[1]
+cfg = flagship_config(hidden_dim=8, num_conv_layers=2, batch_size=5, num_epoch=2)
+cfg["NeuralNetwork"]["Training"]["slo_triggers"] = True
+samples = deterministic_graph_data(
+    number_configurations=20,
+    unit_cell_x_range=(2, 3),
+    unit_cell_y_range=(2, 3),
+    unit_cell_z_range=(2, 3),
+    seed=0,
+)
+run_training(cfg, samples=samples, log_dir=out + "/logs/")
+flight = glob.glob(out + "/logs/*/flight.jsonl")[0]
+bundles = list_incidents(os.path.join(os.path.dirname(flight), "incidents"))
+assert len(bundles) == 1, f"expected exactly one train incident, got {bundles}"
+problems = validate_incident_bundle(bundles[0])
+assert not problems, problems
+with open(os.path.join(bundles[0], "incident_manifest.json")) as f:
+    man = json.load(f)
+assert man["rule"] == "train_nonfinite_burst", man
+assert man["trigger"]["kind"] == "nonfinite_burst", man["trigger"]
+assert man["profile"]["nonempty"], "train incident captured an empty profiler trace"
+ev = read_flight_record(flight)
+assert sum(1 for e in ev if e.get("kind") == "incident") == 1
+trig = [e for e in ev if e.get("kind") == "run_end"][-1].get("triggers")
+assert trig["incidents"] == ["train_nonfinite_burst"], trig
+print(f"incident smoke (NaN train): OK (one bundle at {bundles[0]})")
+EOF
+# --- injected dispatch wedge: serve p99 blows through the SLO, the
+#     serve_p99 rule opens ONE incident, post-wedge traffic drives the
+#     bounded capture; request traces land in the serve flight record
+JAX_PLATFORMS=cpu python - "$INC_DIR" "$INC_DIR/clean" <<'EOF'
+import json
+import os
+import sys
+
+out, ckpt = sys.argv[1], sys.argv[2]
+# wedge: dispatch sleeps 1 s inside the forward for request seq 2
+os.environ["HYDRAGNN_INJECT_SERVE_WEDGE"] = "2:1"
+os.environ["HYDRAGNN_INCIDENT_PROFILE_STEPS"] = "2"
+
+from hydragnn_tpu.api import prepare_loaders_and_config, serve_model
+from hydragnn_tpu.data.synthetic import deterministic_graph_data
+from hydragnn_tpu.flagship import flagship_config
+from hydragnn_tpu.obs import FlightRecorder, read_flight_record
+from hydragnn_tpu.obs.triggers import list_incidents, validate_incident_bundle
+from hydragnn_tpu.serve import ServeConfig
+
+
+def cfg():
+    # num_epoch=2 matches the clean control's run name (the checkpoint dir)
+    return flagship_config(hidden_dim=8, num_conv_layers=2, batch_size=5, num_epoch=2)
+
+
+def data():
+    return deterministic_graph_data(
+        number_configurations=20,
+        unit_cell_x_range=(2, 3),
+        unit_cell_y_range=(2, 3),
+        unit_cell_z_range=(2, 3),
+        seed=0,
+    )
+
+
+flight = FlightRecorder(out + "/serve_flight.jsonl")
+server = serve_model(
+    cfg(),
+    samples=data(),
+    log_dir=ckpt + "/logs/",  # the clean control's checkpoint
+    serve_config=ServeConfig(
+        max_batch=4,
+        max_delay_ms=5.0,
+        slo_p99_ms=200.0,
+        trigger_eval_every_s=0.05,
+        incident_dir=out + "/serve_incidents",
+    ),
+    flight=flight,
+)
+_, _, test_loader, _ = prepare_loaders_and_config(cfg(), data())
+test = (list(test_loader.all_samples) * 8)[:8]
+for s in test:  # sequential: the wedged batch, then post-wedge traffic
+    server.predict(s, timeout=120)
+server.export_trace(out + "/serve_trace.json")
+server.stop()
+with open(out + "/serve_trace.json") as f:
+    assert json.load(f)["traceEvents"], "serve trace export empty"
+bundles = list_incidents(out + "/serve_incidents")
+assert len(bundles) == 1, f"expected exactly one serve incident, got {bundles}"
+problems = validate_incident_bundle(bundles[0])
+assert not problems, problems
+with open(os.path.join(bundles[0], "incident_manifest.json")) as f:
+    man = json.load(f)
+assert man["rule"] == "serve_p99" and man["trigger"]["kind"] == "latency_p99", man
+assert man["profile"]["nonempty"], "serve incident captured an empty profiler trace"
+ev = read_flight_record(out + "/serve_flight.jsonl")
+assert sum(1 for e in ev if e.get("kind") == "incident") == 1
+assert any(e.get("kind") == "trace_capture" for e in ev), "no request traces sampled"
+print(f"incident smoke (serve wedge): OK (one bundle at {bundles[0]})")
+EOF
+# the bundles pass the lint artifact gate and the reporter renders them
+python tools/graftlint.py --artifacts \
+    "$INC_DIR"/nan/logs/*/incidents/*/incident_manifest.json \
+    "$INC_DIR"/serve_incidents/*/incident_manifest.json
+python tools/incident_report.py --validate \
+    "$INC_DIR"/nan/logs/*/incidents "$INC_DIR/serve_incidents"
+python tools/incident_report.py \
+    "$INC_DIR"/nan/logs/*/incidents "$INC_DIR/serve_incidents" \
+    | tee "$INC_DIR/report.out"
+grep -q "== incident" "$INC_DIR/report.out" || {
+    echo "FAIL: incident_report.py rendered nothing"; exit 1; }
+# the incident appears in the fault timeline (and the record validates)
+python tools/obs_report.py --faults "$(ls "$INC_DIR"/nan/logs/*/flight.jsonl)"
+rm -rf "$INC_DIR"
+
+echo "== [11/14] exec-cache smoke (train once; two server starts vs one cache dir; corrupt entry -> loud eviction) =="
 EXEC_DIR="$(mktemp -d)"
 cat > "$EXEC_DIR/serve_once.py" <<'EOF'
 import sys
@@ -610,7 +785,7 @@ grep -q "exec_cache: evicted entry" "$EXEC_DIR/corrupt.err" || {
 }
 rm -rf "$EXEC_DIR"
 
-echo "== [11/13] perf gate (tiny fixed-config bench vs committed baseline) =="
+echo "== [12/14] perf gate (tiny fixed-config bench vs committed baseline) =="
 # fails on a >15% graphs/sec regression (and MFU regression on TPU)
 # against BENCH_CI_BASELINE.json, keyed per backend:device so every CI
 # machine gates against its own recorded number (tools/bench_gate.py)
@@ -638,17 +813,17 @@ fi
 JAX_PLATFORMS=cpu python tools/bench_gate.py --warm-start-arm
 
 if [ "${CI_FULL:-0}" = "1" ]; then
-    echo "== [12/13] full acceptance matrix (reference thresholds) =="
+    echo "== [13/14] full acceptance matrix (reference thresholds) =="
     HYDRAGNN_FULL_MATRIX=1 python -m pytest tests/test_train_matrix.py -q
 else
-    echo "== [12/13] full acceptance matrix: skipped (set CI_FULL=1) =="
+    echo "== [13/14] full acceptance matrix: skipped (set CI_FULL=1) =="
 fi
 
 if [ "${CI_TPU:-0}" = "1" ]; then
-    echo "== [13/13] real-chip TPU kernel suite =="
+    echo "== [14/14] real-chip TPU kernel suite =="
     HYDRAGNN_TPU_TESTS=1 python -m pytest tests/test_tpu_chip.py -q
 else
-    echo "== [13/13] real-chip TPU kernel suite: skipped (set CI_TPU=1, needs a TPU) =="
+    echo "== [14/14] real-chip TPU kernel suite: skipped (set CI_TPU=1, needs a TPU) =="
 fi
 
 echo "CI protocol complete."

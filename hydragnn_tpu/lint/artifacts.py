@@ -175,6 +175,56 @@ def _check_ci_baseline(data: Any) -> List[str]:
     return problems
 
 
+#: rule kinds obs/triggers.py:RULE_KINDS declares — duplicated here
+#: because this module must stay loadable without the package (and
+#: without jax); tests/test_triggers.py pins the two tuples equal.
+_INCIDENT_RULE_KINDS = (
+    "latency_p99",
+    "queue_depth",
+    "queue_age",
+    "mfu_drop",
+    "loss_spike",
+    "nonfinite_burst",
+)
+
+
+def _check_incident_manifest(data: Any) -> List[str]:
+    """incident_manifest.json: one incident bundle's closing manifest
+    (obs/triggers.py:Incident.close — the runtime validator there is
+    validate_incident_manifest; this mirrors it for jax-free lint)."""
+    problems = _require(
+        data,
+        {
+            "schema_version": (int,),
+            "id": (str,),
+            "rule": (str,),
+            "kind": (str,),
+            "status": (str,),
+            "trigger": (dict,),
+            "files": (dict,),
+            "profile": (dict,),
+        },
+    )
+    if problems:
+        return problems
+    problems += [
+        f"trigger.{p}" for p in _require(
+            data["trigger"],
+            {"rule": (str,), "kind": (str,), "observed": _NUM, "threshold": _NUM},
+        )
+    ]
+    problems += [
+        f"profile.{p}" for p in _require(
+            data["profile"],
+            {"captured": (bool,), "steps": (int,), "duration_s": _NUM,
+             "nonempty": (bool,)},
+        )
+    ]
+    if data["kind"] not in _INCIDENT_RULE_KINDS:
+        problems.append(f"unknown rule kind {data['kind']!r}")
+    return problems
+
+
 #: machine-JSON artifact kinds: glob pattern -> (label, validator).
 #: Patterns with ZERO committed matches are themselves findings — these
 #: artifacts are evidence, and losing one silently is the failure mode.
@@ -186,9 +236,21 @@ MACHINE_SCHEMAS: Dict[str, Tuple[str, Callable[[Any], List[str]]]] = {
     "BENCH_CI_BASELINE.json": ("CI perf baseline", _check_ci_baseline),
 }
 
+#: runtime-artifact kinds: produced by RUNS (never committed at the
+#: repo root), so they dispatch by name for explicit paths but are
+#: exempt from the zero-committed-matches scan above.
+RUNTIME_SCHEMAS: Dict[str, Tuple[str, Callable[[Any], List[str]]]] = {
+    "incident_manifest.json": (
+        "incident bundle manifest", _check_incident_manifest,
+    ),
+}
+
 
 def _machine_kind(name: str) -> Optional[Tuple[str, Callable[[Any], List[str]]]]:
     for pattern, spec in MACHINE_SCHEMAS.items():
+        if fnmatch.fnmatch(name, pattern):
+            return spec
+    for pattern, spec in RUNTIME_SCHEMAS.items():
         if fnmatch.fnmatch(name, pattern):
             return spec
     return None
@@ -207,7 +269,8 @@ def validate_machine_artifact(path: str, rel_display: str) -> List[Finding]:
                 col=1,
                 message=(
                     "no schema registered for this artifact name "
-                    f"(known kinds: {', '.join(sorted(MACHINE_SCHEMAS))})"
+                    "(known kinds: "
+                    f"{', '.join(sorted({**MACHINE_SCHEMAS, **RUNTIME_SCHEMAS}))})"
                 ),
             )
         ]

@@ -13,6 +13,11 @@ Pieces:
     compile counting ("no recompile after step 1", now assertable).
   - :mod:`~hydragnn_tpu.obs.export` — tensorboard / JSONL / Prometheus
     textfile exporters over the registry.
+  - :mod:`~hydragnn_tpu.obs.trace` — per-request / per-step distributed
+    traces (trace IDs, spans, Chrome/Perfetto export).
+  - :mod:`~hydragnn_tpu.obs.triggers` — declarative SLO rules over the
+    live registry; firing captures a bounded profiler trace into a
+    self-contained incident bundle.
 
 Global gate: ``HYDRAGNN_TELEMETRY=0`` disables the process-global
 registry and everything the train loop wires up; each piece is also
@@ -49,6 +54,24 @@ from hydragnn_tpu.obs.introspect import (
     per_head_error_metrics,
 )
 from hydragnn_tpu.obs.spans import StepSpans
+from hydragnn_tpu.obs.trace import (
+    RequestTrace,
+    Tracer,
+    export_flight_chrome,
+    flight_to_chrome,
+    new_trace_id,
+    trace_enabled,
+)
+from hydragnn_tpu.obs.triggers import (
+    RULE_KINDS,
+    IncidentRecorder,
+    TriggerEngine,
+    TriggerRule,
+    TriggerVerdict,
+    list_incidents,
+    validate_incident_bundle,
+    validate_incident_manifest,
+)
 from hydragnn_tpu.obs.compile_monitor import (
     BACKEND_COMPILE_EVENT,
     CompileMonitor,
@@ -86,6 +109,20 @@ __all__ = [
     "peak_flops",
     "per_head_error_metrics",
     "StepSpans",
+    "RequestTrace",
+    "Tracer",
+    "export_flight_chrome",
+    "flight_to_chrome",
+    "new_trace_id",
+    "trace_enabled",
+    "RULE_KINDS",
+    "IncidentRecorder",
+    "TriggerEngine",
+    "TriggerRule",
+    "TriggerVerdict",
+    "list_incidents",
+    "validate_incident_bundle",
+    "validate_incident_manifest",
     "BACKEND_COMPILE_EVENT",
     "CompileMonitor",
     "prometheus_name",

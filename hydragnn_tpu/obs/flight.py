@@ -73,6 +73,11 @@ _REQUIRED: Dict[str, tuple] = {
     # one event per cache interaction — hit / miss (with reason) /
     # store / evict / store_failed
     "exec_cache": ("event",),
+    # incident-grade tracing (hydragnn_tpu/obs/trace.py, obs/triggers.py):
+    # a sampled request/step trace (span list) and an SLO-trigger
+    # incident bundle opened under logs/<run>/incidents/<id>/
+    "trace_capture": ("trace_id", "spans"),
+    "incident": ("id", "rule", "path"),
     # bench evidence events: one per measured config (bench.py) and one
     # per gate verdict (bench_serve.py warm-start check) — required here
     # so graftlint --artifacts can hold the committed BENCH_*.jsonl
@@ -94,6 +99,7 @@ FAULT_KINDS = (
     "dispatch_restart",
     "reload",
     "reload_failed",
+    "incident",
 )
 
 _MANIFEST_REQUIRED = ("jax_version", "backend", "num_processes")

@@ -48,11 +48,15 @@ class StepSpans:
     path adds no per-step timing syscalls or allocations.
     """
 
-    def __init__(self, sample_steps: int = 3, skip_first: int = 1):
+    def __init__(self, sample_steps: int = 3, skip_first: int = 1, tracer=None):
         self.sample_steps = sample_steps
         self.skip_first = skip_first
         self.enabled = True
         self.epoch = -1
+        # optional obs/trace.py Tracer: each sampled sync step is also
+        # emitted as a one-span trace keyed (epoch, step), joining the
+        # train timeline with serve request traces
+        self.tracer = tracer
         self._reset()
 
     @staticmethod
@@ -95,6 +99,14 @@ class StepSpans:
             self.skip_first <= self.steps < self.skip_first + self.sample_steps
         )
         if sampling:
+            from hydragnn_tpu.utils.profile import capture_active
+
+            # a live profiler capture (incident or epoch-gated) must
+            # see the step as it actually runs: the sync fence would
+            # serialize the very window being profiled, so the sample
+            # is skipped outright, not deferred
+            sampling = not capture_active()
+        if sampling:
             import jax
 
             from hydragnn_tpu.utils.profile import trace_annotation
@@ -108,6 +120,20 @@ class StepSpans:
             self.device_wait_s += t2 - t1
             self.sync_step_s += t2 - t0
             self.sampled += 1
+            if self.tracer is not None:
+                tr = self.tracer.begin(seq=self.steps, epoch=self.epoch)
+                if tr is not None:
+                    now = time.time()
+                    tr.add_span(
+                        "train.sampled_step",
+                        now - (t2 - t0),
+                        now,
+                        epoch=self.epoch,
+                        step=self.steps,
+                        dispatch_ms=round((t1 - t0) * 1e3, 3),
+                        device_wait_ms=round((t2 - t1) * 1e3, 3),
+                    )
+                    self.tracer.finish(tr)
         else:
             out = fn(*args)
             dt = time.perf_counter() - t0

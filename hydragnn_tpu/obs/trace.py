@@ -1,0 +1,275 @@
+"""Per-request distributed tracing: one trace ID per serve request,
+spans for every hop, one exportable timeline.
+
+The flight recorder answers "what happened to the RUN"; this module
+answers "what happened to REQUEST 4817". Every request admitted by
+``serve/server.py:ModelServer.submit`` gets a :class:`RequestTrace` (a
+trace ID plus an ordered span list); the serve path closes spans at
+each hop — bucket route, queue wait (coalescing), device execute,
+postprocess — and hands the finished trace back to the
+:class:`Tracer`, which keeps a bounded ring of recent traces and
+samples every Nth into the serve flight record as a ``trace_capture``
+event (``obs/flight.py``). Train-side, ``obs/spans.py:StepSpans``
+feeds its sampled synchronous steps through the same Tracer, so train
+steps and serve requests land on ONE timeline keyed by
+``(run, epoch, step)`` / ``(run, seq)``.
+
+Export is Chrome/Perfetto trace-event JSON (``chrome://tracing``,
+https://ui.perfetto.dev): :meth:`Tracer.export_chrome` dumps the live
+ring; :func:`flight_to_chrome` rebuilds a timeline offline from any
+flight record (``trace_capture`` spans + ``epoch`` events), which is
+how a crashed run's trace is recovered from its JSONL alone.
+
+Cost discipline: a disabled tracer (telemetry off, or
+``HYDRAGNN_TRACE=0``) returns ``None`` from :meth:`Tracer.begin` and
+every downstream call site is null-guarded, so the off path adds one
+attribute check per request and nothing else. Timestamps are
+``time.time()`` wall seconds — the same clock the flight recorder
+stamps ``t`` with, so the two sources merge without skew bookkeeping.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Union
+
+from hydragnn_tpu.utils import knobs
+
+
+def trace_enabled() -> bool:
+    """Process-wide tracing gate: telemetry must be on AND
+    ``HYDRAGNN_TRACE`` not disabled (default on)."""
+    from hydragnn_tpu.obs.registry import telemetry_enabled
+
+    return telemetry_enabled() and knobs.get_bool("HYDRAGNN_TRACE", True)
+
+
+def new_trace_id() -> str:
+    """64-bit random hex trace ID — collision-safe at serve volumes,
+    short enough to grep a flight record for."""
+    return os.urandom(8).hex()
+
+
+class RequestTrace:
+    """One request's (or one sampled train step's) span accumulator.
+
+    Spans are closed intervals ``{name, t0, dur_ms, ...attrs}`` with
+    ``t0`` in wall seconds. Two recording styles:
+
+      - :meth:`add_span` — explicit interval (batch-level hops shared
+        by every request in a coalesced batch);
+      - :meth:`mark` — close a span from the previous mark to now (the
+        sequential per-request hops: route -> queue wait -> ...).
+    """
+
+    __slots__ = ("trace_id", "seq", "t_admit", "spans", "attrs", "_mark")
+
+    def __init__(self, trace_id: str, seq: int = -1, attrs: Optional[dict] = None):
+        self.trace_id = trace_id
+        self.seq = seq
+        self.t_admit = time.time()
+        self.spans: List[Dict[str, Any]] = []
+        self.attrs = dict(attrs or {})
+        self._mark = self.t_admit
+
+    def add_span(self, name: str, t0: float, t1: float, **attrs) -> None:
+        span: Dict[str, Any] = {
+            "name": name,
+            "t0": round(t0, 6),
+            "dur_ms": round(max(t1 - t0, 0.0) * 1e3, 3),
+        }
+        if attrs:
+            span.update(attrs)
+        self.spans.append(span)
+
+    def mark(self, name: str, **attrs) -> float:
+        """Close a span covering previous-mark .. now; returns now."""
+        now = time.time()
+        self.add_span(name, self._mark, now, **attrs)
+        self._mark = now
+        return now
+
+    def total_ms(self) -> float:
+        return round(sum(s["dur_ms"] for s in self.spans), 3)
+
+    def to_dict(self) -> dict:
+        d = {"trace_id": self.trace_id, "seq": self.seq, "spans": self.spans}
+        if self.attrs:
+            d["attrs"] = self.attrs
+        return d
+
+
+class Tracer:
+    """Trace factory + sink: mints :class:`RequestTrace` objects at
+    admission, keeps a bounded ring of finished traces, and samples
+    every ``sample_every``-th finished trace into the flight record as
+    a ``trace_capture`` event (the first finished trace is always
+    sampled, so even a 3-request smoke run leaves flight evidence).
+
+    ``begin`` returns ``None`` when tracing is off — call sites guard
+    with ``if trace is not None`` and pay one attribute check.
+    """
+
+    def __init__(
+        self,
+        flight=None,
+        enabled: Optional[bool] = None,
+        sample_every: Optional[int] = None,
+        keep: int = 256,
+    ):
+        self.enabled = trace_enabled() if enabled is None else bool(enabled)
+        if sample_every is None:
+            sample_every = knobs.get_int("HYDRAGNN_TRACE_SAMPLE", 100)
+        self.sample_every = max(1, int(sample_every))
+        self.flight = flight
+        self._lock = threading.Lock()
+        self._finished: deque = deque(maxlen=max(1, keep))
+        self._count = 0
+
+    def begin(self, seq: int = -1, **attrs) -> Optional[RequestTrace]:
+        if not self.enabled:
+            return None
+        return RequestTrace(new_trace_id(), seq, attrs or None)
+
+    def finish(self, trace: Optional[RequestTrace]) -> None:
+        if trace is None:
+            return
+        with self._lock:
+            self._finished.append(trace)
+            self._count += 1
+            n = self._count
+        if self.flight is not None and (n - 1) % self.sample_every == 0:
+            self.flight.record("trace_capture", **trace.to_dict())
+
+    @property
+    def finished_count(self) -> int:
+        with self._lock:
+            return self._count
+
+    def traces(self) -> List[RequestTrace]:
+        """The current ring (a copy), oldest first."""
+        with self._lock:
+            return list(self._finished)
+
+    # -- export ------------------------------------------------------------
+
+    def to_chrome_trace(self) -> dict:
+        events: List[dict] = []
+        for i, tr in enumerate(self.traces()):
+            tid = tr.seq if tr.seq >= 0 else i
+            args = {"trace_id": tr.trace_id}
+            args.update(tr.attrs)
+            events.extend(_chrome_events(tr.spans, pid=1, tid=tid, args=args))
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def export_chrome(self, path: str) -> str:
+        """Write the ring as Chrome trace-event JSON; returns ``path``."""
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.to_chrome_trace(), f)
+        os.replace(tmp, path)
+        return path
+
+
+def _chrome_events(spans, pid: int, tid, args: Optional[dict] = None) -> List[dict]:
+    """Span dicts -> Chrome trace-event 'X' (complete) events.
+    ``ts``/``dur`` are microseconds; ``t0`` wall seconds pass through
+    unshifted so events from different sources stay on one axis."""
+    out = []
+    for s in spans:
+        ev_args = dict(args or {})
+        ev_args.update(
+            {k: v for k, v in s.items() if k not in ("name", "t0", "dur_ms")}
+        )
+        out.append(
+            {
+                "name": s.get("name", "span"),
+                "ph": "X",
+                "ts": round(float(s.get("t0", 0.0)) * 1e6, 1),
+                "dur": round(float(s.get("dur_ms", 0.0)) * 1e3, 1),
+                "pid": pid,
+                "tid": tid,
+                "args": ev_args,
+            }
+        )
+    return out
+
+
+def flight_to_chrome(record: Union[str, List[dict]]) -> dict:
+    """Rebuild a Chrome/Perfetto timeline from a flight record: every
+    ``trace_capture`` event's spans (serve requests, sampled train
+    steps) plus one synthetic span per ``epoch`` event, all keyed by
+    the run name from the ``run_start`` manifest. This is the offline
+    join the tracing design promises: a crashed run's JSONL alone is
+    enough to reconstruct the timeline a human can open."""
+    from hydragnn_tpu.obs.flight import read_flight_record
+
+    events = read_flight_record(record) if isinstance(record, str) else record
+    run = "run"
+    for ev in events:
+        if ev.get("kind") == "run_start":
+            man = ev.get("manifest")
+            if isinstance(man, dict):
+                run = str(man.get("log_name") or man.get("run") or run)
+            break
+    out: List[dict] = []
+    for i, ev in enumerate(events):
+        kind = ev.get("kind")
+        if kind == "trace_capture":
+            spans = ev.get("spans")
+            if not isinstance(spans, list):
+                continue
+            seq = ev.get("seq", -1)
+            tid = seq if isinstance(seq, int) and seq >= 0 else i
+            args = {"run": run, "trace_id": ev.get("trace_id")}
+            args.update(
+                {
+                    k: v
+                    for k, v in ev.items()
+                    if k not in ("v", "kind", "t", "rank", "spans", "trace_id", "seq")
+                }
+            )
+            out.extend(_chrome_events(spans, pid=1, tid=tid, args=args))
+        elif kind == "epoch":
+            # the epoch event is stamped at epoch END; reconstruct the
+            # interval from the recorded epoch duration when present
+            t1 = float(ev.get("t", 0.0))
+            dur_s = ev.get("time") or ev.get("epoch_s") or 0.0
+            try:
+                dur_s = max(float(dur_s), 0.0)
+            except (TypeError, ValueError):
+                dur_s = 0.0
+            args = {"run": run, "epoch": ev.get("epoch")}
+            for key in ("train_loss", "val_loss", "steps"):
+                if key in ev:
+                    args[key] = ev[key]
+            out.append(
+                {
+                    "name": f"epoch {ev.get('epoch')}",
+                    "ph": "X",
+                    "ts": round((t1 - dur_s) * 1e6, 1),
+                    "dur": round(dur_s * 1e6, 1),
+                    "pid": 0,
+                    "tid": int(ev.get("rank", 0) or 0),
+                    "args": args,
+                }
+            )
+    return {"traceEvents": out, "displayTimeUnit": "ms"}
+
+
+def export_flight_chrome(record_path: str, out_path: str) -> str:
+    """``flight_to_chrome`` to a file (atomic write); returns out_path."""
+    data = flight_to_chrome(record_path)
+    d = os.path.dirname(os.path.abspath(out_path))
+    os.makedirs(d, exist_ok=True)
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(data, f)
+    os.replace(tmp, out_path)
+    return out_path

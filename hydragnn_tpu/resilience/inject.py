@@ -29,6 +29,11 @@ per supervised run.
                                      — a donated cached executable is then
                                      EVICTED with a ``donation_check_failed``
                                      miss and the consumer live-compiles
+  HYDRAGNN_INJECT_TRIGGER=RULE       force-fire the named SLO trigger rule
+                                     once at the next TriggerEngine.evaluate
+                                     (obs/triggers.py) — drives the incident
+                                     capture path without waiting for a real
+                                     anomaly
   =================================  ==========================================
 
 Serving-side faults (docs/RESILIENCE.md "Serving resilience"; request
@@ -193,6 +198,27 @@ def maybe_serve_kill_dispatch(batch_count: int) -> None:
         raise RuntimeError(
             f"injected serve fault: dispatch thread killed at batch {batch_count}"
         )
+
+
+_TRIGGER_FIRED = False
+
+
+def injected_trigger(known_rules=None) -> Optional[str]:
+    """The SLO rule name ``HYDRAGNN_INJECT_TRIGGER`` names, returned
+    ONCE per process (the engine force-fires that rule at its next
+    evaluate). ``known_rules`` filters: an injected name no engine rule
+    carries is left un-consumed so the engine that DOES know it (train
+    vs serve run in one process) gets the shot."""
+    spec = _spec("HYDRAGNN_INJECT_TRIGGER")
+    if spec is None:
+        return None
+    global _TRIGGER_FIRED
+    if _TRIGGER_FIRED:
+        return None
+    if known_rules is not None and spec not in known_rules:
+        return None
+    _TRIGGER_FIRED = True
+    return spec
 
 
 def serve_torn_reload() -> bool:

@@ -44,6 +44,7 @@ class PendingRequest:
     t_enqueue: float  # time.monotonic() at admission
     bucket: int
     seq: int = -1  # server-wide admission sequence number
+    trace: Any = None  # obs/trace.py RequestTrace (None when tracing off)
 
 
 class MicroBatchQueue:
@@ -71,7 +72,7 @@ class MicroBatchQueue:
         self._count = 0
         self._closed = False
 
-    def put(self, bucket: int, item: Any, seq: int = -1) -> Future:
+    def put(self, bucket: int, item: Any, seq: int = -1, trace: Any = None) -> Future:
         """Admit one request into ``bucket``'s lane; returns its Future.
         Raises :class:`Overloaded` when the queue is at capacity and
         :class:`ServerClosed` after :meth:`close` — a closed queue must
@@ -86,7 +87,7 @@ class MicroBatchQueue:
                     f"serving queue full ({self._count}/{self._max_pending} pending)"
                 )
             self._pending[bucket].append(
-                PendingRequest(item, fut, time.monotonic(), bucket, seq)
+                PendingRequest(item, fut, time.monotonic(), bucket, seq, trace)
             )
             self._count += 1
             self._cv.notify_all()
@@ -95,6 +96,19 @@ class MicroBatchQueue:
     def depth(self) -> int:
         with self._cv:
             return self._count
+
+    def oldest_age_s(self) -> float:
+        """Age (seconds) of the oldest queued request across all
+        buckets; 0.0 when the queue is empty. The head of each bucket's
+        deque is its oldest admit, so this is O(buckets)."""
+        with self._cv:
+            oldest = None
+            for dq in self._pending:
+                if dq and (oldest is None or dq[0].t_enqueue < oldest):
+                    oldest = dq[0].t_enqueue
+        if oldest is None:
+            return 0.0
+        return max(time.monotonic() - oldest, 0.0)
 
     def take_batch(self) -> Optional[Tuple[int, List[PendingRequest], str]]:
         with self._cv:

@@ -80,6 +80,10 @@ class ServeMetrics:
         self._oversize_eager = r.counter(f"{p}.oversize_eager")
         self._errors = r.counter(f"{p}.errors")
         self._queue_depth = r.gauge(f"{p}.queue_depth")
+        # age of the oldest queued request — the SLO trigger engine's
+        # queue_age signal, and (via the registry's Prometheus export)
+        # the same gauge external probes scrape
+        self._queue_oldest_age = r.gauge(f"{p}.queue_oldest_age_s")
         # compile-cache accounting: warmup compiles are the startup AOT
         # ladder (expected, paid once); a MISS is a post-warmup dispatch
         # that required a fresh XLA compile — the thing steady-state
@@ -216,8 +220,12 @@ class ServeMetrics:
         self._latency.observe(seconds)
         self._results.inc(n_results)
 
-    def set_queue_depth(self, depth: int) -> None:
+    def set_queue_depth(
+        self, depth: int, oldest_age_s: Optional[float] = None
+    ) -> None:
         self._queue_depth.set(depth)
+        if oldest_age_s is not None:
+            self._queue_oldest_age.set(round(float(oldest_age_s), 4))
 
     # -- export ------------------------------------------------------------
 
@@ -256,6 +264,7 @@ class ServeMetrics:
             "ready": self._ready.snapshot(),
             "queue_depth": self._queue_depth.snapshot(),
             "queue_depth_peak": int(self._queue_depth.peak),
+            "queue_oldest_age_s": self._queue_oldest_age.snapshot(),
             "compile_warmup": self._compile_warmup.snapshot(),
             "compile_hits": self._compile_hits.snapshot(),
             "compile_misses": self._compile_misses.snapshot(),

@@ -153,6 +153,17 @@ class ServeConfig:
     prometheus_path: Optional[str] = None
     prometheus_every_s: float = 5.0
     exec_cache_dir: Optional[str] = None
+    # SLO trigger rules (obs/triggers.py) — None disables a rule, so a
+    # default-config server runs exactly as before. When any rule is
+    # set, the dispatch loop evaluates the engine every
+    # trigger_eval_every_s and a firing rule opens an incident bundle
+    # (bounded profiler capture + evidence sidecars) under
+    # incident_dir (default: <log_dir>/serve/incidents).
+    slo_p99_ms: Optional[float] = None
+    slo_queue_depth: Optional[int] = None
+    slo_queue_age_s: Optional[float] = None
+    trigger_eval_every_s: float = 1.0
+    incident_dir: Optional[str] = None
 
 
 def request_to_dict(sample: Any) -> Dict[str, Any]:
@@ -300,6 +311,13 @@ class ModelServer:
         self._reload_lock = threading.Lock()
         self._supervisor = None  # built in start()
         self.log_dir = "./logs/"  # reload()'s default checkpoint root
+        # per-request tracing + SLO triggers, built in start() (the
+        # incident root defaults under log_dir, which api.serve_model
+        # stamps after construction)
+        self._tracer = None
+        self._triggers = None
+        self._incidents = None
+        self._last_trigger_eval = 0.0
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -372,6 +390,54 @@ class ModelServer:
         from hydragnn_tpu.serve.supervise import DispatchSupervisor
 
         cfg = self.config
+        # per-request tracing (obs/trace.py): every admitted request
+        # gets a trace ID + span list; every Nth finished trace lands
+        # in the flight record as a trace_capture event
+        from hydragnn_tpu.obs.trace import Tracer
+
+        self._tracer = Tracer(flight=self.flight)
+        # declarative SLO rules -> trigger engine + incident recorder
+        # (obs/triggers.py); no rules configured -> both stay None and
+        # the dispatch loop pays one attribute check per batch
+        rules = []
+        mp = self.metrics.prefix
+        if cfg.slo_p99_ms is not None:
+            from hydragnn_tpu.obs.triggers import TriggerRule
+
+            rules.append(
+                TriggerRule(
+                    "serve_p99", "latency_p99", f"{mp}.latency_s",
+                    cfg.slo_p99_ms / 1e3,
+                )
+            )
+        if cfg.slo_queue_depth is not None:
+            from hydragnn_tpu.obs.triggers import TriggerRule
+
+            rules.append(
+                TriggerRule(
+                    "serve_queue_depth", "queue_depth", f"{mp}.queue_depth",
+                    float(cfg.slo_queue_depth),
+                )
+            )
+        if cfg.slo_queue_age_s is not None:
+            from hydragnn_tpu.obs.triggers import TriggerRule
+
+            rules.append(
+                TriggerRule(
+                    "serve_queue_age", "queue_age",
+                    f"{mp}.queue_oldest_age_s", float(cfg.slo_queue_age_s),
+                )
+            )
+        if rules:
+            from hydragnn_tpu.obs.triggers import IncidentRecorder, TriggerEngine
+
+            self._triggers = TriggerEngine(rules, registry=self.metrics.registry)
+            self._incidents = IncidentRecorder(
+                cfg.incident_dir
+                or os.path.join(self.log_dir, "serve", "incidents"),
+                registry=self.metrics.registry,
+                flight_path=self.flight.path,
+            )
         self._supervisor = DispatchSupervisor(
             self._run,
             policy=SupervisorPolicy(
@@ -400,7 +466,19 @@ class ModelServer:
             self._supervisor.stop(timeout)
         self._started = False
         if was_started:
-            self.flight.end_run(status="stopped", metrics=self.metrics_snapshot())
+            # close any open incident (capture stopped, manifest
+            # written) BEFORE the final snapshot so the run_end trigger
+            # block counts it
+            if self._incidents is not None:
+                self._incidents.finalize()
+            extra = {}
+            if self._triggers is not None:
+                extra["triggers"] = self._triggers.summary(
+                    self._incidents.capture_s if self._incidents else 0.0
+                )
+            self.flight.end_run(
+                status="stopped", metrics=self.metrics_snapshot(), **extra
+            )
 
     def _on_dispatch_giveup(self, exc: BaseException) -> None:
         """Restart budget exhausted: a loudly dead server. Close
@@ -438,17 +516,22 @@ class ModelServer:
         g = self._validated(request_to_dict(sample))
         n, e = _dict_sizes(g)
         seq = next(self._seq)
+        trace = self._tracer.begin(seq=seq) if self._tracer is not None else None
         bucket = route(self.buckets, n, e)
         if bucket is not None:
+            if trace is not None:
+                trace.mark("serve.route", bucket=bucket.index)
             self.metrics.record_request(bucket.index)
             try:
-                fut = self._queue.put(bucket.index, g, seq=seq)
+                fut = self._queue.put(bucket.index, g, seq=seq, trace=trace)
             except Overloaded:
                 self.metrics.record_reject()
                 raise
-            self.metrics.set_queue_depth(self._queue.depth())
+            self.metrics.set_queue_depth(
+                self._queue.depth(), self._queue.oldest_age_s()
+            )
             return fut
-        return self._submit_oversize(g, n, e, seq)
+        return self._submit_oversize(g, n, e, seq, trace)
 
     def predict(self, sample: Any, timeout: Optional[float] = None) -> Dict[str, np.ndarray]:
         """Blocking single-request convenience around :meth:`submit`."""
@@ -500,6 +583,11 @@ class ModelServer:
         if depth >= highwater:
             reasons.append(f"queue over high-water ({depth}/{highwater})")
         self.metrics.set_health(live, ready, hb_age, warm)
+        # keep the queue gauges fresh even when the dispatch loop is
+        # idle/wedged — the oldest-request age is exactly the signal
+        # that matters then (satellite of the trigger engine AND the
+        # external Prometheus probe)
+        self.metrics.set_queue_depth(depth, self._queue.oldest_age_s())
         return {
             "live": live,
             "ready": ready,
@@ -623,7 +711,9 @@ class ModelServer:
 
     # -- oversize fallbacks ------------------------------------------------
 
-    def _submit_oversize(self, g: Dict[str, Any], n: int, e: int, seq: int) -> Future:
+    def _submit_oversize(
+        self, g: Dict[str, Any], n: int, e: int, seq: int, trace: Any = None
+    ) -> Future:
         self.metrics.record_request(None)
         fut: Future = Future()
         largest = self.buckets[-1]
@@ -632,8 +722,10 @@ class ModelServer:
             # co-tenants) but within the biggest plan alone: dispatch
             # unbatched on the ALREADY-COMPILED largest bucket
             self.metrics.record_oversize("largest_bucket")
+            if trace is not None:
+                trace.mark("serve.route", oversize="largest_bucket")
             t0 = time.monotonic()
-            reqs = [PendingRequest(g, fut, t0, largest.index, seq)]
+            reqs = [PendingRequest(g, fut, t0, largest.index, seq, trace)]
             self._execute_bucket(largest.index, reqs, reason="oversize")
             return fut
         if not self.config.eager_fallback:
@@ -652,16 +744,23 @@ class ModelServer:
             result = self._execute_eager(g, seq)
             if not _result_finite(result) and self.config.check_finite:
                 self._quarantine(
-                    PendingRequest(g, fut, t0, -1, seq), None, "nonfinite", None
+                    PendingRequest(g, fut, t0, -1, seq, trace), None,
+                    "nonfinite", None,
                 )
                 return fut
             fut.set_result(result)
             self.metrics.observe_latency(time.monotonic() - t0)
+            if trace is not None:
+                trace.mark("serve.eager_execute")
+                self._tracer.finish(trace)
         except Oversize as exc:
             self.metrics.record_error()
             fut.set_exception(exc)
         except BaseException as exc:
-            self._quarantine(PendingRequest(g, fut, t0, -1, seq), None, "exception", exc)
+            self._quarantine(
+                PendingRequest(g, fut, t0, -1, seq, trace), None,
+                "exception", exc,
+            )
         return fut
 
     def _execute_eager(self, g: Dict[str, Any], seq: int) -> Dict[str, np.ndarray]:
@@ -698,7 +797,9 @@ class ModelServer:
             if got is None:
                 return
             bucket_index, requests, reason = got
-            self.metrics.set_queue_depth(self._queue.depth())
+            self.metrics.set_queue_depth(
+                self._queue.depth(), self._queue.oldest_age_s()
+            )
             self._dispatched_batches += 1
             sup.busy(True)
             sup.beat()
@@ -706,6 +807,7 @@ class ModelServer:
                 # thread-death injection fires OUTSIDE request isolation
                 inject.maybe_serve_kill_dispatch(self._dispatched_batches)
                 self._execute_bucket(bucket_index, requests, reason)
+                self._maybe_trigger()
             except BaseException as exc:
                 # anything escaping here is dispatch-level (request
                 # failures were isolated below): resolve the in-hand
@@ -743,9 +845,16 @@ class ModelServer:
 
         bucket = self.buckets[bucket_index]
         seqs = [r.seq for r in requests]
+        for r in requests:
+            if r.trace is not None:
+                # coalescing wait ends the moment the batch is in hand
+                r.trace.mark(
+                    "serve.queue_wait", reason=reason, bucket=bucket_index
+                )
         try:
             inject.maybe_serve_wedge(seqs)
             inject.maybe_serve_raise(seqs)
+            t_build0 = time.time()
             batch = self.partitioner.shard_inference_batch(
                 batch_graphs(
                     [r.item for r in requests],
@@ -754,14 +863,24 @@ class ModelServer:
                     n_graph_pad=bucket.graph_pad,
                 )
             )
+            t_exec0 = time.time()
             exe = self._cache.executable(bucket)
             outputs = [np.asarray(o) for o in exe(self.served.variables, batch)]
             outputs = inject.maybe_serve_nan(outputs, seqs)
+            t_exec1 = time.time()
         except Exception as exc:
             self._isolate_failure(
                 bucket_index, requests, "exception", exc, singles_retry
             )
             return
+        # batch-level spans are shared by every co-batched trace
+        for r in requests:
+            if r.trace is not None:
+                r.trace.add_span(
+                    "serve.batch_build", t_build0, t_exec0,
+                    occupancy=len(requests),
+                )
+                r.trace.add_span("serve.device_execute", t_exec0, t_exec1)
         self.metrics.record_batch(
             bucket_index, len(requests), bucket.max_batch, reason
         )
@@ -780,6 +899,10 @@ class ModelServer:
             if not r.future.done():
                 r.future.set_result(result)
                 self.metrics.observe_latency(t_done - r.t_enqueue)
+                if r.trace is not None:
+                    r.trace.add_span("serve.postprocess", t_exec1, time.time())
+                    self._tracer.finish(r.trace)
+                    r.trace = None
         if poisoned:
             self._isolate_failure(
                 bucket_index, poisoned, "nonfinite", None, singles_retry
@@ -834,6 +957,38 @@ class ModelServer:
                     reason=kind,
                 )
             )
+        if r.trace is not None and self._tracer is not None:
+            r.trace.mark("serve.quarantine", reason=kind)
+            self._tracer.finish(r.trace)
+            r.trace = None
+
+    def _maybe_trigger(self) -> None:
+        """Post-batch trigger hook: drive any open incident's bounded
+        capture, then (rate-limited to ``trigger_eval_every_s``)
+        evaluate the SLO rules. Observability must never take the
+        dispatch thread down, so everything is exception-contained."""
+        trig, inc = self._triggers, self._incidents
+        if trig is None or inc is None:
+            return
+        try:
+            inc.tick()
+            now = time.monotonic()
+            if now - self._last_trigger_eval < self.config.trigger_eval_every_s:
+                return
+            self._last_trigger_eval = now
+            for verdict in trig.evaluate():
+                opened = inc.open_incident(verdict, flight=self.flight)
+                if opened is not None:
+                    opened.tick()  # start the capture on this batch
+        except Exception as exc:
+            self.flight.error(exc, where="trigger_engine")
+
+    def export_trace(self, path: str) -> Optional[str]:
+        """Dump the tracer's recent-request ring as Chrome/Perfetto
+        trace JSON; returns the path (None when tracing is off)."""
+        if self._tracer is None or not self._tracer.enabled:
+            return None
+        return self._tracer.export_chrome(path)
 
     def _slice_result(
         self, outputs, graph_index: int, node_offset: int, num_nodes: int

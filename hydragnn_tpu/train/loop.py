@@ -207,6 +207,7 @@ def train_epoch(
     spans=None,
     hooks=None,
     diag=None,
+    incidents=None,
 ) -> Tuple[TrainState, float, np.ndarray]:
     """One training epoch; returns (state, avg_loss, avg_tasks_loss[H]).
 
@@ -257,6 +258,11 @@ def train_epoch(
             acc.add(loss, task_losses, batch.graph_mask)
         if profiler is not None:
             profiler.step()
+        if incidents is not None:
+            # drives any OPEN incident's bounded profiler capture at
+            # step granularity (obs/triggers.py:IncidentRecorder.tick);
+            # a recorder with no open incident returns immediately
+            incidents.tick()
     avg_loss, avg_tasks = acc.finalize()
     return state, avg_loss, avg_tasks
 
@@ -746,6 +752,60 @@ def train_validate_test(
             "profile_trace", path=path, epoch=ep
         )
 
+    # Incident-grade tracing (obs/trace.py + obs/triggers.py,
+    # docs/OBSERVABILITY.md "Tracing and incidents"): sampled sync
+    # steps join the request-trace timeline keyed (epoch, step), and —
+    # when Training.slo_triggers is on — an SLO trigger engine
+    # evaluated at each epoch end (nonfinite burst, loss spike vs
+    # rolling median, MFU drop) arms a bounded profiler capture whose
+    # evidence lands in an incident bundle under
+    # <log_dir>/<log_name>/incidents/<id>/.
+    tracer = None
+    trig_engine = None
+    incidents = None
+    if telemetry_on:
+        from hydragnn_tpu.obs.trace import Tracer
+
+        tracer = Tracer(flight=flight)
+        spans.tracer = tracer
+    if telemetry_on and bool(training.get("slo_triggers", False)):
+        from hydragnn_tpu.obs import get_registry
+        from hydragnn_tpu.obs.triggers import (
+            IncidentRecorder,
+            TriggerEngine,
+            TriggerRule,
+        )
+
+        trig_engine = TriggerEngine(
+            [
+                TriggerRule(
+                    "train_nonfinite_burst",
+                    "nonfinite_burst",
+                    "train.nonfinite_skipped",
+                    float(training.get("slo_nonfinite_burst", 1)),
+                ),
+                TriggerRule(
+                    "train_loss_spike",
+                    "loss_spike",
+                    "train_loss",
+                    float(training.get("slo_loss_spike_factor", 3.0)),
+                ),
+                TriggerRule(
+                    "train_mfu_drop",
+                    "mfu_drop",
+                    "mfu",
+                    float(training.get("slo_mfu_drop_factor", 0.5)),
+                ),
+            ],
+            registry=get_registry(),
+        )
+        if jax.process_index() == 0:
+            incidents = IncidentRecorder(
+                os.path.join(log_dir, log_name, "incidents"),
+                registry=get_registry(),
+                flight_path=flight.path,
+            )
+
     # Model-level introspection (hydragnn_tpu/obs/introspect.py,
     # docs/OBSERVABILITY.md "Model-level diagnostics"): per-head
     # gradient diagnostics sampled every Training.diag_every steps
@@ -880,8 +940,18 @@ def train_validate_test(
         a crashed run must still leave a parseable artifact (the r05
         'traceback was the only evidence' failure mode)."""
         hooks.teardown()
+        if incidents is not None:
+            incidents.finalize()
         flight.error(exc)
-        flight.end_run(status="failed", epochs=epochs)
+        flight.end_run(
+            status="failed",
+            epochs=epochs,
+            triggers=(
+                trig_engine.summary(incidents.capture_s if incidents else 0.0)
+                if trig_engine is not None
+                else None
+            ),
+        )
         if cmon is not None:
             cmon.stop()
         if own_flight:
@@ -1218,6 +1288,8 @@ def train_validate_test(
             epoch=epoch,
             step=int(jax.device_get(ckpt_state.step)),
         )
+        if incidents is not None:
+            incidents.finalize()
         flight.end_run(status="preempted", epochs=epoch - start_epoch)
         if cmon is not None:
             cmon.stop()
@@ -1298,6 +1370,10 @@ def train_validate_test(
         t_train0 = time.perf_counter()
         with (profiler if profiler is not None else contextlib.nullcontext()):
             if scan_fn is not None:
+                if incidents is not None:
+                    # scan mode is one dispatch per epoch: a single tick
+                    # here spans the whole epoch's capture window
+                    incidents.tick()
                 state, train_loss, train_tasks = train_epoch_scan(
                     train_loader, state, scan_fn, epoch, diag=diag,
                     sentry=sentry,
@@ -1312,6 +1388,7 @@ def train_validate_test(
                     spans=spans,
                     hooks=hooks,
                     diag=diag,
+                    incidents=incidents,
                 )
         # the epoch metrics above already synced at finalize, so this
         # wall time covers every dispatched train step's execution —
@@ -1472,6 +1549,21 @@ def train_validate_test(
             compiles=compiles,
             **extra,
         )
+
+        # SLO trigger evaluation at the epoch boundary: feed the rolling
+        # series the rules watch, then let at most one verdict open an
+        # incident whose profiler capture runs during the NEXT epoch's
+        # ticks (docs/OBSERVABILITY.md "SLO triggers and incidents").
+        if trig_engine is not None:
+            trig_engine.observe("train_loss", train_loss)
+            trig_engine.observe("val_loss", val_loss)
+            if hw is not None and hw.get("mfu") is not None:
+                trig_engine.observe("mfu", hw["mfu"])
+            for verdict in trig_engine.evaluate():
+                # the bundle's trigger.json carries the full verdict;
+                # open_incident records the flight "incident" pointer
+                if incidents is not None:
+                    incidents.open_incident(verdict, flight=flight)
         from hydragnn_tpu.utils.tensorboard import write_scalar_dict
 
         if span_snap is not None:
@@ -1608,6 +1700,9 @@ def train_validate_test(
     # prefetch accounting, ...), and the whole-run compile count.
     if cmon is not None:
         cmon.stop()
+    if incidents is not None:
+        # an incident still capturing at run end closes as "truncated"
+        incidents.finalize()
     from hydragnn_tpu.obs import get_registry
     from hydragnn_tpu.utils.time_utils import timers_snapshot
 
@@ -1624,6 +1719,11 @@ def train_validate_test(
         # hardware-efficiency rollup: mean/max MFU across epochs and
         # the run's device-memory high-water mark
         hw=ledger.run_summary() if ledger is not None else None,
+        triggers=(
+            trig_engine.summary(incidents.capture_s if incidents else 0.0)
+            if trig_engine is not None
+            else None
+        ),
     )
     if own_flight:
         flight.close()

@@ -86,6 +86,23 @@ KNOBS: Dict[str, Knob] = {
            "tools/graftcheck.py",
            "Comma-separated named Partitioner layouts the graftcheck CLI "
            "audits by default (dp = pure data parallel, fsdp2 = fsdp=2)."),
+        _K("HYDRAGNN_INCIDENT_COOLDOWN_S", "float", "300",
+           "obs/triggers.py",
+           "Minimum seconds between admitted SLO trigger firings (the "
+           "engine's rate limit against incident storms)."),
+        _K("HYDRAGNN_INCIDENT_MAX", "int", "5", "obs/triggers.py",
+           "Incident count cap per engine per run; further verdicts are "
+           "suppressed (counted in the run_end triggers block)."),
+        _K("HYDRAGNN_INCIDENT_OVERHEAD_PCT", "float", "5",
+           "obs/triggers.py",
+           "Profiler-capture overhead budget as a percent of run wall "
+           "time; a new incident that would exceed it is suppressed."),
+        _K("HYDRAGNN_INCIDENT_PROFILE_S", "float", "10", "obs/triggers.py",
+           "Wall-time bound on one incident's profiler capture (whichever "
+           "of steps/seconds trips first stops the trace)."),
+        _K("HYDRAGNN_INCIDENT_PROFILE_STEPS", "int", "3", "obs/triggers.py",
+           "Step-count bound on one incident's profiler capture "
+           "(ticks of the capturing loop, train steps or serve batches)."),
         _K("HYDRAGNN_INJECT_DONATION_CHECK_FAIL", "flag", None,
            "utils/exec_cache.py",
            "Force the donation round-trip gate to report failure: the "
@@ -128,6 +145,9 @@ KNOBS: Dict[str, Knob] = {
            "resilience/inject.py",
            "B:S: the loader's producer sleeps S seconds before building "
            "batch B of an epoch (drives the hang watchdog)."),
+        _K("HYDRAGNN_INJECT_TRIGGER", "spec", None, "resilience/inject.py",
+           "RULE: force-fire the named SLO trigger rule once at the next "
+           "TriggerEngine.evaluate (drives incident capture on demand)."),
         _K("HYDRAGNN_LOCAL_MIN_ROWS", "int", "200000", "ops/segment_pallas.py",
            "Row threshold below which the local-window kernel family "
            "falls back (its fixed per-call cost needs large operands)."),
@@ -151,6 +171,12 @@ KNOBS: Dict[str, Knob] = {
            "defaults."),
         _K("HYDRAGNN_TPU_TESTS", "flag", None, "tests/test_tpu_chip.py",
            "Opt into the real-chip TPU kernel suite (needs hardware)."),
+        _K("HYDRAGNN_TRACE", "bool", "1", "obs/trace.py",
+           "Per-request/step distributed tracing gate (within the "
+           "process-wide HYDRAGNN_TELEMETRY gate): 0 disables tracing."),
+        _K("HYDRAGNN_TRACE_SAMPLE", "int", "100", "obs/trace.py",
+           "Record every Nth finished trace into the flight record as a "
+           "trace_capture event (the first trace is always recorded)."),
         _K("HYDRAGNN_WATCHDOG_S", "float", "0", "train/loop.py",
            "Hang-watchdog stall threshold in seconds; 0/unset = off. "
            "Must be sized above the worst expected compile time."),

@@ -16,9 +16,54 @@ TPU HLO timelines when run on TPU).
 from __future__ import annotations
 
 import os
+import threading
 from typing import Optional
 
 import jax
+
+# jax allows ONE active profiler trace per process; this flag is the
+# arbiter between the epoch-gated Profiler below and incident captures
+# (obs/triggers.py), and the signal obs/spans.py uses to suppress its
+# sampled block_until_ready fence while a capture is live (the fence
+# would serialize the very step being profiled).
+_CAPTURE_LOCK = threading.Lock()
+_CAPTURE_ACTIVE = False
+
+
+def capture_active() -> bool:
+    """Whether a jax profiler trace is currently being captured."""
+    with _CAPTURE_LOCK:
+        return _CAPTURE_ACTIVE
+
+
+def try_start_capture(prefix: str) -> bool:
+    """Start a jax profiler trace into ``prefix`` if no capture is
+    live; returns whether this caller now owns the capture. Refusal
+    (not an exception) is the contract — an incident firing during the
+    epoch-gated profiler's window simply captures nothing."""
+    global _CAPTURE_ACTIVE
+    with _CAPTURE_LOCK:
+        if _CAPTURE_ACTIVE:
+            return False
+        _CAPTURE_ACTIVE = True
+    try:
+        os.makedirs(prefix, exist_ok=True)
+        jax.profiler.start_trace(prefix)
+    except Exception:
+        with _CAPTURE_LOCK:
+            _CAPTURE_ACTIVE = False
+        return False
+    return True
+
+
+def stop_capture() -> None:
+    """Stop the live capture (no-op when none is)."""
+    global _CAPTURE_ACTIVE
+    with _CAPTURE_LOCK:
+        if not _CAPTURE_ACTIVE:
+            return
+        _CAPTURE_ACTIVE = False
+    jax.profiler.stop_trace()
 
 
 class Profiler:
@@ -74,15 +119,13 @@ class Profiler:
         self._step_in_epoch += 1
         start_at = self.wait + self.warmup
         if not self._tracing and self._step_in_epoch == start_at:
-            os.makedirs(self.prefix, exist_ok=True)
-            jax.profiler.start_trace(self.prefix)
-            self._tracing = True
+            self._tracing = try_start_capture(self.prefix)
         elif self._tracing and self._step_in_epoch >= start_at + self.active:
             self._stop()
 
     def _stop(self) -> None:
         if self._tracing:
-            jax.profiler.stop_trace()
+            stop_capture()
             self._tracing = False
             self.done = True
             print(f"Profiler trace written to {self.prefix} (epoch {self.target_epoch})")

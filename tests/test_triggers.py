@@ -1,0 +1,591 @@
+"""Incident-grade tracing tests: trace IDs + spans + Chrome export
+(hydragnn_tpu/obs/trace.py), SLO trigger rules + rate limiting +
+overhead budget (hydragnn_tpu/obs/triggers.py), incident bundle
+round-trip and crashed-mid-write tolerance, the spans/profiler
+suppression contract, and the queue gauges the serve SLO rules read."""
+
+import json
+import os
+
+import pytest
+
+from hydragnn_tpu.obs.flight import FlightRecorder, read_flight_record
+from hydragnn_tpu.obs.registry import MetricsRegistry
+from hydragnn_tpu.obs.trace import (
+    RequestTrace,
+    Tracer,
+    flight_to_chrome,
+    new_trace_id,
+)
+from hydragnn_tpu.obs.triggers import (
+    RULE_KINDS,
+    IncidentRecorder,
+    TriggerEngine,
+    TriggerRule,
+    TriggerVerdict,
+    list_incidents,
+    validate_incident_bundle,
+    validate_incident_manifest,
+)
+
+
+def _verdict(rule="r", kind="loss_spike", metric="train_loss"):
+    return TriggerVerdict(rule, kind, metric, 9.0, 3.0, 1234.5)
+
+
+class FakeClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+@pytest.fixture
+def no_capture(monkeypatch):
+    """Stub the jax.profiler capture so trigger tests stay hermetic
+    (one real-capture test exercises the true path)."""
+    from hydragnn_tpu.utils import profile
+
+    started = []
+    monkeypatch.setattr(
+        profile, "try_start_capture", lambda prefix: started.append(prefix) or True
+    )
+    monkeypatch.setattr(profile, "stop_capture", lambda: None)
+    return started
+
+
+# ---------------------------------------------------------------------------
+# traces
+# ---------------------------------------------------------------------------
+
+
+def test_trace_ids_unique_and_greppable():
+    ids = {new_trace_id() for _ in range(64)}
+    assert len(ids) == 64
+    assert all(len(i) == 16 for i in ids)
+
+
+def test_request_trace_marks_and_spans():
+    tr = RequestTrace("abc", seq=7)
+    tr.mark("route", bucket=1)
+    tr.add_span("execute", tr.t_admit, tr.t_admit + 0.25, occupancy=4)
+    assert [s["name"] for s in tr.spans] == ["route", "execute"]
+    assert tr.spans[1]["dur_ms"] == pytest.approx(250.0)
+    assert tr.spans[1]["occupancy"] == 4
+    d = tr.to_dict()
+    assert d["trace_id"] == "abc" and d["seq"] == 7 and len(d["spans"]) == 2
+
+
+def test_tracer_disabled_returns_none():
+    t = Tracer(enabled=False)
+    assert t.begin(seq=0) is None
+    t.finish(None)  # null-guarded: the off path must not throw
+    assert t.finished_count == 0
+
+
+def test_tracer_samples_first_and_every_nth_into_flight(tmp_path):
+    path = str(tmp_path / "flight.jsonl")
+    with FlightRecorder(path) as fr:
+        fr.start_run({"run": "t"})
+        tracer = Tracer(flight=fr, enabled=True, sample_every=3)
+        for i in range(7):
+            tr = tracer.begin(seq=i)
+            tr.mark("serve.queue_wait")
+            tracer.finish(tr)
+        fr.end_run(status="stopped")
+    captures = [
+        e for e in read_flight_record(path) if e["kind"] == "trace_capture"
+    ]
+    # traces 0, 3, 6 sampled (first always) — schema-complete events
+    assert [e["seq"] for e in captures] == [0, 3, 6]
+    assert all(e["trace_id"] and e["spans"] for e in captures)
+
+
+def test_tracer_chrome_export_and_flight_to_chrome(tmp_path):
+    path = str(tmp_path / "flight.jsonl")
+    with FlightRecorder(path) as fr:
+        fr.start_run({"run": "demo"})
+        tracer = Tracer(flight=fr, enabled=True, sample_every=1)
+        tr = tracer.begin(seq=0)
+        tr.mark("serve.queue_wait")
+        tr.mark("serve.device_execute", bucket=2)
+        tracer.finish(tr)
+        fr.epoch(0, train_loss=1.0, val_loss=1.1, time=2.5)
+        fr.end_run(status="completed")
+
+    out = str(tmp_path / "trace.json")
+    tracer.export_chrome(out)
+    with open(out) as f:
+        chrome = json.load(f)
+    names = [e["name"] for e in chrome["traceEvents"]]
+    assert "serve.queue_wait" in names and "serve.device_execute" in names
+    assert all(e["ph"] == "X" for e in chrome["traceEvents"])
+
+    # offline join: flight JSONL alone -> one timeline with the epoch
+    joined = flight_to_chrome(path)
+    names = [e["name"] for e in joined["traceEvents"]]
+    assert "serve.device_execute" in names and "epoch 0" in names
+    epoch_ev = next(e for e in joined["traceEvents"] if e["name"] == "epoch 0")
+    assert epoch_ev["dur"] == pytest.approx(2.5e6)
+    assert epoch_ev["args"]["run"] == "demo"
+
+
+# ---------------------------------------------------------------------------
+# trigger rules: each fires on its signal, none on a clean run
+# ---------------------------------------------------------------------------
+
+
+def _engine(rules, registry=None, **kw):
+    kw.setdefault("cooldown_s", 0.0)
+    kw.setdefault("max_incidents", 100)
+    return TriggerEngine(rules, registry=registry or MetricsRegistry(), **kw)
+
+
+def test_latency_p99_rule_fires_only_over_target():
+    r = MetricsRegistry()
+    eng = _engine([TriggerRule("p99", "latency_p99", "serve.latency_s", 0.5)], r)
+    h = r.histogram("serve.latency_s")
+    for _ in range(20):
+        h.observe(0.01)
+    assert eng.evaluate() == []  # clean: p99 well under target
+    for _ in range(20):
+        h.observe(2.0)
+    (v,) = eng.evaluate()
+    assert v.rule == "p99" and v.observed > 0.5 and not v.injected
+
+
+def test_queue_depth_and_age_rules():
+    r = MetricsRegistry()
+    eng = _engine(
+        [
+            TriggerRule("qd", "queue_depth", "serve.queue_depth", 10),
+            TriggerRule("qa", "queue_age", "serve.queue_oldest_age_s", 1.0),
+        ],
+        r,
+    )
+    r.gauge("serve.queue_depth").set(3)
+    r.gauge("serve.queue_oldest_age_s").set(0.2)
+    assert eng.evaluate() == []
+    r.gauge("serve.queue_depth").set(25)
+    (v,) = eng.evaluate()
+    assert v.rule == "qd" and v.observed == 25
+    r.gauge("serve.queue_depth").set(3)
+    r.gauge("serve.queue_oldest_age_s").set(4.5)
+    (v,) = eng.evaluate()
+    assert v.rule == "qa"
+
+
+def test_nonfinite_burst_rule_uses_counter_delta():
+    r = MetricsRegistry()
+    eng = _engine(
+        [TriggerRule("nf", "nonfinite_burst", "train.nonfinite_skipped", 2)], r
+    )
+    c = r.counter("train.nonfinite_skipped")
+    assert eng.evaluate() == []  # zero delta
+    c.inc(1)
+    assert eng.evaluate() == []  # delta 1 < 2
+    c.inc(3)
+    (v,) = eng.evaluate()
+    assert v.rule == "nf" and v.observed == 3  # delta since last evaluate
+    assert eng.evaluate() == []  # delta resets
+
+
+def test_loss_spike_and_mfu_drop_rolling_median_rules():
+    eng = _engine(
+        [
+            TriggerRule("spike", "loss_spike", "train_loss", 3.0),
+            TriggerRule("mfu", "mfu_drop", "mfu", 0.5),
+        ]
+    )
+    for loss, mfu in ((1.0, 0.4), (0.9, 0.41), (0.8, 0.39)):
+        eng.observe("train_loss", loss)
+        eng.observe("mfu", mfu)
+        assert eng.evaluate() == []  # a healthy declining run
+    eng.observe("train_loss", 5.0)  # > 3x median(1.0, 0.9, 0.8)
+    eng.observe("mfu", 0.4)
+    (v,) = eng.evaluate()
+    assert v.rule == "spike" and v.detail["rolling_median"] == pytest.approx(0.9)
+    eng.observe("train_loss", 0.7)
+    eng.observe("mfu", 0.05)  # < 0.5x median
+    (v,) = eng.evaluate()
+    assert v.rule == "mfu"
+
+
+def test_observe_drops_none_samples():
+    eng = _engine([TriggerRule("mfu", "mfu_drop", "mfu", 0.5)])
+    for _ in range(5):
+        eng.observe("mfu", None)  # MFU unavailable off-TPU
+    assert eng.evaluate() == []
+
+
+def test_injected_trigger_force_fires_once(monkeypatch):
+    monkeypatch.setenv("HYDRAGNN_INJECT_TRIGGER", "forced_rule")
+    from hydragnn_tpu.resilience import inject
+
+    monkeypatch.setattr(inject, "_TRIGGER_FIRED", False)
+    other = _engine([TriggerRule("other", "loss_spike", "x", 3.0)])
+    assert other.evaluate() == []  # unknown rule name: NOT consumed
+    eng = _engine([TriggerRule("forced_rule", "loss_spike", "train_loss", 3.0)])
+    (v,) = eng.evaluate()
+    assert v.injected and v.rule == "forced_rule"
+    assert eng.evaluate() == []  # one-shot
+
+
+# ---------------------------------------------------------------------------
+# rate limiting + overhead budget
+# ---------------------------------------------------------------------------
+
+
+def test_engine_admits_at_most_one_verdict_per_evaluate():
+    r = MetricsRegistry()
+    eng = _engine(
+        [
+            TriggerRule("qd", "queue_depth", "serve.queue_depth", 1),
+            TriggerRule("qa", "queue_age", "serve.queue_oldest_age_s", 0.1),
+        ],
+        r,
+    )
+    r.gauge("serve.queue_depth").set(10)
+    r.gauge("serve.queue_oldest_age_s").set(10.0)
+    admitted = eng.evaluate()
+    assert len(admitted) == 1 and eng.suppressed == 1
+
+
+def test_engine_cooldown_and_max_incidents():
+    clock = FakeClock()
+    r = MetricsRegistry()
+    eng = TriggerEngine(
+        [TriggerRule("qd", "queue_depth", "serve.queue_depth", 1)],
+        registry=r,
+        cooldown_s=60.0,
+        max_incidents=2,
+        clock=clock,
+    )
+    r.gauge("serve.queue_depth").set(10)
+    assert len(eng.evaluate()) == 1
+    assert eng.evaluate() == []  # inside cooldown
+    clock.advance(61)
+    assert len(eng.evaluate()) == 1
+    clock.advance(61)
+    assert eng.evaluate() == []  # max_incidents reached
+    s = eng.summary()
+    assert s["fired"] == 2 and s["suppressed"] == 2
+    assert s["incidents"] == ["qd", "qd"]
+    assert 0.0 <= s["overhead_frac"] < 1.0
+
+
+def test_recorder_overhead_budget_suppresses_new_incidents(tmp_path, no_capture):
+    clock = FakeClock()
+    rec = IncidentRecorder(
+        str(tmp_path / "incidents"),
+        profile_steps=1000,
+        profile_s=30.0,
+        overhead_frac=0.05,
+        clock=clock,
+    )
+    clock.advance(10.0)
+    # the FIRST capture is always admitted (zero spent so far) — a short
+    # CI run must still capture its one planned incident
+    inc = rec.open_incident(_verdict())
+    assert inc is not None
+    rec.tick()  # starts the capture clock
+    clock.advance(31.0)
+    rec.tick()  # ...the 30s wall bound trips
+    assert rec.open is None
+    assert rec.capture_s == pytest.approx(31.0)
+    # spent 31s of capture in ~41s of run: way over the 5% budget
+    assert rec.open_incident(_verdict("second")) is None
+    assert rec.suppressed_budget == 1
+    clock.advance(10_000.0)  # 31s / 10ks ~ 0.3% — budget recovered
+    assert rec.open_incident(_verdict("third")) is not None
+
+
+def test_recorder_keeps_one_incident_open(tmp_path, no_capture):
+    clock = FakeClock()
+    rec = IncidentRecorder(
+        str(tmp_path / "i"), profile_steps=3, profile_s=999.0,
+        overhead_frac=1.0, clock=clock,
+    )
+    inc = rec.open_incident(_verdict())
+    assert inc is not None
+    assert rec.open_incident(_verdict("other")) is None  # one at a time
+    for _ in range(3):
+        rec.tick()
+    assert rec.open is None and rec.closed_ids == [inc.id]
+    assert rec.open_incident(_verdict("other")) is not None  # slot free
+
+
+def test_incident_capture_bounded_by_wall_time(tmp_path, no_capture):
+    clock = FakeClock()
+    rec = IncidentRecorder(
+        str(tmp_path / "i"), profile_steps=10_000, profile_s=5.0,
+        overhead_frac=1.0, clock=clock,
+    )
+    rec.open_incident(_verdict())
+    rec.tick()
+    assert rec.open is not None
+    clock.advance(6.0)  # wall bound trips before the step bound
+    rec.tick()
+    assert rec.open is None
+
+
+# ---------------------------------------------------------------------------
+# incident bundles
+# ---------------------------------------------------------------------------
+
+
+def test_incident_bundle_round_trip(tmp_path, no_capture):
+    root = str(tmp_path / "incidents")
+    flight_path = str(tmp_path / "flight.jsonl")
+    with FlightRecorder(flight_path) as fr:
+        fr.start_run({"run": "t"})
+        reg = MetricsRegistry()
+        reg.counter("train.nonfinite_skipped").inc(4)
+        rec = IncidentRecorder(
+            root, registry=reg, flight_path=flight_path,
+            profile_steps=2, profile_s=999.0, overhead_frac=1.0,
+        )
+        inc = rec.open_incident(_verdict("nf", "nonfinite_burst"), flight=fr)
+        for _ in range(2):
+            rec.tick()
+        fr.end_run(status="completed")
+
+    (bundle,) = list_incidents(root)
+    assert validate_incident_bundle(bundle) == []
+    with open(os.path.join(bundle, "incident_manifest.json")) as f:
+        man = json.load(f)
+    assert man["rule"] == "nf" and man["status"] == "ok"
+    assert man["trigger"]["observed"] == 9.0
+    assert man["profile"]["steps"] == 2
+    assert validate_incident_manifest(man) == []
+    # every sidecar the manifest names exists and parses
+    with open(os.path.join(bundle, "metrics.json")) as f:
+        assert json.load(f)["train"]["nonfinite_skipped"] == 4
+    with open(os.path.join(bundle, "flight_tail.jsonl")) as f:
+        tail = [json.loads(line) for line in f if line.strip()]
+    # the tail snapshots the record as of OPEN (before the incident
+    # pointer event lands), so run_start is there
+    assert inc is not None
+    assert any(e["kind"] == "run_start" for e in tail)
+    # the flight pointer was recorded at OPEN
+    evs = read_flight_record(flight_path)
+    assert any(e["kind"] == "incident" and e["path"] == bundle for e in evs)
+
+
+def test_incident_finalize_marks_truncated(tmp_path, no_capture):
+    rec = IncidentRecorder(
+        str(tmp_path / "i"), profile_steps=100, profile_s=999.0,
+        overhead_frac=1.0,
+    )
+    rec.open_incident(_verdict())
+    rec.tick()
+    rec.finalize()  # run ends mid-capture
+    (bundle,) = list_incidents(str(tmp_path / "i"))
+    with open(os.path.join(bundle, "incident_manifest.json")) as f:
+        assert json.load(f)["status"] == "truncated"
+    assert validate_incident_bundle(bundle) == []
+
+
+def test_readers_tolerate_crash_mid_incident_write(tmp_path, no_capture):
+    """A run that dies between sidecars and manifest leaves a bundle
+    with NO manifest and a flight record with a TRUNCATED tail line;
+    both must stay readable."""
+    root = str(tmp_path / "incidents")
+    flight_path = str(tmp_path / "flight.jsonl")
+    with FlightRecorder(flight_path) as fr:
+        fr.start_run({"run": "t"})
+        rec = IncidentRecorder(
+            root, flight_path=flight_path, profile_steps=5,
+            profile_s=999.0, overhead_frac=1.0,
+        )
+        rec.open_incident(_verdict(), flight=fr)
+        # crash here: no ticks, no close, no end_run
+    with open(flight_path, "a") as f:
+        f.write('{"v": 2, "kind": "incident", "id": "i002-half')  # torn write
+
+    (bundle,) = list_incidents(root)
+    problems = validate_incident_bundle(bundle)
+    assert problems and "manifest missing" in problems[0]
+    # sidecars written at open are intact
+    assert os.path.exists(os.path.join(bundle, "trigger.json"))
+    # the reader DROPS the torn tail line (the expected crash shape)
+    # instead of raising; the incident pointer survives as the last
+    # parseable event
+    events = read_flight_record(flight_path)
+    assert events[-1]["kind"] == "incident"
+
+    # the renderer narrates the crashed bundle instead of exploding
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "_t_incident_report",
+        os.path.join(os.path.dirname(__file__), "..", "tools", "incident_report.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    text = mod.render_bundle(bundle)
+    assert "NO MANIFEST" in text and "trigger.json" in text
+
+
+def test_incident_manifest_schema_rejects_malformed():
+    assert validate_incident_manifest([]) != []
+    assert any(
+        "missing required field" in p for p in validate_incident_manifest({})
+    )
+    good = {
+        "schema_version": 1,
+        "id": "i001-r",
+        "rule": "r",
+        "kind": "loss_spike",
+        "status": "ok",
+        "trigger": {"rule": "r", "kind": "loss_spike", "observed": 1.0,
+                    "threshold": 3.0},
+        "files": {},
+        "profile": {"captured": False, "steps": 0, "duration_s": 0.0,
+                    "nonempty": False},
+    }
+    assert validate_incident_manifest(good) == []
+    bad = dict(good, kind="not_a_kind")
+    assert any("unknown rule kind" in p for p in validate_incident_manifest(bad))
+
+
+def test_lint_schema_mirrors_runtime_rule_kinds(tmp_path):
+    """graftlint --artifacts must stay jax-free, so lint/artifacts.py
+    carries its own copy of the manifest schema; pin the two against
+    drift."""
+    from hydragnn_tpu.lint.artifacts import (
+        _INCIDENT_RULE_KINDS,
+        validate_artifacts,
+    )
+
+    assert tuple(_INCIDENT_RULE_KINDS) == tuple(RULE_KINDS)
+    good = {
+        "schema_version": 1,
+        "id": "i001-r",
+        "rule": "r",
+        "kind": "latency_p99",
+        "status": "ok",
+        "trigger": {"rule": "r", "kind": "latency_p99", "observed": 1.0,
+                    "threshold": 0.5},
+        "files": {},
+        "profile": {"captured": True, "steps": 3, "duration_s": 1.0,
+                    "nonempty": False},
+    }
+    path = tmp_path / "incident_manifest.json"
+    path.write_text(json.dumps(good))
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    assert validate_artifacts(repo_root, [str(path)]) == []
+    path.write_text(json.dumps(dict(good, profile={})))
+    findings = validate_artifacts(repo_root, [str(path)])
+    assert findings and all(f.rule == "HGART" for f in findings)
+
+
+def test_rule_kind_validation():
+    with pytest.raises(ValueError):
+        TriggerRule("x", "not_a_kind", "m", 1.0)
+
+
+# ---------------------------------------------------------------------------
+# real capture + spans suppression
+# ---------------------------------------------------------------------------
+
+
+def test_incident_real_profiler_capture(tmp_path):
+    """The true jax.profiler path: one bounded capture lands real trace
+    files in the bundle's profile/ dir and the manifest says so."""
+    import jax
+
+    rec = IncidentRecorder(
+        str(tmp_path / "i"), profile_steps=2, profile_s=999.0,
+        overhead_frac=1.0,
+    )
+    rec.open_incident(_verdict())
+    for _ in range(2):
+        jax.block_until_ready(jax.numpy.ones((8, 8)) @ jax.numpy.ones((8, 8)))
+        rec.tick()
+    (bundle,) = list_incidents(str(tmp_path / "i"))
+    assert validate_incident_bundle(bundle) == []
+    with open(os.path.join(bundle, "incident_manifest.json")) as f:
+        man = json.load(f)
+    assert man["profile"]["captured"] is True
+    assert man["profile"]["nonempty"] is True
+
+
+def test_spans_sampling_suppressed_while_capture_active(monkeypatch):
+    """Satellite pin: StepSpans' sampled block_until_ready window must
+    NOT fire while a profiler capture is live — the sync fence would
+    serialize the very steps being profiled."""
+    from hydragnn_tpu.obs.spans import StepSpans
+    from hydragnn_tpu.utils import profile
+
+    spans = StepSpans(sample_steps=2, skip_first=0)
+    spans.epoch_start(0)
+    monkeypatch.setattr(profile, "capture_active", lambda: True)
+    for _ in range(3):
+        spans.step(lambda: 1.0)
+    assert spans.sampled == 0  # every sample skipped outright
+    assert spans.steps == 3  # the step index still advanced
+
+    spans.epoch_start(1)
+    monkeypatch.setattr(profile, "capture_active", lambda: False)
+    for _ in range(3):
+        spans.step(lambda: 1.0)
+    assert spans.sampled == 2  # normal sampling resumes
+
+
+def test_capture_slot_is_exclusive(tmp_path, monkeypatch):
+    """utils/profile.py owns the ONE process-wide jax trace slot:
+    a second start is refused, not raised."""
+    from hydragnn_tpu.utils import profile
+
+    calls = []
+    monkeypatch.setattr(
+        profile.jax.profiler, "start_trace", lambda p: calls.append(p)
+    )
+    monkeypatch.setattr(profile.jax.profiler, "stop_trace", lambda: None)
+    assert profile.try_start_capture(str(tmp_path / "a"))
+    assert profile.capture_active()
+    assert not profile.try_start_capture(str(tmp_path / "b"))  # refused
+    profile.stop_capture()
+    assert not profile.capture_active()
+    assert profile.try_start_capture(str(tmp_path / "c"))
+    profile.stop_capture()
+    assert calls == [str(tmp_path / "a"), str(tmp_path / "c")]
+
+
+# ---------------------------------------------------------------------------
+# queue gauges (the serve SLO rules' inputs)
+# ---------------------------------------------------------------------------
+
+
+def test_batcher_oldest_age_tracks_head_request():
+    from hydragnn_tpu.serve.batcher import MicroBatchQueue
+
+    q = MicroBatchQueue(num_buckets=2, max_batch=4, max_delay_s=60.0,
+                        max_pending=16)
+    assert q.oldest_age_s() == 0.0
+    q.put(0, "a", seq=0)
+    import time as _time
+
+    _time.sleep(0.01)
+    q.put(1, "b", seq=1)
+    assert q.oldest_age_s() >= 0.01  # head of bucket 0 is the oldest
+    q.cancel_pending()
+    assert q.oldest_age_s() == 0.0
+
+
+def test_queue_gauges_reach_prometheus_text():
+    from hydragnn_tpu.serve.metrics import ServeMetrics
+
+    m = ServeMetrics(num_buckets=1, registry=MetricsRegistry())
+    m.set_queue_depth(5, oldest_age_s=1.25)
+    snap = m.snapshot()
+    assert snap["queue_depth"] == 5
+    assert snap["queue_oldest_age_s"] == 1.25
+    text = m.to_prometheus_text()
+    assert 'hydragnn_serve_queue_depth{rank="0"} 5' in text
+    assert 'hydragnn_serve_queue_oldest_age_s{rank="0"} 1.25' in text

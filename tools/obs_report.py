@@ -318,6 +318,7 @@ def render_faults(events: List[dict]) -> str:
         "reload_failed": sum(
             1 for e in events if e.get("kind") == "reload_failed"
         ),
+        "incidents": sum(1 for e in events if e.get("kind") == "incident"),
         "nonfinite_skipped": sum(
             (e.get("nonfinite") or {}).get("skipped", 0)
             for e in events
@@ -366,6 +367,10 @@ def render_faults(events: List[dict]) -> str:
                 f"source={e.get('source')} rolled_back={e.get('rolled_back')} "
                 f"error={str(e.get('error') or '')[:80]}"
             )
+        elif kind == "incident":
+            # SLO trigger fired; the bundle at `path` holds the evidence
+            # (render it with tools/incident_report.py)
+            detail = f"id={e.get('id')} rule={e.get('rule')} path={e.get('path')}"
         elif kind == "run_end":
             detail = f"status={e.get('status')}"
         else:
