@@ -120,6 +120,7 @@ def main() -> None:
     per_thread = np.array_split(order, n_threads)
     errors: list = []
 
+    # graftsync: thread-root
     def client(idx_list) -> None:
         try:
             for i in idx_list:
@@ -127,6 +128,7 @@ def main() -> None:
         except BaseException as exc:  # pragma: no cover - surfaced in record
             errors.append(repr(exc))
 
+    # graftsync: disable=HS004 -- every element is joined in the loop below
     threads = [threading.Thread(target=client, args=(ix,)) for ix in per_thread]
     t0 = time.perf_counter()
     for t in threads:
@@ -354,6 +356,7 @@ def chaos() -> None:
     ready_samples: list = []
     sampling = threading.Event()
 
+    # graftsync: thread-root
     def sampler() -> None:
         while not sampling.wait(0.01):
             ready_samples.append((time.perf_counter(), server.health()["ready"]))

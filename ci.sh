@@ -9,8 +9,8 @@
 #                         baked TPU image ships no formatter, so the gate
 #                         degrades to a full-tree syntax check (compileall)
 #                         and prints which gate ran.
-#   2. graftlint        — tools/graftlint.py (docs/LINT.md): the
-#                         `--changed` pre-commit fast path first, then
+#   2. graftlint +      — tools/graftlint.py (docs/LINT.md): the
+#      graftsync          `--changed` pre-commit fast path first, then
 #                         the AST invariant linter over the whole tree
 #                         (HG001 host-sync-in-hot-path ... HG008
 #                         tracer-leak) with an empty committed baseline,
@@ -23,7 +23,14 @@
 #                         HG005/HG006 — including the aliased `from
 #                         jax.sharding import Mesh as M` case the old
 #                         grep missed) and requires the linter to fail
-#                         on each.
+#                         on each. Then tools/graftsync.py (docs/LINT.md
+#                         HS rules): the thread-safety/lock-discipline
+#                         analyzer — same --changed fast path, full-tree
+#                         scan with an EMPTY committed baseline, and a
+#                         self-test injecting one violation per HS rule
+#                         (HS001 unguarded shared state ... HS006
+#                         lock-order cycle), each of which must
+#                         individually fail the gate.
 #   3. graftcheck       — tools/graftcheck.py (docs/LINT.md, CC rules):
 #                         the compiled-IR contract checker — lowers the
 #                         hot entry points under the pure-DP and fsdp=2
@@ -88,6 +95,15 @@
 #                         tools/serve_probe.py must exit 0 on the
 #                         exported Prometheus textfile
 #                         (docs/RESILIENCE.md "Serving resilience").
+#                         Then the lock-order witness smoke: the same
+#                         serve is re-run with HYDRAGNN_LOCK_DEBUG=1
+#                         and an injected lock-order inversion
+#                         (HYDRAGNN_INJECT_LOCK_ORDER) — the witness
+#                         must convert it into a schema-valid
+#                         `lock_order` flight event (thread stacks
+#                         attached) while the server keeps answering
+#                         and the probe still exits 0: the witness is
+#                         observability, never an availability risk.
 #  10. exec-cache smoke — persistent AOT executable cache (docs/PERF.md
 #                         "r09 cold start"): train a tiny model once,
 #                         start TWO servers (separate processes) against
@@ -119,7 +135,7 @@
 set -euo pipefail
 cd "$(dirname "$0")"
 
-echo "== [1/14] format gate =="
+echo "== format gate =="
 if python -m black --version >/dev/null 2>&1; then
     python -m black --check .
 elif command -v black >/dev/null 2>&1; then
@@ -129,7 +145,7 @@ else
     python -m compileall -q hydragnn_tpu tests examples tools bench.py bench_scaling.py bench_serve.py __graft_entry__.py
 fi
 
-echo "== [2/14] graftlint (AST invariant linter, docs/LINT.md) =="
+echo "== graftlint (AST invariant linter, docs/LINT.md) =="
 # The --changed fast path first: this is the exact pre-commit loop a
 # developer runs locally (working tree + index vs HEAD), so CI proves
 # the fast path itself stays healthy. The full-tree scan below remains
@@ -187,7 +203,110 @@ done
 echo "graftlint self-test: HG001/HG002/HG005/HG006 each reject their injected violation"
 rm -rf "$LINT_ST"
 
-echo "== [3/14] graftcheck (compiled-IR contract checker, docs/LINT.md CC rules) =="
+echo "== graftsync (thread-safety/lock-discipline analyzer, docs/LINT.md HS rules) =="
+# Same shape as graftlint: the --changed pre-commit fast path first,
+# then the authoritative full-tree scan against the EMPTY committed
+# baseline (tools/graftsync_baseline.json — every finding in the
+# shipped tree is a regression, not a grandfathered debt).
+python tools/graftsync.py --changed || {
+    echo "FAIL: graftsync --changed (pre-commit fast path) found violations"
+    exit 1
+}
+python tools/graftsync.py --json /tmp/graftsync_findings.json || {
+    echo "FAIL: graftsync found violations (JSON artifact: /tmp/graftsync_findings.json)"
+    exit 1
+}
+# Self-test: each HS rule must individually FAIL on an injected
+# violation of the invariant it guards. Fixtures live in a temp dir
+# (tests/ and lint/fixtures are exempt from the HS path policy).
+SYNC_ST="$(mktemp -d)"
+cat > "$SYNC_ST/hs001_unguarded_state.py" <<'EOF'
+import threading
+
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+
+    def add(self, x):
+        self._items.append(x)
+EOF
+cat > "$SYNC_ST/hs002_bare_acquire.py" <<'EOF'
+import threading
+
+_L = threading.Lock()
+
+
+def f(work):
+    _L.acquire()
+    work()
+    _L.release()
+EOF
+cat > "$SYNC_ST/hs003_sleep_under_lock.py" <<'EOF'
+import threading
+import time
+
+_L = threading.Lock()
+
+
+def f():
+    with _L:
+        time.sleep(0.1)
+EOF
+cat > "$SYNC_ST/hs004_unjoined_spawn.py" <<'EOF'
+import threading
+
+
+def work():
+    pass
+
+
+def main():
+    t = threading.Thread(target=work)
+    t.start()
+EOF
+cat > "$SYNC_ST/hs005_undeclared_root.py" <<'EOF'
+import threading
+
+
+def work():
+    pass
+
+
+def main():
+    threading.Thread(target=work, daemon=True).start()
+EOF
+cat > "$SYNC_ST/hs006_lock_order_cycle.py" <<'EOF'
+import threading
+
+
+class A:
+    def __init__(self):
+        self._la = threading.Lock()
+        self._lb = threading.Lock()
+
+    def ab(self):
+        with self._la:
+            with self._lb:
+                pass
+
+    def ba(self):
+        with self._lb:
+            with self._la:
+                pass
+EOF
+for rule in HS001 HS002 HS003 HS004 HS005 HS006; do
+    fixture="$(ls "$SYNC_ST"/$(echo "$rule" | tr '[:upper:]' '[:lower:]')_*.py)"
+    if python tools/graftsync.py --rule "$rule" --strict --no-baseline "$fixture" >/dev/null 2>&1; then
+        echo "FAIL: graftsync self-test — $rule did not flag $fixture"
+        exit 1
+    fi
+done
+echo "graftsync self-test: HS001..HS006 each reject their injected violation"
+rm -rf "$SYNC_ST"
+
+echo "== graftcheck (compiled-IR contract checker, docs/LINT.md CC rules) =="
 # Lowers the registered hot entry points (train step, scan-epoch body,
 # eval/stats steps, serve bucket ladder) under BOTH CI layouts — pure-DP
 # (data=8) and fsdp=2 (data=4, fsdp=2) — on the forced 8-device host
@@ -218,13 +337,13 @@ for cc in cc001 cc002 cc003 cc004 cc005 cc006; do
 done
 echo "graftcheck self-test: CC001..CC006 each reject their injected violation"
 
-echo "== [4/14] chip hygiene report =="
+echo "== chip hygiene report =="
 python tools/chip_hygiene.py || true
 
-echo "== [5/14] serial suite (virtual 8-device CPU mesh, incl. 2-process pass) =="
+echo "== serial suite (virtual 8-device CPU mesh, incl. 2-process pass) =="
 python -m pytest tests/ -q
 
-echo "== [6/14] partitioner smoke (HG002 mesh gate; fsdp=2 train == fsdp=1, flight parallel block) =="
+echo "== partitioner smoke (HG002 mesh gate; fsdp=2 train == fsdp=1, flight parallel block) =="
 # Train, serve, and bench obtain meshes/shardings exclusively through the
 # Partitioner: no module outside hydragnn_tpu/parallel/ may construct a
 # jax.sharding.Mesh directly. tests/ are exempt (they build adversarial
@@ -311,7 +430,7 @@ echo "$PART_OUT" | grep -q "parallel: mesh=" || {
     echo "FAIL: --validate did not surface the parallel block"; exit 1; }
 rm -rf "$PART_DIR"
 
-echo "== [7/14] telemetry smoke (tiny 2-head training -> schema-valid v2 flight record with head diagnostics + MFU ledger) =="
+echo "== telemetry smoke (tiny 2-head training -> schema-valid v2 flight record with head diagnostics + MFU ledger) =="
 SMOKE_DIR="$(mktemp -d)"
 JAX_PLATFORMS=cpu python - "$SMOKE_DIR" <<'EOF'
 import sys
@@ -371,7 +490,7 @@ print("introspection smoke: OK (v2 record, head diagnostics + MFU ledger present
 EOF
 rm -rf "$SMOKE_DIR"
 
-echo "== [8/14] fault-injection smoke (SIGTERM mid-epoch -> supervisor resume) =="
+echo "== fault-injection smoke (SIGTERM mid-epoch -> supervisor resume) =="
 FAULT_DIR="$(mktemp -d)"
 cat > "$FAULT_DIR/child.py" <<'EOF'
 import sys
@@ -439,7 +558,7 @@ print(
 EOF
 rm -rf "$FAULT_DIR"
 
-echo "== [9/14] serve-chaos smoke (poison request -> quarantine; hot reload from the saved checkpoint; health probe) =="
+echo "== serve-chaos smoke (poison request -> quarantine; hot reload from the saved checkpoint; health probe) =="
 SERVE_DIR="$(mktemp -d)"
 JAX_PLATFORMS=cpu python - "$SERVE_DIR" <<'EOF'
 import glob
@@ -525,9 +644,80 @@ if grep -q "WARNING" "$SERVE_DIR/validate.out"; then
 fi
 python tools/obs_report.py --faults "$SERVE_DIR/serve_flight.jsonl"
 python tools/serve_probe.py --prom "$SERVE_DIR/serve.prom" --verbose
+# lock-order witness smoke: serve the same checkpoint with the runtime
+# witness ON (HYDRAGNN_LOCK_DEBUG=1) and a synthetic lock-order
+# inversion injected between two real serve-path locks. The witness
+# must convert the inversion into a `lock_order` flight event (thread
+# stacks attached, record schema-valid) while the server answers
+# normally and the health probe still exits 0 — an enabled witness is
+# pure observability, never an availability risk.
+JAX_PLATFORMS=cpu HYDRAGNN_LOCK_DEBUG=1 \
+    HYDRAGNN_INJECT_LOCK_ORDER="batcher.MicroBatchQueue._cv,flight.FlightRecorder._lock" \
+    python - "$SERVE_DIR" <<'EOF'
+import sys
+
+out = sys.argv[1]
+
+from hydragnn_tpu.api import prepare_loaders_and_config, serve_model
+from hydragnn_tpu.data.synthetic import deterministic_graph_data
+from hydragnn_tpu.flagship import flagship_config
+from hydragnn_tpu.obs import FlightRecorder
+from hydragnn_tpu.obs.flight import read_flight_record, validate_flight_record
+from hydragnn_tpu.serve import ServeConfig
+
+
+def cfg():
+    return flagship_config(hidden_dim=8, num_conv_layers=2, batch_size=5, num_epoch=1)
+
+
+def data():
+    return deterministic_graph_data(
+        number_configurations=20,
+        unit_cell_x_range=(2, 3),
+        unit_cell_y_range=(2, 3),
+        unit_cell_z_range=(2, 3),
+        seed=0,
+    )
+
+
+flight = FlightRecorder(out + "/witness_flight.jsonl")
+server = serve_model(
+    cfg(),
+    samples=data(),
+    log_dir=out + "/logs/",  # the chaos smoke's checkpoint
+    serve_config=ServeConfig(max_batch=4, max_delay_ms=5.0),
+    flight=flight,
+)
+_, _, test_loader, _ = prepare_loaders_and_config(cfg(), data())
+test = (list(test_loader.all_samples) * 4)[:4]
+for s in test:
+    server.predict(s, timeout=120)
+health = server.health()
+assert health["ready"] and health["live"], health
+server.export_prometheus(out + "/witness.prom")
+server.stop()
+
+ev = read_flight_record(out + "/witness_flight.jsonl")
+lock_events = [e for e in ev if e.get("kind") == "lock_order"]
+assert len(lock_events) == 1, f"expected one injected lock_order event, got {lock_events}"
+e = lock_events[0]
+assert e["injected"] is True, e
+assert set(e["locks"]) == {
+    "batcher.MicroBatchQueue._cv",
+    "flight.FlightRecorder._lock",
+}, e["locks"]
+assert e["stacks"], "lock_order event carried no thread stacks"
+problems = validate_flight_record(ev)
+assert not problems, problems
+print(
+    "lock-order witness smoke: OK (injected inversion -> one schema-valid "
+    "lock_order event with thread stacks; server answered with the witness on)"
+)
+EOF
+python tools/serve_probe.py --prom "$SERVE_DIR/witness.prom" --verbose
 rm -rf "$SERVE_DIR"
 
-echo "== [10/14] incident smoke (SLO triggers: clean control -> zero incidents; injected NaN train + wedged serve -> one validated bundle each) =="
+echo "== incident smoke (SLO triggers: clean control -> zero incidents; injected NaN train + wedged serve -> one validated bundle each) =="
 INC_DIR="$(mktemp -d)"
 # --- clean control: triggers armed + tracing on, nothing injected ->
 #     ZERO incidents and sub-1% measured trigger/capture overhead; the
@@ -702,7 +892,7 @@ grep -q "== incident" "$INC_DIR/report.out" || {
 python tools/obs_report.py --faults "$(ls "$INC_DIR"/nan/logs/*/flight.jsonl)"
 rm -rf "$INC_DIR"
 
-echo "== [11/14] exec-cache smoke (train once; two server starts vs one cache dir; corrupt entry -> loud eviction) =="
+echo "== exec-cache smoke (train once; two server starts vs one cache dir; corrupt entry -> loud eviction) =="
 EXEC_DIR="$(mktemp -d)"
 cat > "$EXEC_DIR/serve_once.py" <<'EOF'
 import sys
@@ -785,7 +975,7 @@ grep -q "exec_cache: evicted entry" "$EXEC_DIR/corrupt.err" || {
 }
 rm -rf "$EXEC_DIR"
 
-echo "== [12/14] perf gate (tiny fixed-config bench vs committed baseline) =="
+echo "== perf gate (tiny fixed-config bench vs committed baseline) =="
 # fails on a >15% graphs/sec regression (and MFU regression on TPU)
 # against BENCH_CI_BASELINE.json, keyed per backend:device so every CI
 # machine gates against its own recorded number (tools/bench_gate.py)
@@ -813,17 +1003,17 @@ fi
 JAX_PLATFORMS=cpu python tools/bench_gate.py --warm-start-arm
 
 if [ "${CI_FULL:-0}" = "1" ]; then
-    echo "== [13/14] full acceptance matrix (reference thresholds) =="
+    echo "== full acceptance matrix (reference thresholds) =="
     HYDRAGNN_FULL_MATRIX=1 python -m pytest tests/test_train_matrix.py -q
 else
-    echo "== [13/14] full acceptance matrix: skipped (set CI_FULL=1) =="
+    echo "== full acceptance matrix: skipped (set CI_FULL=1) =="
 fi
 
 if [ "${CI_TPU:-0}" = "1" ]; then
-    echo "== [14/14] real-chip TPU kernel suite =="
+    echo "== real-chip TPU kernel suite =="
     HYDRAGNN_TPU_TESTS=1 python -m pytest tests/test_tpu_chip.py -q
 else
-    echo "== [14/14] real-chip TPU kernel suite: skipped (set CI_TPU=1, needs a TPU) =="
+    echo "== real-chip TPU kernel suite: skipped (set CI_TPU=1, needs a TPU) =="
 fi
 
 echo "CI protocol complete."

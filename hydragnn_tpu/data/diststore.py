@@ -31,6 +31,7 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from hydragnn_tpu.data.dataset import GraphSample
+from hydragnn_tpu.utils import syncdebug
 
 
 def _pack_sample(s: GraphSample) -> bytes:
@@ -145,12 +146,18 @@ class DistSampleStore:
         self.starts = np.concatenate([[0], np.cumsum(self.counts)])
         self.total = int(self.counts.sum())
 
+        # graftsync: guarded-by=diststore.DistSampleStore._lock
         self._cache: "OrderedDict[int, bytes]" = OrderedDict()
         self._cache_size = cache_size
+        # graftsync: thread-safe=set once in __init__ before the accept thread spawns; close() only closes the socket (never reassigns), unblocking accept()
         self._server: Optional[socket.socket] = None
+        # graftsync: thread-safe=populated once in __init__ (before any fetch) and read-only afterwards
         self._peers: List[tuple] = []
+        # graftsync: guarded-by=diststore.DistSampleStore._lock
         self._conns: Dict[int, socket.socket] = {}
-        self._lock = threading.Lock()
+        self._lock = syncdebug.maybe_wrap(
+            threading.Lock(), "diststore.DistSampleStore._lock"
+        )
         if self.nproc > 1:
             self._start_server()
             self._exchange_addresses()
@@ -166,6 +173,7 @@ class DistSampleStore:
         t = threading.Thread(target=self._serve_loop, daemon=True)
         t.start()
 
+    # graftsync: thread-root
     def _serve_loop(self) -> None:
         while True:
             try:
@@ -176,6 +184,7 @@ class DistSampleStore:
                 target=self._serve_conn, args=(conn,), daemon=True
             ).start()
 
+    # graftsync: thread-root
     def _serve_conn(self, conn: socket.socket) -> None:
         try:
             while True:
@@ -254,9 +263,14 @@ class DistSampleStore:
                 self._server.close()
             except OSError:
                 pass
-        for c in self._conns.values():
+        # swap the connection map out under the lock, close outside it: a
+        # concurrent _fetch_remote either kept its conn (gets a
+        # ConnectionError it already handles) or re-caches a fresh one
+        with self._lock:
+            conns = list(self._conns.values())
+            self._conns.clear()
+        for c in conns:
             try:
                 c.close()
             except OSError:
                 pass
-        self._conns.clear()

@@ -491,6 +491,7 @@ class GraphLoader:
                     continue
             return False  # consumer abandoned the generator
 
+        # graftsync: thread-root
         def producer():
             try:
                 for b in range(nb):

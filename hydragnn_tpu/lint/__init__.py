@@ -1,10 +1,14 @@
-"""graftlint — the repo's AST-based invariant linter.
+"""graftlint/graftsync — the repo's AST-based static analyzers.
 
-``core`` holds the framework (Finding/Rule/runner/suppressions/
-baseline), ``rules`` the HG001–HG008 rule set, ``artifacts`` the
-flight-record artifact validator behind ``graftlint --artifacts``.
-docs/LINT.md is the human-facing catalog; ``tools/graftlint.py`` the
-CLI (which loads this package standalone, without importing the
-jax-heavy ``hydragnn_tpu`` root — keep this ``__init__`` free of
+``core`` holds the shared framework (Finding/Rule/runner/
+suppressions/baseline), ``rules`` the graftlint HG001–HG008 rule set,
+``concurrency`` the graftsync HS001–HS006 thread-safety/
+lock-discipline rules plus the static lock-order graph the runtime
+witness (``utils/syncdebug.py``) seeds from, ``ir`` the graftcheck
+compiled-IR contracts, and ``artifacts`` the flight-record artifact
+validator behind ``graftlint --artifacts``. docs/LINT.md is the
+human-facing catalog; ``tools/graftlint.py`` / ``tools/graftsync.py``
+are the CLIs (each loads this package standalone, without importing
+the jax-heavy ``hydragnn_tpu`` root — keep this ``__init__`` free of
 submodule imports so that bootstrap stays cheap and ordering-free).
 """

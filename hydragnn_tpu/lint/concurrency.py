@@ -1,0 +1,1036 @@
+"""graftsync — thread-safety & lock-discipline static analysis (HS rules).
+
+The r06–r12 substrate made hydragnn_tpu heavily multithreaded: serve
+dispatch + DispatchSupervisor, the HangWatchdog heartbeat, loader
+prefetch, diststore connection threads, the flight-recorder write lock,
+the metrics registry, the Tracer ring, the process-wide profiler
+capture slot, the IncidentRecorder. graftlint (HG rules) checks
+AST/JAX invariants and graftcheck (CC rules) compiled IR; this module
+is the third leg — it checks the CONCURRENCY discipline of the tree,
+statically, from plain AST (stdlib-only, no jax import, milliseconds).
+
+Model
+-----
+A class is *concurrent* when it owns a lock (``threading.Lock`` /
+``RLock`` / ``Condition``, possibly wrapped in
+``syncdebug.maybe_wrap``), when one of its methods is the target of a
+``Thread(target=...)`` / ``threading.Timer`` spawn, or when its
+``class`` line carries ``# graftsync: shared``. Thread roots are every
+spawn target plus (implicitly) the main thread calling the public API,
+so every mutable attribute of a concurrent class is cross-thread
+visible and must declare its discipline:
+
+    self._count = 0      # graftsync: guarded-by=batcher.MicroBatchQueue._cv
+    self.enabled = True  # graftsync: thread-safe=GIL-atomic bool gate
+
+Module globals written from functions (``global X`` or container
+mutation) follow the same rule. Locks are named — derived
+``<modstem>.<Class>.<attr>`` / ``<modstem>.<NAME>`` by default,
+overridable with ``# graftsync: lock=<name>`` or the string passed to
+``syncdebug.maybe_wrap``. A method whose callers hold a lock for it
+declares ``# graftsync: holds=<lock>``; the analyzer then checks its
+same-class call sites actually hold that lock. Spawn targets declare
+``# graftsync: thread-root``. Suppressions use the shared graftlint
+grammar: ``# graftsync: disable=HS001 -- reason``.
+
+Rules (docs/LINT.md catalogs invariant + motivating incident):
+  HS001 unguarded-shared-state      declaration + guard-discipline
+  HS002 lock-acquire-without-release-path
+  HS003 blocking-call-under-lock    (block_until_ready, queue.get,
+                                     future resolution, profiler
+                                     capture, sleeps/joins/waits)
+  HS004 thread-spawn-without-join/daemon-policy
+  HS005 undeclared-thread-root
+  HS006 potential-deadlock          static lock-order cycle
+
+The static lock-order graph HS006 builds is also exported through
+:func:`build_lock_order` — ``tools/graftsync.py --order-graph`` dumps
+it, and the runtime witness (``utils/syncdebug.py``,
+``HYDRAGNN_LOCK_DEBUG=1``) seeds its observed-order assertion with it.
+
+Scope: the production tree (tests/ and examples/ spawn threads
+adversarially on purpose and are excluded, mirroring graftlint's
+per-rule excludes). Checks are lexical — a ``with lock:`` region plus
+``holds=`` bodies; call-graph reasoning is one level deep and only
+where resolution is unambiguous, because a linter that guesses is a
+linter that gets suppressed.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from .core import Finding, ParsedModule, Rule, dotted_name
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+_WRAP_TAILS = {"maybe_wrap"}
+
+#: method names whose call mutates the receiver in place — a
+#: ``self.X.append(...)`` is a write to shared state just like
+#: ``self.X = ...``
+_MUTATORS = {
+    "append", "appendleft", "extend", "insert",
+    "pop", "popleft", "popitem", "remove", "discard",
+    "add", "clear", "update", "setdefault", "sort", "reverse",
+    "put", "put_nowait",
+}
+
+#: dotted-tail names that block (or run arbitrary callbacks) and must
+#: not execute while holding a lock; see _blocking_reason for the
+#: context-sensitive members (.get/.wait/.join/.cancel)
+_BLOCKING_TAILS = {
+    "block_until_ready": "device sync",
+    "device_get": "device transfer",
+    "sleep": "sleep",
+    "try_start_capture": "profiler capture",
+    "stop_capture": "profiler capture",
+    "start_trace": "profiler capture",
+    "stop_trace": "profiler capture",
+    "set_exception": "future resolution runs done-callbacks synchronously",
+    "set_result": "future resolution runs done-callbacks synchronously",
+    "result": "future wait",
+}
+
+_ANNOT_RE = re.compile(
+    r"#\s*graftsync:\s*([a-z][a-z-]*)\s*(?:=\s*([^#]*?))?\s*$"
+)
+
+_ANNOT_KINDS = {
+    "lock", "guarded-by", "thread-safe", "holds", "thread-root", "shared",
+}
+
+
+def _parse_annotations(lines: Sequence[str]) -> Dict[int, Tuple[str, str]]:
+    """``{line: (kind, value)}`` for every graftsync annotation;
+    ``disable``/``disable-file`` belong to core's suppression machinery
+    and are skipped here."""
+    out: Dict[int, Tuple[str, str]] = {}
+    for i, line in enumerate(lines, start=1):
+        m = _ANNOT_RE.search(line)
+        if not m:
+            continue
+        kind = m.group(1)
+        if kind not in _ANNOT_KINDS:
+            continue
+        value = (m.group(2) or "").strip()
+        # an optional trailing "-- reason" on name-valued annotations
+        if kind != "thread-safe" and "--" in value:
+            value = value.split("--", 1)[0].strip()
+        out[i] = (kind, value)
+    return out
+
+
+def _annot_at(annots: Dict[int, Tuple[str, str]], line: int,
+              kind: str) -> Optional[str]:
+    """Annotation of ``kind`` on ``line`` or the line directly above."""
+    for at in (line, line - 1):
+        entry = annots.get(at)
+        if entry and entry[0] == kind:
+            return entry[1]
+    return None
+
+
+def _contains_lock_ctor(node: ast.AST) -> bool:
+    for call in ast.walk(node):
+        if isinstance(call, ast.Call):
+            name = dotted_name(call.func)
+            if name and name.split(".")[-1] in _LOCK_CTORS:
+                return True
+    return False
+
+
+def _wrap_name_arg(node: ast.AST) -> Optional[str]:
+    """The lock name passed to ``syncdebug.maybe_wrap(<ctor>, "name")``
+    anywhere inside an assignment value."""
+    for call in ast.walk(node):
+        if isinstance(call, ast.Call):
+            name = dotted_name(call.func)
+            if name and name.split(".")[-1] in _WRAP_TAILS:
+                if len(call.args) > 1 and isinstance(call.args[1], ast.Constant):
+                    v = call.args[1].value
+                    if isinstance(v, str):
+                        return v
+    return None
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _base_self_attr(node: ast.AST) -> Optional[str]:
+    """The ``X`` in a ``self.X[...]...`` chain — the attribute a
+    subscript store or mutator call ultimately mutates."""
+    while isinstance(node, (ast.Subscript, ast.Attribute)):
+        attr = _self_attr(node)
+        if attr is not None:
+            return attr
+        node = node.value
+    return None
+
+
+class _SpawnSite:
+    def __init__(self, call: ast.Call, kind: str, target: Optional[ast.AST],
+                 owner_class: Optional[str], bound: Optional[str],
+                 nested_in: Optional[str]):
+        self.call = call
+        self.kind = kind  # "Thread" | "Timer"
+        self.target = target
+        self.owner_class = owner_class
+        self.bound = bound  # dotted name the spawn was assigned to
+        self.nested_in = nested_in  # enclosing function name
+        self.daemon = any(
+            kw.arg == "daemon"
+            and isinstance(kw.value, ast.Constant)
+            and kw.value.value is True
+            for kw in call.keywords
+        )
+
+
+class _ClassModel:
+    def __init__(self, name: str, node: ast.ClassDef):
+        self.name = name
+        self.node = node
+        self.lock_attrs: Dict[str, str] = {}  # attr -> lock name
+        self.methods: Dict[str, ast.AST] = {}
+        self.guards: Dict[str, str] = {}  # attr -> guarding lock name
+        self.safe: Dict[str, str] = {}  # attr -> thread-safe reason
+        self.decl_lines: Dict[str, int] = {}  # attr -> first assign line
+        # attr -> [(method, node)] writes/mutations outside __init__
+        self.mut_writes: Dict[str, List[Tuple[str, ast.AST]]] = {}
+        # (attr, node, held names, method, nested) — every access
+        self.accesses: List[Tuple[str, ast.AST, Tuple[str, ...], str, bool]] = []
+        # self.M(...) call sites: (method called, held, caller, node, nested)
+        self.self_calls: List[Tuple[str, Tuple[str, ...], str, ast.AST, bool]] = []
+        self.thread_target_methods: Set[str] = set()
+        self.holds: Dict[str, str] = {}  # method -> lock it runs under
+        self.shared_annotated = False
+
+    @property
+    def concurrent(self) -> bool:
+        return bool(
+            self.lock_attrs or self.thread_target_methods
+            or self.shared_annotated
+        )
+
+
+class _ModuleModel:
+    """Everything the HS rules need from one parsed module."""
+
+    def __init__(self, module: ParsedModule):
+        self.module = module
+        self.modstem = os.path.splitext(os.path.basename(module.path))[0]
+        self.annots = _parse_annotations(module.lines)
+        self.classes: Dict[str, _ClassModel] = {}
+        self.module_locks: Dict[str, str] = {}  # global name -> lock name
+        self.global_decl_lines: Dict[str, int] = {}
+        self.global_guards: Dict[str, str] = {}
+        self.global_safe: Dict[str, str] = {}
+        # global -> [(func, node)] function-scope writes/mutations
+        self.global_writes: Dict[str, List[Tuple[str, ast.AST]]] = {}
+        # (name, node, held, func, nested)
+        self.global_accesses: List[
+            Tuple[str, ast.AST, Tuple[str, ...], str, bool]] = []
+        self.spawns: List[_SpawnSite] = []
+        # names that actually resolve to threading.Thread/threading.Timer
+        # in this module: bare imports (from threading import Thread) and
+        # module aliases (import threading [as th]). Keeps locally-defined
+        # Thread/Timer classes (e.g. the utils.time_utils stopwatch) from
+        # being mistaken for spawns.
+        self.threading_names: Set[str] = set()
+        self.threading_mods: Set[str] = {"threading"}
+        self.functions: Dict[str, ast.AST] = {}  # module + nested defs
+        self.daemon_assigns: Set[str] = set()  # dotted names with .daemon = True
+        self.joined: Set[str] = set()  # dotted names with .join(...) calls
+        self.cancelled: Set[str] = set()  # dotted names with .cancel(...) calls
+        self.acquires: List[Tuple[str, str, ast.AST, str]] = []
+        # ^ (lock name, dotted base, node, enclosing function)
+        self.released_in_finally: Dict[str, Set[str]] = {}  # func -> bases
+        # HS003 candidates: (node, tail, reason, held names)
+        self.blocking: List[Tuple[ast.AST, str, str, Tuple[str, ...]]] = []
+        # HS006: lock-order edges (held -> acquired, node)
+        self.edges: List[Tuple[str, str, ast.AST]] = []
+        # locks each function/method acquires directly: qual -> set
+        self.fn_acquires: Dict[str, Set[str]] = {}
+        # calls made while holding: (held names, callee qual or attr tail,
+        #   resolved locally?, node, func)
+        self.held_calls: List[
+            Tuple[Tuple[str, ...], str, bool, ast.AST, str]] = []
+        self._build()
+
+    # -- construction ------------------------------------------------------
+
+    def _build(self) -> None:
+        tree = self.module.tree
+        for stmt in tree.body:
+            if isinstance(stmt, ast.ClassDef):
+                self._build_class(stmt)
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions[stmt.name] = stmt
+            elif isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                self._module_assign(stmt)
+            elif isinstance(stmt, ast.Import):
+                for a in stmt.names:
+                    if a.name == "threading":
+                        self.threading_mods.add(a.asname or "threading")
+            elif isinstance(stmt, ast.ImportFrom):
+                if stmt.module == "threading":
+                    for a in stmt.names:
+                        if a.name in ("Thread", "Timer"):
+                            self.threading_names.add(a.asname or a.name)
+        # second pass: scan executable code (module functions + methods)
+        for stmt in tree.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._scan_function(stmt, owner=None)
+            elif isinstance(stmt, ast.ClassDef):
+                cm = self.classes.get(stmt.name)
+                for sub in stmt.body:
+                    if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        self._scan_function(sub, owner=cm)
+
+    def _module_assign(self, stmt) -> None:
+        targets = (
+            stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+        )
+        names = [t.id for t in targets if isinstance(t, ast.Name)]
+        if not names or stmt.value is None:
+            return
+        for name in names:
+            self.global_decl_lines.setdefault(name, stmt.lineno)
+            if _contains_lock_ctor(stmt.value):
+                lock_name = (
+                    _annot_at(self.annots, stmt.lineno, "lock")
+                    or _wrap_name_arg(stmt.value)
+                    or f"{self.modstem}.{name}"
+                )
+                self.module_locks[name] = lock_name
+            else:
+                guard = _annot_at(self.annots, stmt.lineno, "guarded-by")
+                safe = _annot_at(self.annots, stmt.lineno, "thread-safe")
+                if guard:
+                    self.global_guards[name] = guard
+                if safe is not None:
+                    self.global_safe[name] = safe
+
+    def _build_class(self, node: ast.ClassDef) -> None:
+        cm = _ClassModel(node.name, node)
+        if _annot_at(self.annots, node.lineno, "shared") is not None:
+            cm.shared_annotated = True
+        for sub in node.body:
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                cm.methods[sub.name] = sub
+                holds = _annot_at(self.annots, sub.lineno, "holds")
+                if holds:
+                    cm.holds[sub.name] = holds
+                if _annot_at(self.annots, sub.lineno, "thread-root") is not None:
+                    pass  # recorded for HS005 via spawn resolution
+                # attribute declarations (incl. lock creation)
+                for inner in ast.walk(sub):
+                    if isinstance(inner, (ast.Assign, ast.AnnAssign)):
+                        tgts = (
+                            inner.targets if isinstance(inner, ast.Assign)
+                            else [inner.target]
+                        )
+                        for t in tgts:
+                            attr = _self_attr(t)
+                            if attr is None or inner.value is None:
+                                continue
+                            cm.decl_lines.setdefault(attr, inner.lineno)
+                            if _contains_lock_ctor(inner.value):
+                                lock_name = (
+                                    _annot_at(self.annots, inner.lineno, "lock")
+                                    or _wrap_name_arg(inner.value)
+                                    or f"{self.modstem}.{cm.name}.{attr}"
+                                )
+                                cm.lock_attrs.setdefault(attr, lock_name)
+                                continue
+                            guard = _annot_at(
+                                self.annots, inner.lineno, "guarded-by")
+                            safe = _annot_at(
+                                self.annots, inner.lineno, "thread-safe")
+                            if guard:
+                                cm.guards.setdefault(attr, guard)
+                            if safe is not None:
+                                cm.safe.setdefault(attr, safe)
+        self.classes[node.name] = cm
+
+    # -- lock/expression resolution ----------------------------------------
+
+    def _resolve_lock(self, expr: ast.AST,
+                      owner: Optional[_ClassModel]) -> Optional[str]:
+        attr = _self_attr(expr)
+        if attr is not None and owner is not None:
+            return owner.lock_attrs.get(attr)
+        if isinstance(expr, ast.Name):
+            return self.module_locks.get(expr.id)
+        return None
+
+    # -- executable-code scan ----------------------------------------------
+
+    def _scan_function(self, fn, owner: Optional[_ClassModel]) -> None:
+        qual = f"{owner.name}.{fn.name}" if owner else fn.name
+        held0: Tuple[Tuple[str, str], ...] = ()
+        if owner:
+            holds = owner.holds.get(fn.name)
+        else:
+            # module-level functions may declare holds= too (call-site
+            # verification only happens for same-class methods)
+            holds = _annot_at(self.annots, fn.lineno, "holds")
+        if holds:
+            held0 = ((holds, "<holds>"),)
+        for stmt in fn.body:
+            self._walk(stmt, held0, owner, fn.name, qual, nested=False)
+
+    def _walk(self, node, held, owner, method, qual, nested) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self.functions.setdefault(node.name, node)
+            # a nested def body runs later, in an unknown lock context
+            for stmt in node.body:
+                self._walk(stmt, (), owner, method, qual, nested=True)
+            return
+        if isinstance(node, ast.Lambda):
+            self._walk(node.body, (), owner, method, qual, nested=True)
+            return
+        if isinstance(node, ast.With):
+            new_held = held
+            for item in node.items:
+                self._walk(item.context_expr, held, owner, method, qual, nested)
+                lock = self._resolve_lock(item.context_expr, owner)
+                if lock is not None:
+                    expr_s = dotted_name(item.context_expr) or "<expr>"
+                    if not nested:
+                        for h, _ in new_held:
+                            if h != lock:
+                                self.edges.append((h, lock, node))
+                        self.fn_acquires.setdefault(qual, set()).add(lock)
+                    new_held = new_held + ((lock, expr_s),)
+            for stmt in node.body:
+                self._walk(stmt, new_held, owner, method, qual, nested)
+            return
+
+        if isinstance(node, ast.Global):
+            for name in node.names:
+                self.global_writes.setdefault(name, []).append((qual, node))
+        if isinstance(node, ast.Call):
+            self._handle_call(node, held, owner, method, qual, nested)
+        if isinstance(node, ast.Attribute):
+            attr = _self_attr(node)
+            if attr is not None and owner is not None:
+                held_names = tuple(h for h, _ in held)
+                owner.accesses.append((attr, node, held_names, method, nested))
+                if isinstance(node.ctx, (ast.Store, ast.Del)):
+                    owner.mut_writes.setdefault(attr, []).append((method, node))
+        if isinstance(node, ast.Subscript) and isinstance(
+            node.ctx, (ast.Store, ast.Del)
+        ):
+            attr = _base_self_attr(node)
+            if attr is not None and owner is not None:
+                owner.mut_writes.setdefault(attr, []).append((method, node))
+        if isinstance(node, ast.Name):
+            # module-global access from function scope (not locally bound)
+            if (
+                node.id in self.global_decl_lines
+                and node.id not in self.module_locks
+            ):
+                held_names = tuple(h for h, _ in held)
+                self.global_accesses.append(
+                    (node.id, node, held_names, qual, nested)
+                )
+        if isinstance(node, ast.Assign):
+            self._handle_assign(node, owner, method, qual, nested)
+
+        for child in ast.iter_child_nodes(node):
+            self._walk(child, held, owner, method, qual, nested)
+
+    def _spawn_kind(self, func_name: Optional[str]) -> Optional[str]:
+        """``"Thread"``/``"Timer"`` when ``func_name`` resolves to the
+        threading ctor in this module's import table, else None."""
+        if not func_name:
+            return None
+        parts = func_name.split(".")
+        tail = parts[-1]
+        if tail not in ("Thread", "Timer"):
+            return None
+        if len(parts) == 1:
+            return tail if func_name in self.threading_names else None
+        return tail if ".".join(parts[:-1]) in self.threading_mods else None
+
+    def _handle_assign(self, node: ast.Assign, owner, method, qual,
+                       nested) -> None:
+        # spawn bound to a variable/attribute (for the HS004 join check)
+        if isinstance(node.value, ast.Call):
+            if self._spawn_kind(dotted_name(node.value.func)):
+                for t in node.targets:
+                    bound = dotted_name(t)
+                    if bound:
+                        self._last_spawn_binding = bound
+        # X.daemon = True
+        for t in node.targets:
+            if (
+                isinstance(t, ast.Attribute)
+                and t.attr == "daemon"
+                and isinstance(node.value, ast.Constant)
+                and node.value.value is True
+            ):
+                base = dotted_name(t.value)
+                if base:
+                    self.daemon_assigns.add(base)
+
+    def _handle_call(self, node: ast.Call, held, owner, method, qual,
+                     nested) -> None:
+        func_name = dotted_name(node.func)
+        tail = func_name.split(".")[-1] if func_name else None
+        held_names = tuple(h for h, _ in held)
+
+        # thread/timer spawns
+        spawn_kind = self._spawn_kind(func_name)
+        if spawn_kind:
+            tail = spawn_kind
+            target = None
+            if tail == "Thread":
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        target = kw.value
+            else:
+                if len(node.args) > 1:
+                    target = node.args[1]
+                for kw in node.keywords:
+                    if kw.arg == "function":
+                        target = kw.value
+            bound = None
+            # bound via enclosing Assign (recorded just before in _walk)
+            bound = getattr(self, "_last_spawn_binding", None)
+            self._last_spawn_binding = None
+            self.spawns.append(_SpawnSite(
+                node, tail, target,
+                owner.name if owner else None, bound, qual,
+            ))
+            if target is not None:
+                t_attr = _self_attr(target)
+                if t_attr is not None and owner is not None:
+                    owner.thread_target_methods.add(t_attr)
+
+        # mutator calls on self attributes / globals
+        if isinstance(node.func, ast.Attribute) and node.func.attr in _MUTATORS:
+            base = node.func.value
+            attr = _base_self_attr(base)
+            if attr is not None and owner is not None:
+                owner.mut_writes.setdefault(attr, []).append((method, node))
+            elif isinstance(base, ast.Name) and base.id in self.global_decl_lines:
+                self.global_writes.setdefault(base.id, []).append((qual, node))
+
+        # join/cancel bookkeeping for HS004
+        if isinstance(node.func, ast.Attribute):
+            base_name = dotted_name(node.func.value)
+            if node.func.attr == "join" and base_name:
+                self.joined.add(base_name)
+            if node.func.attr == "cancel" and base_name:
+                self.cancelled.add(base_name)
+
+        # bare acquire/release for HS002
+        if isinstance(node.func, ast.Attribute) and node.func.attr in (
+            "acquire", "release"
+        ):
+            lock = self._resolve_lock(node.func.value, owner)
+            if lock is not None and node.func.attr == "acquire":
+                base = dotted_name(node.func.value) or "<expr>"
+                self.acquires.append((lock, base, node, qual))
+                if not nested:
+                    for h in held_names:
+                        if h != lock:
+                            self.edges.append((h, lock, node))
+                    self.fn_acquires.setdefault(qual, set()).add(lock)
+
+        # same-class calls (holds= verification + HS006 local edges)
+        if owner is not None:
+            m_attr = _self_attr(node.func)
+            if m_attr is not None and m_attr in owner.methods:
+                owner.self_calls.append(
+                    (m_attr, held_names, method, node, nested))
+        if held_names and not nested and tail:
+            local = False
+            if owner is not None and _self_attr(node.func) in owner.methods:
+                local = True
+                callee = f"{owner.name}.{_self_attr(node.func)}"
+            elif isinstance(node.func, ast.Name) and tail in self.functions:
+                local = True
+                callee = tail
+            else:
+                callee = tail
+            self.held_calls.append((held_names, callee, local, node, qual))
+
+        # blocking-call candidates for HS003 (only matter when held)
+        if held_names and not nested:
+            reason = self._blocking_reason(node, tail, held)
+            if reason is not None:
+                self.blocking.append((node, tail or "<call>", reason,
+                                      held_names))
+
+    def _blocking_reason(self, node: ast.Call, tail: Optional[str],
+                         held) -> Optional[str]:
+        if tail in _BLOCKING_TAILS:
+            return _BLOCKING_TAILS[tail]
+        if not isinstance(node.func, ast.Attribute):
+            return None
+        base = node.func.value
+        base_name = dotted_name(base) or ""
+        if tail == "get":
+            # queue.get() blocks; dict.get(key[, default]) never has
+            # zero positional args — the zero-arg form is unambiguous
+            kwargs = {kw.arg for kw in node.keywords}
+            if not node.args and kwargs <= {"timeout", "block"}:
+                return "queue get"
+        if tail in ("wait", "wait_for"):
+            # Condition.wait on the ONLY held lock releases it — legal;
+            # any other wait blocks while something else stays held
+            if len(held) == 1 and held[0][1] == base_name:
+                return None
+            return "wait while a lock is held"
+        if tail == "cancel":
+            parts = base_name.split(".")
+            if any(p in ("future", "fut") for p in parts):
+                return "future resolution runs done-callbacks synchronously"
+        if tail == "join":
+            if isinstance(base, ast.Constant):
+                return None  # "sep".join(...)
+            parts = base_name.split(".")
+            if parts and parts[-1] == "path":
+                return None  # os.path.join
+            if len(node.args) >= 2:
+                return None
+            if len(node.args) == 1 and not isinstance(
+                node.args[0], (ast.Constant, ast.Name, ast.Attribute)
+            ):
+                return None  # sep.join(genexpr)
+            if any(
+                p in ("thread", "worker", "monitor", "_thread", "_worker",
+                      "_monitor", "t", "timer", "_timer", "proc")
+                for p in parts
+            ):
+                return "thread join"
+            return None
+        return None
+
+
+class _Analyzer:
+    """Shared per-run cache: one :class:`_ModuleModel` per file, built
+    lazily the first time any HS rule checks that module."""
+
+    def __init__(self) -> None:
+        self._models: Dict[str, _ModuleModel] = {}
+
+    def model(self, module: ParsedModule) -> _ModuleModel:
+        mm = self._models.get(module.path)
+        if mm is None or mm.module is not module:
+            mm = _ModuleModel(module)
+            self._models[module.path] = mm
+        return mm
+
+
+_HS_EXCLUDE = ("tests/", "examples/", "lint/fixtures")
+
+
+class _HSRule(Rule):
+    severity = "error"
+    exclude = _HS_EXCLUDE
+
+    def __init__(self, analyzer: _Analyzer):
+        self.analyzer = analyzer
+
+
+class UnguardedSharedState(_HSRule):
+    id = "HS001"
+    name = "unguarded-shared-state"
+    description = (
+        "mutable state of a concurrent class (or a module global written "
+        "from functions) must declare '# graftsync: guarded-by=<lock>' or "
+        "'thread-safe=<reason>', and guarded accesses must hold the lock"
+    )
+
+    def check(self, module: ParsedModule) -> Iterator[Finding]:
+        mm = self.analyzer.model(module)
+        for cm in mm.classes.values():
+            if not cm.concurrent:
+                continue
+            yield from self._check_class(module, mm, cm)
+        yield from self._check_globals(module, mm)
+
+    def _check_class(self, module, mm, cm) -> Iterator[Finding]:
+        flagged: Set[str] = set()
+        for attr, writes in sorted(cm.mut_writes.items()):
+            out_of_init = [
+                (m, n) for m, n in writes if m not in ("__init__",)
+            ]
+            if not out_of_init or attr in cm.lock_attrs:
+                continue
+            if attr in cm.guards or attr in cm.safe:
+                continue
+            method, node = out_of_init[0]
+            flagged.add(attr)
+            yield self.finding(
+                module, node,
+                f"attribute '{attr}' of concurrent class '{cm.name}' is "
+                f"mutated in '{method}' without a '# graftsync: "
+                "guarded-by=<lock>' or 'thread-safe=<reason>' declaration "
+                "on its assignment",
+            )
+        for attr, node, held, method, nested in cm.accesses:
+            guard = cm.guards.get(attr)
+            if guard is None or method == "__init__" or nested:
+                continue
+            if guard in held:
+                continue
+            yield self.finding(
+                module, node,
+                f"access to '{attr}' (declared guarded-by={guard}) in "
+                f"'{cm.name}.{method}' without holding {guard} — wrap in "
+                f"'with' or annotate the method '# graftsync: holds={guard}'",
+            )
+        # holds= methods must actually be called with the lock held
+        for callee, held, caller, node, nested in cm.self_calls:
+            need = cm.holds.get(callee)
+            if need is None or nested:
+                continue
+            if need in held:
+                continue
+            yield self.finding(
+                module, node,
+                f"'{cm.name}.{caller}' calls '{callee}' (declared "
+                f"holds={need}) without holding {need}",
+            )
+        # thread-safe declarations must carry a reason
+        for attr, reason in cm.safe.items():
+            if not reason and attr not in flagged:
+                line = cm.decl_lines.get(attr, cm.node.lineno)
+                yield Finding(
+                    rule=self.id, path=module.path, line=line, col=1,
+                    message=(
+                        f"'# graftsync: thread-safe=' on '{cm.name}.{attr}' "
+                        "needs a reason (why is unguarded access safe?)"
+                    ),
+                    severity=self.severity,
+                    snippet=module.snippet(line),
+                )
+
+    def _check_globals(self, module, mm) -> Iterator[Finding]:
+        for name, writes in sorted(mm.global_writes.items()):
+            if name in mm.module_locks:
+                continue
+            if name in mm.global_guards or name in mm.global_safe:
+                continue
+            if name not in mm.global_decl_lines:
+                continue
+            _, node = writes[0]
+            yield self.finding(
+                module, node,
+                f"module global '{name}' is written from function scope "
+                "without a '# graftsync: guarded-by=<lock>' or "
+                "'thread-safe=<reason>' declaration on its module-level "
+                "assignment",
+            )
+        for name, node, held, func, nested in mm.global_accesses:
+            guard = mm.global_guards.get(name)
+            if guard is None or nested:
+                continue
+            if name not in mm.global_writes:
+                # never written from functions: reads are of a constant
+                continue
+            if guard in held:
+                continue
+            yield self.finding(
+                module, node,
+                f"access to module global '{name}' (declared "
+                f"guarded-by={guard}) in '{func}' without holding {guard}",
+            )
+
+
+class AcquireWithoutRelease(_HSRule):
+    id = "HS002"
+    name = "lock-acquire-without-release-path"
+    description = (
+        "a bare lock.acquire() must have a matching release() in a "
+        "finally block of the same function (prefer 'with lock:')"
+    )
+
+    def check(self, module: ParsedModule) -> Iterator[Finding]:
+        mm = self.analyzer.model(module)
+        if not mm.acquires:
+            return
+        # collect bases released inside finally blocks, per function
+        released: Dict[str, Set[str]] = {}
+        for scope_name, fn in self._all_functions(mm):
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Try) and node.finalbody:
+                    for inner in node.finalbody:
+                        for call in ast.walk(inner):
+                            if (
+                                isinstance(call, ast.Call)
+                                and isinstance(call.func, ast.Attribute)
+                                and call.func.attr == "release"
+                            ):
+                                base = dotted_name(call.func.value)
+                                if base:
+                                    released.setdefault(
+                                        scope_name, set()).add(base)
+        for lock, base, node, qual in mm.acquires:
+            if base in released.get(qual, set()):
+                continue
+            yield self.finding(
+                module, node,
+                f"bare acquire of {lock} without a release() in a finally "
+                "block on every exit path — use 'with' or try/finally",
+            )
+
+    @staticmethod
+    def _all_functions(mm):
+        for name, fn in mm.functions.items():
+            yield name, fn
+        for cm in mm.classes.values():
+            for name, fn in cm.methods.items():
+                yield f"{cm.name}.{name}", fn
+
+
+class BlockingCallUnderLock(_HSRule):
+    id = "HS003"
+    name = "blocking-call-under-lock"
+    description = (
+        "device syncs, queue gets, sleeps, thread joins, profiler "
+        "captures and future resolution must not run while holding a lock"
+    )
+
+    def check(self, module: ParsedModule) -> Iterator[Finding]:
+        mm = self.analyzer.model(module)
+        for node, tail, reason, held in mm.blocking:
+            yield self.finding(
+                module, node,
+                f"blocking call '{tail}' ({reason}) while holding "
+                f"{', '.join(held)}",
+            )
+
+
+class SpawnPolicy(_HSRule):
+    id = "HS004"
+    name = "thread-spawn-without-join-or-daemon"
+    description = (
+        "every Thread/Timer spawn must be daemon=True, be joined, or "
+        "(Timer) be cancelled somewhere — otherwise shutdown leaks it"
+    )
+
+    def check(self, module: ParsedModule) -> Iterator[Finding]:
+        mm = self.analyzer.model(module)
+        for sp in mm.spawns:
+            if sp.daemon:
+                continue
+            if sp.bound and (
+                sp.bound in mm.daemon_assigns
+                or sp.bound in mm.joined
+                or (sp.kind == "Timer" and sp.bound in mm.cancelled)
+            ):
+                continue
+            yield self.finding(
+                module, sp.call,
+                f"{sp.kind} spawned without daemon=True and without a "
+                f"join(){' or cancel()' if sp.kind == 'Timer' else ''} "
+                "in this module — declare the shutdown policy",
+            )
+
+
+
+class UndeclaredThreadRoot(_HSRule):
+    id = "HS005"
+    name = "undeclared-thread-root"
+    description = (
+        "every resolvable Thread/Timer target must carry a "
+        "'# graftsync: thread-root' annotation on its def"
+    )
+
+    def check(self, module: ParsedModule) -> Iterator[Finding]:
+        mm = self.analyzer.model(module)
+        for sp in mm.spawns:
+            if sp.target is None:
+                continue
+            if isinstance(sp.target, ast.Lambda):
+                yield self.finding(
+                    module, sp.target,
+                    "lambda thread target cannot be annotated — name the "
+                    "function and mark it '# graftsync: thread-root'",
+                )
+                continue
+            fn = self._resolve_target(mm, sp)
+            if fn is None:
+                continue  # dynamic target: stay quiet rather than guess
+            if _annot_at(mm.annots, fn.lineno, "thread-root") is None:
+                yield self.finding(
+                    module, sp.call,
+                    f"thread target '{self._target_label(sp)}' lacks a "
+                    "'# graftsync: thread-root' annotation on its def "
+                    f"(line {fn.lineno})",
+                )
+
+    @staticmethod
+    def _target_label(sp: _SpawnSite) -> str:
+        return dotted_name(sp.target) or "<target>"
+
+    @staticmethod
+    def _resolve_target(mm: _ModuleModel, sp: _SpawnSite):
+        attr = _self_attr(sp.target)
+        if attr is not None and sp.owner_class:
+            cm = mm.classes.get(sp.owner_class)
+            if cm:
+                return cm.methods.get(attr)
+            return None
+        if isinstance(sp.target, ast.Name):
+            return mm.functions.get(sp.target.id)
+        return None
+
+
+class PotentialDeadlock(_HSRule):
+    id = "HS006"
+    name = "potential-deadlock"
+    description = (
+        "the static lock-order graph (every nested acquire site, plus "
+        "calls made under a lock into methods that acquire) must be a DAG"
+    )
+
+    def __init__(self, analyzer: _Analyzer):
+        super().__init__(analyzer)
+        # edge (a, b) -> (path, line, snippet) of one witness site
+        self._edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+        # lock-acquiring callables across the whole scan, by bare name:
+        # name -> set of lock names (ambiguity tracked by set size > ...)
+        self._method_locks: Dict[str, Set[str]] = {}
+        self._deferred: List[
+            Tuple[Tuple[str, ...], str, str, int, str]] = []
+
+    def check(self, module: ParsedModule) -> Iterator[Finding]:
+        mm = self.analyzer.model(module)
+        for a, b, node in mm.edges:
+            self._note_edge(a, b, module, node)
+        for qual, locks in mm.fn_acquires.items():
+            tail = qual.split(".")[-1]
+            self._method_locks.setdefault(tail, set()).update(locks)
+        for held, callee, local, node, _ in mm.held_calls:
+            if local:
+                locks = mm.fn_acquires.get(callee, set())
+                for h in held:
+                    for lock in locks:
+                        if lock != h:
+                            self._note_edge(h, lock, module, node)
+            else:
+                line = getattr(node, "lineno", 1)
+                self._deferred.append(
+                    (held, callee, module.path, line, module.snippet(line))
+                )
+        return iter(())
+
+    def _note_edge(self, a: str, b: str, module: ParsedModule,
+                   node: ast.AST) -> None:
+        line = getattr(node, "lineno", 1)
+        self._edges.setdefault(
+            (a, b), (module.path, line, module.snippet(line)))
+
+    def finalize(self) -> Iterator[Finding]:
+        # resolve deferred cross-module calls: only when the callee name
+        # unambiguously maps to exactly one lock-acquiring method
+        for held, callee, path, line, snippet in self._deferred:
+            locks = self._method_locks.get(callee)
+            if not locks or len(locks) != 1:
+                continue
+            (lock,) = tuple(locks)
+            for h in held:
+                if h != lock:
+                    self._edges.setdefault((h, lock), (path, line, snippet))
+        adj: Dict[str, Set[str]] = {}
+        for (a, b) in self._edges:
+            adj.setdefault(a, set()).add(b)
+            adj.setdefault(b, set())
+        seen_cycles: Set[frozenset] = set()
+        color: Dict[str, int] = {}
+        stack: List[str] = []
+
+        def dfs(u: str) -> Iterator[List[str]]:
+            color[u] = 1
+            stack.append(u)
+            for v in sorted(adj.get(u, ())):
+                if color.get(v, 0) == 0:
+                    yield from dfs(v)
+                elif color.get(v) == 1:
+                    cycle = stack[stack.index(v):] + [v]
+                    yield cycle
+            stack.pop()
+            color[u] = 2
+
+        findings: List[Finding] = []
+        for node in sorted(adj):
+            if color.get(node, 0) == 0:
+                for cycle in dfs(node):
+                    key = frozenset(cycle)
+                    if key in seen_cycles:
+                        continue
+                    seen_cycles.add(key)
+                    a, b = cycle[0], cycle[1]
+                    path, line, snippet = self._edges[(a, b)]
+                    findings.append(Finding(
+                        rule=self.id, path=path, line=line, col=1,
+                        message=(
+                            "lock-order cycle (potential deadlock): "
+                            + " -> ".join(cycle)
+                        ),
+                        severity=self.severity,
+                        snippet=snippet,
+                    ))
+        return iter(findings)
+
+    def graph(self) -> Dict[str, List]:
+        """The accumulated static lock-order graph (call after a scan)."""
+        locks: Set[str] = set()
+        edges = []
+        for held, callee, path, line, snippet in self._deferred:
+            locks_c = self._method_locks.get(callee)
+            if locks_c and len(locks_c) == 1:
+                (lock,) = tuple(locks_c)
+                for h in held:
+                    if h != lock:
+                        self._edges.setdefault((h, lock),
+                                               (path, line, snippet))
+        for (a, b), (path, line, _) in sorted(self._edges.items()):
+            locks.update((a, b))
+            edges.append({"from": a, "to": b, "site": f"{path}:{line}"})
+        return {"locks": sorted(locks), "edges": edges}
+
+
+def concurrency_rules(repo_root: str) -> List[Rule]:
+    """A fresh HS001–HS006 rule set sharing one analysis cache —
+    build a new set per scan (HS006 accumulates cross-file state)."""
+    analyzer = _Analyzer()
+    return [
+        UnguardedSharedState(analyzer),
+        AcquireWithoutRelease(analyzer),
+        BlockingCallUnderLock(analyzer),
+        SpawnPolicy(analyzer),
+        UndeclaredThreadRoot(analyzer),
+        PotentialDeadlock(analyzer),
+    ]
+
+
+def build_lock_order(
+    repo_root: str, paths: Optional[Sequence[str]] = None
+) -> Dict[str, List]:
+    """Scan the tree (or ``paths``) and return the static lock-order
+    graph ``{"locks": [...], "edges": [{"from", "to", "site"}, ...]}``.
+    This is what ``tools/graftsync.py --order-graph`` dumps and what the
+    runtime witness (``utils/syncdebug.py``) seeds its assertion with."""
+    from .core import run_lint
+
+    rules = concurrency_rules(repo_root)
+    hs006 = next(r for r in rules if r.id == "HS006")
+    run_lint(repo_root, [hs006], paths=paths, baseline=None, full_tree=True)
+    return hs006.graph()

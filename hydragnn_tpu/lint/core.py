@@ -59,11 +59,14 @@ DEFAULT_ROOTS = (
 
 _SKIP_DIRS = {"__pycache__", ".git", ".pytest_cache", "node_modules", ".venv"}
 
+# one suppression grammar for both linters: graftlint (HG rules) and
+# graftsync (HS rules, lint/concurrency.py) — rule ids are disjoint, so
+# either spelling may carry either family
 _SUPPRESS_RE = re.compile(
-    r"#\s*graftlint:\s*disable(?:-file)?=([A-Za-z0-9_,\s]+)"
+    r"#\s*graft(?:lint|sync):\s*disable(?:-file)?=([A-Za-z0-9_,\s]+)"
 )
 _SUPPRESS_FILE_RE = re.compile(
-    r"#\s*graftlint:\s*disable-file=([A-Za-z0-9_,\s]+)"
+    r"#\s*graft(?:lint|sync):\s*disable-file=([A-Za-z0-9_,\s]+)"
 )
 
 
@@ -245,10 +248,12 @@ def load_baseline(path: Optional[str]) -> Set[str]:
     return {e["fingerprint"] for e in data.get("findings", [])}
 
 
-def write_baseline(path: str, findings: Sequence[Finding]) -> None:
+def write_baseline(
+    path: str, findings: Sequence[Finding], tool: str = "graftlint"
+) -> None:
     data = {
         "comment": (
-            "graftlint grandfathered findings (docs/LINT.md). The shipped "
+            f"{tool} grandfathered findings (docs/LINT.md). The shipped "
             "tree is lint-clean: keep this EMPTY; a non-empty baseline is "
             "temporary debt for landing a new rule ahead of its fixes."
         ),

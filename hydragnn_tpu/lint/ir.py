@@ -364,7 +364,7 @@ def active_injections() -> Set[str]:
 
 def _tiny_flagship(device_stack: int, conv_bf16: bool = False,
                    model_type: Optional[str] = None):
-    """The ci.sh stage-5 miniature: flagship config + deterministic
+    """The ci.sh graftcheck-stage miniature: flagship config + deterministic
     graphs, small enough that lowering stays in the seconds range.
     Returns (loader, nn_config, batch, model, variables)."""
     from hydragnn_tpu.api import prepare_loaders_and_config

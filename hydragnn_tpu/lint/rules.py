@@ -157,7 +157,7 @@ class MeshOutsidePartitioner(Rule):
     and nowhere else.
 
     The AST-accurate replacement for the old ``grep -rn 'Mesh('`` gate
-    at ci.sh stage 4: it additionally sees ``jax.sharding.Mesh(...)``
+    in ci.sh's partitioner-smoke stage: it additionally sees ``jax.sharding.Mesh(...)``
     attribute calls, module aliases (``import jax.sharding as sh;
     sh.Mesh(...)``), and aliased imports (``from jax.sharding import
     Mesh as M``) that the grep missed. Every mesh must come from the

@@ -24,8 +24,11 @@ _SRCS = [
 ]
 _BUILD_DIR = os.path.join(_REPO_ROOT, "native", "build")
 
+# graftsync: thread-safe=idempotent memoization; a racing _load() builds an equivalent CDLL and the GIL-atomic store keeps either
 _lib: Optional[ctypes.CDLL] = None
-_LOAD_FAILED = False  # sticky: never retry the compile per-call (hot path)
+# graftsync: thread-safe=GIL-atomic one-way False->True latch; sticky: never retry the compile per-call (hot path)
+_LOAD_FAILED = False
+# graftsync: thread-safe=GIL-atomic one-way False->True latch set after _lib
 HAVE_NATIVE = False
 
 

@@ -26,12 +26,17 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from hydragnn_tpu.utils import syncdebug
+
 # the event jax's dispatch layer records around every backend compile
 BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
 
+# graftsync: guarded-by=compile_monitor._active_lock
 _active: List["CompileMonitor"] = []
-_active_lock = threading.Lock()
-_dispatcher_registered = False
+_active_lock = syncdebug.maybe_wrap(
+    threading.Lock(), "compile_monitor._active_lock"
+)
+_dispatcher_registered = False  # graftsync: guarded-by=compile_monitor._active_lock
 
 
 def _dispatch(event: str, duration_secs: float, **kwargs) -> None:
@@ -52,15 +57,19 @@ def _monitoring_available() -> bool:
 
 def _ensure_dispatcher() -> bool:
     global _dispatcher_registered
-    if _dispatcher_registered:
-        return True
+    # check-and-register under the lock: two monitors starting
+    # concurrently must not both register the dispatcher, or every
+    # compile would be counted twice forever (jax has no unregister)
     if not _monitoring_available():
         return False
-    import jax.monitoring as mon
+    with _active_lock:
+        if _dispatcher_registered:
+            return True
+        import jax.monitoring as mon
 
-    mon.register_event_duration_secs_listener(_dispatch)
-    _dispatcher_registered = True
-    return True
+        mon.register_event_duration_secs_listener(_dispatch)
+        _dispatcher_registered = True
+        return True
 
 
 class CompileMonitor:
@@ -78,12 +87,18 @@ class CompileMonitor:
         registry=None,
     ):
         self._events = frozenset(events)
-        self._lock = threading.Lock()
-        self.count = 0
-        self.total_duration_s = 0.0
+        self._lock = syncdebug.maybe_wrap(
+            threading.Lock(), "compile_monitor.CompileMonitor._lock"
+        )
+        self.count = 0  # graftsync: guarded-by=compile_monitor.CompileMonitor._lock
+        self.total_duration_s = 0.0  # graftsync: guarded-by=compile_monitor.CompileMonitor._lock
+        # graftsync: guarded-by=compile_monitor.CompileMonitor._lock
         self.records: List[Tuple[float, str, float]] = []  # (t, event, dur)
+        # graftsync: guarded-by=compile_monitor.CompileMonitor._lock
         self._marks: Dict[str, int] = {}
+        # graftsync: thread-safe=written only from the lifecycle-owning thread in start(); the dispatch thread only reads
         self.available = False
+        # graftsync: thread-safe=written only from the lifecycle-owning thread in start()/stop()
         self._started = False
         if registry is not None:
             registry.gauge("obs.compile_monitor_available")

@@ -78,6 +78,11 @@ _REQUIRED: Dict[str, tuple] = {
     # incident bundle opened under logs/<run>/incidents/<id>/
     "trace_capture": ("trace_id", "spans"),
     "incident": ("id", "rule", "path"),
+    # runtime lock-order witness (hydragnn_tpu/utils/syncdebug.py,
+    # HYDRAGNN_LOCK_DEBUG=1): an observed acquisition order that
+    # contradicts the static graftsync lock-order graph, with every
+    # thread's stack at the moment of the inversion
+    "lock_order": ("locks", "stacks"),
     # bench evidence events: one per measured config (bench.py) and one
     # per gate verdict (bench_serve.py warm-start check) — required here
     # so graftlint --artifacts can hold the committed BENCH_*.jsonl
@@ -100,6 +105,7 @@ FAULT_KINDS = (
     "reload",
     "reload_failed",
     "incident",
+    "lock_order",
 )
 
 _MANIFEST_REQUIRED = ("jax_version", "backend", "num_processes")
@@ -140,21 +146,27 @@ class FlightRecorder:
     def __init__(self, path: Optional[str], enabled: bool = True):
         import threading
 
+        from hydragnn_tpu.utils import syncdebug
+
         self.path = path
+        # graftsync: thread-safe=GIL-atomic bool gate; a record() racing close() re-checks _f under the lock, worst case one event is dropped
         self.enabled = bool(enabled and path)
-        self._f = None
+        self._f = None  # graftsync: guarded-by=flight.FlightRecorder._lock
         # the watchdog and preemption grace timer record from their own
         # threads; one lock keeps lines whole
-        self._lock = threading.Lock()
+        self._lock = syncdebug.maybe_wrap(
+            threading.Lock(), "flight.FlightRecorder._lock"
+        )
         if self.enabled:
             d = os.path.dirname(os.path.abspath(path))
             os.makedirs(d, exist_ok=True)
             self._f = open(path, "a", buffering=1)
+            syncdebug.register_flight(self)
 
     # -- core --------------------------------------------------------------
 
     def record(self, kind: str, **payload) -> None:
-        if not self.enabled or self._f is None:
+        if not self.enabled:
             return
         event = {
             "v": SCHEMA_VERSION,
@@ -165,6 +177,8 @@ class FlightRecorder:
         event.update({k: _jsonable(v) for k, v in payload.items()})
         try:
             with self._lock:
+                if self._f is None:
+                    return  # closed concurrently after the enabled gate
                 self._f.write(json.dumps(event) + "\n")
                 self._f.flush()
         except (OSError, ValueError):
@@ -207,13 +221,18 @@ class FlightRecorder:
         self.record("run_end", status=status, **payload)
 
     def close(self) -> None:
-        if self._f is not None:
-            try:
-                self._f.close()
-            except OSError:
-                pass
+        # detach under the lock so a concurrent record() either wins the
+        # race (its line lands before the close) or sees _f gone — never
+        # a write to a closed fd; the actual close happens outside
+        with self._lock:
+            f = self._f
             self._f = None
             self.enabled = False
+        if f is not None:
+            try:
+                f.close()
+            except OSError:
+                pass
 
     def __enter__(self) -> "FlightRecorder":
         return self

@@ -28,7 +28,7 @@ import os
 import threading
 from collections import deque
 from typing import Dict, Optional
-from hydragnn_tpu.utils import knobs
+from hydragnn_tpu.utils import knobs, syncdebug
 
 
 def _percentile_nearest_rank(sorted_vals, q: float) -> float:
@@ -49,8 +49,10 @@ class Counter:
 
     def __init__(self, name: str):
         self.name = name
-        self._lock = threading.Lock()
-        self._value = 0.0
+        self._lock = syncdebug.maybe_wrap(
+            threading.Lock(), "registry.Counter._lock"
+        )
+        self._value = 0.0  # graftsync: guarded-by=registry.Counter._lock
 
     def inc(self, n: float = 1) -> None:
         with self._lock:
@@ -73,9 +75,11 @@ class Gauge:
 
     def __init__(self, name: str):
         self.name = name
-        self._lock = threading.Lock()
-        self._value = 0.0
-        self._peak = 0.0
+        self._lock = syncdebug.maybe_wrap(
+            threading.Lock(), "registry.Gauge._lock"
+        )
+        self._value = 0.0  # graftsync: guarded-by=registry.Gauge._lock
+        self._peak = 0.0  # graftsync: guarded-by=registry.Gauge._lock
 
     def set(self, v: float) -> None:
         with self._lock:
@@ -107,10 +111,13 @@ class Histogram:
 
     def __init__(self, name: str, window: int = 2048):
         self.name = name
-        self._lock = threading.Lock()
+        self._lock = syncdebug.maybe_wrap(
+            threading.Lock(), "registry.Histogram._lock"
+        )
+        # graftsync: guarded-by=registry.Histogram._lock
         self._window: deque = deque(maxlen=window)
-        self._count = 0
-        self._sum = 0.0
+        self._count = 0  # graftsync: guarded-by=registry.Histogram._lock
+        self._sum = 0.0  # graftsync: guarded-by=registry.Histogram._lock
 
     def observe(self, v: float) -> None:
         with self._lock:
@@ -183,8 +190,12 @@ class MetricsRegistry:
 
     def __init__(self, enabled: bool = True, rank: Optional[int] = None):
         self.enabled = enabled
+        # graftsync: thread-safe=write-once None->int latch (set under _lock in rank); unlocked reads see None or the final value
         self._rank = rank
-        self._lock = threading.Lock()
+        self._lock = syncdebug.maybe_wrap(
+            threading.Lock(), "registry.MetricsRegistry._lock"
+        )
+        # graftsync: guarded-by=registry.MetricsRegistry._lock
         self._metrics: Dict[str, object] = {}
 
     # -- factories ---------------------------------------------------------
@@ -224,12 +235,18 @@ class MetricsRegistry:
         """This process's rank; resolved lazily so building a registry
         never forces jax backend initialization."""
         if self._rank is None:
+            # resolve OUTSIDE the lock — process_index() can block on
+            # backend init for seconds; racing resolvers compute the
+            # same value and the first write under the lock wins
             try:
                 import jax
 
-                self._rank = jax.process_index()
+                r = jax.process_index()
             except Exception:
-                self._rank = 0
+                r = 0
+            with self._lock:
+                if self._rank is None:
+                    self._rank = r
         return self._rank
 
     def names(self):
@@ -256,8 +273,8 @@ class MetricsRegistry:
         return out
 
 
-_GLOBAL: Optional[MetricsRegistry] = None
-_GLOBAL_LOCK = threading.Lock()
+_GLOBAL: Optional[MetricsRegistry] = None  # graftsync: guarded-by=registry._GLOBAL_LOCK
+_GLOBAL_LOCK = syncdebug.maybe_wrap(threading.Lock(), "registry._GLOBAL_LOCK")
 
 
 def telemetry_enabled() -> bool:

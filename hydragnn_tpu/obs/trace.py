@@ -37,7 +37,7 @@ import time
 from collections import deque
 from typing import Any, Dict, List, Optional, Union
 
-from hydragnn_tpu.utils import knobs
+from hydragnn_tpu.utils import knobs, syncdebug
 
 
 def trace_enabled() -> bool:
@@ -97,9 +97,17 @@ class RequestTrace:
         return round(sum(s["dur_ms"] for s in self.spans), 3)
 
     def to_dict(self) -> dict:
-        d = {"trace_id": self.trace_id, "seq": self.seq, "spans": self.spans}
+        # snapshot, not the live lists: the caller (Tracer.finish, chrome
+        # export) serializes on another thread than the one still holding
+        # this trace — handing out self.spans itself would let a late
+        # mark() mutate the list mid-serialization
+        d = {
+            "trace_id": self.trace_id,
+            "seq": self.seq,
+            "spans": [dict(s) for s in self.spans],
+        }
         if self.attrs:
-            d["attrs"] = self.attrs
+            d["attrs"] = dict(self.attrs)
         return d
 
 
@@ -126,9 +134,12 @@ class Tracer:
             sample_every = knobs.get_int("HYDRAGNN_TRACE_SAMPLE", 100)
         self.sample_every = max(1, int(sample_every))
         self.flight = flight
-        self._lock = threading.Lock()
+        self._lock = syncdebug.maybe_wrap(
+            threading.Lock(), "trace.Tracer._lock"
+        )
+        # graftsync: guarded-by=trace.Tracer._lock
         self._finished: deque = deque(maxlen=max(1, keep))
-        self._count = 0
+        self._count = 0  # graftsync: guarded-by=trace.Tracer._lock
 
     def begin(self, seq: int = -1, **attrs) -> Optional[RequestTrace]:
         if not self.enabled:
@@ -160,17 +171,20 @@ class Tracer:
     def to_chrome_trace(self) -> dict:
         events: List[dict] = []
         for i, tr in enumerate(self.traces()):
+            d = tr.to_dict()
             tid = tr.seq if tr.seq >= 0 else i
-            args = {"trace_id": tr.trace_id}
-            args.update(tr.attrs)
-            events.extend(_chrome_events(tr.spans, pid=1, tid=tid, args=args))
+            args = {"trace_id": d["trace_id"]}
+            args.update(d.get("attrs", {}))
+            events.extend(_chrome_events(d["spans"], pid=1, tid=tid, args=args))
         return {"traceEvents": events, "displayTimeUnit": "ms"}
 
     def export_chrome(self, path: str) -> str:
         """Write the ring as Chrome trace-event JSON; returns ``path``."""
         d = os.path.dirname(os.path.abspath(path))
         os.makedirs(d, exist_ok=True)
-        tmp = path + ".tmp"
+        # per-writer tmp name: two threads exporting to the same path
+        # must each replace atomically, never interleave into one tmp
+        tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.tmp"
         with open(tmp, "w") as f:
             json.dump(self.to_chrome_trace(), f)
         os.replace(tmp, path)
@@ -268,7 +282,7 @@ def export_flight_chrome(record_path: str, out_path: str) -> str:
     data = flight_to_chrome(record_path)
     d = os.path.dirname(os.path.abspath(out_path))
     os.makedirs(d, exist_ok=True)
-    tmp = out_path + ".tmp"
+    tmp = f"{out_path}.{os.getpid()}.{threading.get_ident()}.tmp"
     with open(tmp, "w") as f:
         json.dump(data, f)
     os.replace(tmp, out_path)

@@ -54,7 +54,7 @@ import time
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional
 
-from hydragnn_tpu.utils import knobs
+from hydragnn_tpu.utils import knobs, syncdebug
 
 RULE_KINDS = (
     "latency_p99",
@@ -495,13 +495,18 @@ class IncidentRecorder:
         self.overhead_frac = float(overhead_frac)
         self._clock = clock
         self._t0 = clock()
-        self._lock = threading.Lock()
-        self._seq = 0
+        self._lock = syncdebug.maybe_wrap(
+            threading.Lock(), "triggers.IncidentRecorder._lock"
+        )
+        self._seq = 0  # graftsync: guarded-by=triggers.IncidentRecorder._lock
+        # graftsync: guarded-by=triggers.IncidentRecorder._lock
         self._open: Optional[Incident] = None
-        self.capture_s = 0.0
-        self.suppressed_budget = 0
+        self.capture_s = 0.0  # graftsync: guarded-by=triggers.IncidentRecorder._lock
+        self.suppressed_budget = 0  # graftsync: guarded-by=triggers.IncidentRecorder._lock
+        # graftsync: guarded-by=triggers.IncidentRecorder._lock
         self.closed_ids: List[str] = []
 
+    # graftsync: holds=triggers.IncidentRecorder._lock
     def _budget_exhausted(self) -> bool:
         # charges capture time already SPENT against wall time, so the
         # first capture of a run is always admitted (a short CI run must

@@ -27,6 +27,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 DATA_AXIS = "data"
 
 
+# graftsync: thread-safe=written during single-threaded startup (setup_distributed must precede any other jax call, hence any worker thread)
 _DISTRIBUTED_INITIALIZED = False
 
 

@@ -115,6 +115,7 @@ def maybe_sigterm(step: Optional[int] = None, epoch: Optional[int] = None) -> No
             os.kill(os.getpid(), signal.SIGTERM)
 
 
+# graftsync: thread-safe=fault-injection counter bumped only by the single checkpoint-writing thread
 _CHECKPOINT_SAVES = 0
 
 
@@ -173,6 +174,7 @@ def maybe_serve_nan(outputs, seqs):
     return [np.full_like(np.asarray(o), np.nan) for o in outputs]
 
 
+# graftsync: thread-safe=GIL-atomic one-way False->True latch; only the single dispatch thread writes it
 _SERVE_WEDGED = False
 
 
@@ -200,6 +202,7 @@ def maybe_serve_kill_dispatch(batch_count: int) -> None:
         )
 
 
+# graftsync: thread-safe=GIL-atomic one-way False->True latch; only the single trigger-evaluating thread writes it
 _TRIGGER_FIRED = False
 
 

@@ -94,11 +94,15 @@ class PreemptionHandler:
     ):
         self.grace_s = float(grace_s)
         self.hard_exit = bool(hard_exit)
+        # graftsync: thread-safe=written only by the signal handler, which CPython runs on the main thread; GIL-atomic int
         self.signum: Optional[int] = None
+        # graftsync: thread-safe=written only from the owning thread in install()/uninstall(); the timer thread never touches it
         self.available = False
         self._signals = tuple(signals)
         self._stop = threading.Event()
+        # graftsync: thread-safe=install()/uninstall() run on the owning (main) thread only
         self._old: dict = {}
+        # graftsync: thread-safe=written by the main-thread signal handler and uninstall(); CPython delivers signals on the main thread
         self._timer: Optional[threading.Timer] = None
 
     def install(self) -> "PreemptionHandler":
@@ -133,6 +137,7 @@ class PreemptionHandler:
             t.start()
             self._timer = t
 
+    # graftsync: thread-root
     def _force_exit(self) -> None:
         # runs on the timer thread after the grace window: plain write
         # (no logging machinery) then immediate exit — the evictor's

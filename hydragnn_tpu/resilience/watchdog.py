@@ -81,16 +81,21 @@ class HangWatchdog:
         self.gate = gate
         self.rearm = bool(rearm)
         self.end_run_on_fire = bool(end_run_on_fire)
+        # graftsync: thread-safe=only the single watchdog thread increments; readers tolerate staleness
         self.fire_count = 0
         # the watchdog ARMS only after this many beats: setup (imports,
         # model init) and the first train step's compile legitimately
         # block for longer than any reasonable stall threshold — the
         # same skip-the-compile-step discipline as StepSpans.skip_first
         self.warmup_beats = int(warmup_beats)
+        # graftsync: thread-safe=GIL-atomic bool; written by the watchdog thread, readers only observe a stale False for one poll interval
         self.fired = False
+        # graftsync: thread-safe=GIL-atomic int store from the hot loop; the watchdog thread only compares against warmup_beats
         self._beats = 0
+        # graftsync: thread-safe=GIL-atomic float store (the per-batch heartbeat); a torn read is impossible, a stale one just delays firing by one poll
         self._last_beat = time.monotonic()
         self._stop = threading.Event()
+        # graftsync: thread-safe=start()/stop() run on the owning thread only
         self._thread: Optional[threading.Thread] = None
 
     def beat(self) -> None:
@@ -122,6 +127,7 @@ class HangWatchdog:
 
     # -- internals ---------------------------------------------------------
 
+    # graftsync: thread-root
     def _run(self) -> None:
         while not self._stop.wait(self.poll_s):
             if not self.armed:

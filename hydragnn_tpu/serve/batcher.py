@@ -27,6 +27,8 @@ from collections import deque
 from concurrent.futures import Future
 from typing import Any, List, Optional, Tuple
 
+from hydragnn_tpu.utils import syncdebug
+
 
 class Overloaded(RuntimeError):
     """The request queue is full — explicit load-shedding signal."""
@@ -67,10 +69,13 @@ class MicroBatchQueue:
         self._max_batch = max_batch
         self._max_delay_s = float(max_delay_s)
         self._max_pending = max_pending
-        self._cv = threading.Condition()
+        self._cv = syncdebug.maybe_wrap(
+            threading.Condition(), "batcher.MicroBatchQueue._cv"
+        )
+        # graftsync: guarded-by=batcher.MicroBatchQueue._cv
         self._pending: List[deque] = [deque() for _ in range(num_buckets)]
-        self._count = 0
-        self._closed = False
+        self._count = 0  # graftsync: guarded-by=batcher.MicroBatchQueue._cv
+        self._closed = False  # graftsync: guarded-by=batcher.MicroBatchQueue._cv
 
     def put(self, bucket: int, item: Any, seq: int = -1, trace: Any = None) -> Future:
         """Admit one request into ``bucket``'s lane; returns its Future.
@@ -146,6 +151,7 @@ class MicroBatchQueue:
                     timeout=None if soonest_t is None else max(soonest_t - now, 0.0)
                 )
 
+    # graftsync: holds=batcher.MicroBatchQueue._cv
     def _pop(self, bucket: int) -> List[PendingRequest]:
         dq = self._pending[bucket]
         out = [dq.popleft() for _ in range(min(len(dq), self._max_batch))]
@@ -163,16 +169,20 @@ class MicroBatchQueue:
     def cancel_pending(self, exc: Optional[BaseException] = None) -> int:
         """Fail every queued request (server teardown without drain);
         returns how many were cancelled."""
+        # drain under the lock, resolve futures OUTSIDE it: resolving a
+        # future runs its done-callbacks synchronously on this thread,
+        # and a callback that touches the queue (depth(), a retry
+        # re-put) would deadlock on the non-reentrant Condition
+        drained: List[PendingRequest] = []
         with self._cv:
-            n = 0
             for dq in self._pending:
                 while dq:
-                    req = dq.popleft()
-                    if exc is not None:
-                        req.future.set_exception(exc)
-                    else:
-                        req.future.cancel()
-                    n += 1
+                    drained.append(dq.popleft())
             self._count = 0
             self._cv.notify_all()
-            return n
+        for req in drained:
+            if exc is not None:
+                req.future.set_exception(exc)
+            else:
+                req.future.cancel()
+        return len(drained)

@@ -17,6 +17,8 @@ import dataclasses
 import threading
 from typing import Any, Dict, List, Optional
 
+from hydragnn_tpu.utils import syncdebug
+
 
 @dataclasses.dataclass
 class ServedModel:
@@ -79,7 +81,10 @@ class ModelRegistry:
 
     def __init__(self, log_dir: str = "./logs/"):
         self.log_dir = log_dir
-        self._lock = threading.Lock()
+        self._lock = syncdebug.maybe_wrap(
+            threading.Lock(), "registry.ModelRegistry._lock"
+        )
+        # graftsync: guarded-by=registry.ModelRegistry._lock
         self._models: Dict[str, ServedModel] = {}
 
     def register(

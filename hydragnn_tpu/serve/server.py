@@ -53,7 +53,7 @@ from hydragnn_tpu.serve.batcher import (
 )
 from hydragnn_tpu.serve.buckets import Bucket, BucketCompileCache, build_bucket_ladder, route
 from hydragnn_tpu.serve.metrics import ServeMetrics
-from hydragnn_tpu.utils import knobs
+from hydragnn_tpu.utils import knobs, syncdebug
 from hydragnn_tpu.serve.registry import ServedModel
 
 
@@ -296,27 +296,41 @@ class ModelServer:
             ),
             compat=compat_manifest(layout=(pcfg.data, pcfg.fsdp, pcfg.edge)),
         )
+        # graftsync: thread-safe=MicroBatchQueue is internally synchronized (its own Condition); the reference itself is set once here
         self._queue = MicroBatchQueue(
             len(self.buckets),
             self.config.max_batch,
             self.config.max_delay_ms / 1e3,
             self.config.max_pending,
         )
+        # graftsync: guarded-by=server.ModelServer._eager_lock
         self._eager_shapes: set = set()
-        self._eager_lock = threading.Lock()
+        self._eager_lock = syncdebug.maybe_wrap(
+            threading.Lock(), "server.ModelServer._eager_lock"
+        )
+        # graftsync: thread-safe=GIL-atomic bool lifecycle flags written by the owning thread in start()/stop(); a racing submit sees at worst one stale admit, which the closed queue then rejects
         self._started = False
+        # graftsync: thread-safe=GIL-atomic one-way False->True latch set by the owning thread in stop()
         self._stopped = False
         self._seq = itertools.count()  # admission sequence (injection anchor)
+        # graftsync: thread-safe=only the single dispatch thread increments (in _run)
         self._dispatched_batches = 0
-        self._reload_lock = threading.Lock()
+        self._reload_lock = syncdebug.maybe_wrap(
+            threading.Lock(), "server.ModelServer._reload_lock"
+        )
+        # graftsync: thread-safe=written by the owning thread in start()/stop() before/after the dispatch threads exist; others read the reference
         self._supervisor = None  # built in start()
         self.log_dir = "./logs/"  # reload()'s default checkpoint root
         # per-request tracing + SLO triggers, built in start() (the
         # incident root defaults under log_dir, which api.serve_model
         # stamps after construction)
+        # graftsync: thread-safe=written once in start() before the dispatch thread spawns; Tracer is internally synchronized
         self._tracer = None
+        # graftsync: thread-safe=written once in start() before the dispatch thread spawns
         self._triggers = None
+        # graftsync: thread-safe=written once in start() before the dispatch thread spawns; IncidentRecorder is internally synchronized
         self._incidents = None
+        # graftsync: thread-safe=only the dispatch thread writes (_maybe_trigger runs on the dispatch loop)
         self._last_trigger_eval = 0.0
 
     # -- lifecycle ---------------------------------------------------------

@@ -74,14 +74,23 @@ class DispatchSupervisor:
         self.tick_every_s = float(tick_every_s)
         self.poll_s = float(poll_s)
         self.thread_name = thread_name
+        # graftsync: thread-safe=only the single monitor thread increments; health readers tolerate staleness
         self.restarts = 0
+        # graftsync: thread-safe=GIL-atomic one-way False->True latch set by the monitor thread
         self.failed = False
+        # graftsync: thread-safe=GIL-atomic reference store from the worker thread; the monitor reads it once after join
         self.last_error: Optional[BaseException] = None
+        # graftsync: thread-safe=GIL-atomic bool; dispatch thread writes, watchdog gate reads — a stale read shifts stall attribution by one poll
         self._busy = False
+        # graftsync: thread-safe=only the single monitor thread touches it
         self._was_stalled = False
+        # graftsync: thread-safe=GIL-atomic bool; worker sets it as its last act, the monitor reads it only after is_alive() is False
         self._clean_exit = False
+        # graftsync: thread-safe=GIL-atomic one-way False->True latch set by stop()
         self._stopping = False
+        # graftsync: thread-safe=written by the owning thread (start/stop) and the monitor's crash path; GIL-atomic reference store
         self._worker: Optional[threading.Thread] = None
+        # graftsync: thread-safe=start()/stop() run on the owning thread only
         self._monitor: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self.watchdog = HangWatchdog(
@@ -152,6 +161,7 @@ class DispatchSupervisor:
         )
         self._worker.start()
 
+    # graftsync: thread-root
     def _wrapped(self) -> None:
         try:
             self._target()
@@ -161,6 +171,7 @@ class DispatchSupervisor:
         finally:
             self._busy = False
 
+    # graftsync: thread-root
     def _run_monitor(self) -> None:
         last_tick = time.monotonic()
         while not self._stop.wait(self.poll_s):

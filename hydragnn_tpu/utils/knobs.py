@@ -114,6 +114,11 @@ KNOBS: Dict[str, Knob] = {
            "resilience/inject.py",
            "K: during the K-th checkpoint save, write a torn file and "
            "SIGKILL the process (integrity-validation drill)."),
+        _K("HYDRAGNN_INJECT_LOCK_ORDER", "spec", None, "utils/syncdebug.py",
+           "LOCKA,LOCKB: once both named locks register with the runtime "
+           "witness, synthesize an A->B acquisition then the B->A "
+           "inversion (one-shot; bookkeeping only, no real lock taken) "
+           "to drive the lock_order violation path end to end."),
         _K("HYDRAGNN_INJECT_NAN_STEP", "spec", None, "resilience/inject.py",
            "N[:M]: replace node features with NaN for train steps "
            "N..N+M-1 (drives the non-finite sentry)."),
@@ -151,6 +156,11 @@ KNOBS: Dict[str, Knob] = {
         _K("HYDRAGNN_LOCAL_MIN_ROWS", "int", "200000", "ops/segment_pallas.py",
            "Row threshold below which the local-window kernel family "
            "falls back (its fixed per-call cost needs large operands)."),
+        _K("HYDRAGNN_LOCK_DEBUG", "bool", "0", "utils/syncdebug.py",
+           "Wrap every declared lock in the runtime lock-order witness: "
+           "observed acquisition order is checked against graftsync's "
+           "static lock-order graph; a violation dumps all thread stacks "
+           "into the flight record as a lock_order event (never raises)."),
         _K("HYDRAGNN_MATRIX_REPORT", "path", None, "tests/test_train_e2e.py",
            "Write the acceptance-matrix JSON report to this path."),
         _K("HYDRAGNN_NUM_PREFETCH", "int", "2", "data/loader.py",

@@ -13,6 +13,7 @@ import sys
 from typing import Iterable, Optional
 
 VERBOSITY_LEVELS = (0, 1, 2, 3, 4)
+# graftsync: thread-safe=idempotent memoization; a racing setup builds an equivalent logger and the GIL-atomic store keeps either
 _logger: Optional[logging.Logger] = None
 
 

@@ -21,19 +21,26 @@ from typing import Optional
 
 import jax
 
-# jax allows ONE active profiler trace per process; this flag is the
+from hydragnn_tpu.utils import syncdebug
+
+# jax allows ONE active profiler trace per process; this slot is the
 # arbiter between the epoch-gated Profiler below and incident captures
 # (obs/triggers.py), and the signal obs/spans.py uses to suppress its
 # sampled block_until_ready fence while a capture is live (the fence
-# would serialize the very step being profiled).
-_CAPTURE_LOCK = threading.Lock()
-_CAPTURE_ACTIVE = False
+# would serialize the very step being profiled). The slot is
+# tri-state — "idle" / "active" / "stopping" — because both
+# start_trace and stop_trace block (device sync) and must run OUTSIDE
+# the lock, yet the slot has to stay busy through them: a two-state
+# flag cleared before stop_trace() returns would let a concurrent
+# try_start_capture start a trace the old owner's stop then kills.
+_CAPTURE_LOCK = syncdebug.maybe_wrap(threading.Lock(), "profile._CAPTURE_LOCK")
+_CAPTURE_STATE = "idle"  # graftsync: guarded-by=profile._CAPTURE_LOCK
 
 
 def capture_active() -> bool:
-    """Whether a jax profiler trace is currently being captured."""
+    """Whether a jax profiler trace is being captured (or torn down)."""
     with _CAPTURE_LOCK:
-        return _CAPTURE_ACTIVE
+        return _CAPTURE_STATE != "idle"
 
 
 def try_start_capture(prefix: str) -> bool:
@@ -41,29 +48,35 @@ def try_start_capture(prefix: str) -> bool:
     live; returns whether this caller now owns the capture. Refusal
     (not an exception) is the contract — an incident firing during the
     epoch-gated profiler's window simply captures nothing."""
-    global _CAPTURE_ACTIVE
+    global _CAPTURE_STATE
     with _CAPTURE_LOCK:
-        if _CAPTURE_ACTIVE:
+        if _CAPTURE_STATE != "idle":
             return False
-        _CAPTURE_ACTIVE = True
+        _CAPTURE_STATE = "active"
     try:
         os.makedirs(prefix, exist_ok=True)
         jax.profiler.start_trace(prefix)
     except Exception:
         with _CAPTURE_LOCK:
-            _CAPTURE_ACTIVE = False
+            _CAPTURE_STATE = "idle"
         return False
     return True
 
 
 def stop_capture() -> None:
-    """Stop the live capture (no-op when none is)."""
-    global _CAPTURE_ACTIVE
+    """Stop the live capture (no-op when none is). The slot stays busy
+    ("stopping") until stop_trace returns, so a concurrent
+    try_start_capture cannot start a trace this teardown would kill."""
+    global _CAPTURE_STATE
     with _CAPTURE_LOCK:
-        if not _CAPTURE_ACTIVE:
+        if _CAPTURE_STATE != "active":
             return
-        _CAPTURE_ACTIVE = False
-    jax.profiler.stop_trace()
+        _CAPTURE_STATE = "stopping"
+    try:
+        jax.profiler.stop_trace()
+    finally:
+        with _CAPTURE_LOCK:
+            _CAPTURE_STATE = "idle"
 
 
 class Profiler:

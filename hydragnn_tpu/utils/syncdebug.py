@@ -1,0 +1,325 @@
+"""Runtime lock-order witness (``HYDRAGNN_LOCK_DEBUG=1``).
+
+graftsync (``lint/concurrency.py``) proves the STATIC lock-order graph
+is a DAG; this module watches the DYNAMIC order. Every declared lock in
+the tree is created through :func:`maybe_wrap` — with the knob off (the
+default) that returns the raw lock untouched, so production pays
+nothing. With ``HYDRAGNN_LOCK_DEBUG=1`` each lock is wrapped in a
+:class:`WitnessLock` that records per-thread acquisition order into a
+process-wide order graph, seeded with graftsync's static edges. An
+acquisition that contradicts the graph (acquiring A while holding B
+when A→B is already an observed/static order) is a potential deadlock
+in the making: the witness dumps every thread's stack into the flight
+record as a ``lock_order`` event (``obs/flight.py``), prints a warning,
+and CONTINUES — a witness that deadlocks or raises on the serve path
+would be worse than the bug it hunts.
+
+``HYDRAGNN_INJECT_LOCK_ORDER="<lockA>,<lockB>"`` is the one-shot
+self-test: once both named locks exist, the witness synthesizes an
+A→B acquisition followed by the B→A inversion (bookkeeping only — no
+real lock is taken, so the injection cannot deadlock), driving the
+full violation path end to end. ci.sh uses it to prove a real serve
+process converts an inversion into a validated ``lock_order`` flight
+event without going down.
+
+Lock identity is by NAME (``<modstem>.<Class>.<attr>`` — the graftsync
+naming scheme), not by instance: all Counters share one node, which is
+the standard lockdep coarsening and exactly what the static graph
+reasons about.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import traceback
+import weakref
+from typing import Dict, List, Optional, Set, Tuple
+
+from hydragnn_tpu.utils import knobs
+
+_ENABLED: Optional[bool] = None  # graftsync: thread-safe=write-once None->bool latch; GIL-atomic, worst case two threads read the env twice to the same value
+_STATE_LOCK = threading.Lock()  # graftsync: lock=syncdebug._STATE_LOCK
+# observed + static order edges: name -> set of successors
+_ORDER: Dict[str, Set[str]] = {}  # graftsync: guarded-by=syncdebug._STATE_LOCK
+_REGISTERED: Set[str] = set()  # graftsync: guarded-by=syncdebug._STATE_LOCK
+_SEEN_EDGES: Set[Tuple[str, str]] = set()  # graftsync: guarded-by=syncdebug._STATE_LOCK
+_VIOLATIONS: List[dict] = []  # graftsync: guarded-by=syncdebug._STATE_LOCK
+_FLIGHTS: List = []  # graftsync: guarded-by=syncdebug._STATE_LOCK
+_STATIC_SEEDED = False  # graftsync: guarded-by=syncdebug._STATE_LOCK
+_INJECT_FIRED = False  # graftsync: guarded-by=syncdebug._STATE_LOCK
+_TLS = threading.local()
+
+
+def enabled() -> bool:
+    """Whether the witness is on — ``HYDRAGNN_LOCK_DEBUG`` read once
+    and cached (wrap decisions must be consistent for process life)."""
+    global _ENABLED
+    if _ENABLED is None:
+        _ENABLED = knobs.get_bool("HYDRAGNN_LOCK_DEBUG", False)
+    return _ENABLED
+
+
+def maybe_wrap(lock, name: str):
+    """Wrap ``lock`` in a :class:`WitnessLock` under ``name`` when the
+    witness is enabled; return it untouched otherwise. Every declared
+    lock in the tree is created through this call."""
+    if not enabled():
+        return lock
+    _register(name)
+    return WitnessLock(lock, name)
+
+
+def register_flight(recorder) -> None:
+    """Point the witness at a flight recorder (held weakly) so a
+    violation lands in the run's event log. ``FlightRecorder`` calls
+    this from its own ``__init__``; no-op while the witness is off."""
+    if not enabled():
+        return
+    with _STATE_LOCK:
+        _FLIGHTS.append(weakref.ref(recorder))
+
+
+def violations() -> List[dict]:
+    """Violations recorded so far (copies)."""
+    with _STATE_LOCK:
+        return [dict(v) for v in _VIOLATIONS]
+
+
+def reset() -> None:
+    """Forget all witness state INCLUDING the cached enable decision —
+    test isolation only; never call this from library code."""
+    global _ENABLED, _STATIC_SEEDED, _INJECT_FIRED
+    with _STATE_LOCK:
+        _ENABLED = None
+        _ORDER.clear()
+        _REGISTERED.clear()
+        _SEEN_EDGES.clear()
+        _VIOLATIONS.clear()
+        _FLIGHTS.clear()
+        _STATIC_SEEDED = False
+        _INJECT_FIRED = False
+    _TLS.held = []
+
+
+# -- internals ---------------------------------------------------------------
+
+
+def _held() -> List[str]:
+    held = getattr(_TLS, "held", None)
+    if held is None:
+        held = _TLS.held = []
+    return held
+
+
+def _register(name: str) -> None:
+    with _STATE_LOCK:
+        first = name not in _REGISTERED
+        _REGISTERED.add(name)
+    if first:
+        _seed_static()
+        _maybe_inject()
+
+
+def _seed_static() -> None:
+    """Seed the order graph with graftsync's static edges (once): a
+    runtime acquisition contradicting the STATIC order then fires even
+    if the other direction was never observed at runtime."""
+    global _STATIC_SEEDED
+    with _STATE_LOCK:
+        if _STATIC_SEEDED:
+            return
+        _STATIC_SEEDED = True
+    try:
+        from hydragnn_tpu.lint.concurrency import build_lock_order
+
+        repo_root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        graph = build_lock_order(repo_root)
+    except Exception:
+        return  # no source tree (installed wheel): observed-only mode
+    with _STATE_LOCK:
+        for edge in graph.get("edges", ()):
+            a, b = edge.get("from"), edge.get("to")
+            if a and b:
+                _ORDER.setdefault(a, set()).add(b)
+                _SEEN_EDGES.add((a, b))
+
+
+# graftsync: holds=syncdebug._STATE_LOCK
+def _path_exists_locked(src: str, dst: str) -> bool:
+    """DFS reachability src -> dst in _ORDER; caller holds _STATE_LOCK."""
+    stack, seen = [src], {src}
+    while stack:
+        u = stack.pop()
+        if u == dst:
+            return True
+        for v in _ORDER.get(u, ()):
+            if v not in seen:
+                seen.add(v)
+                stack.append(v)
+    return False
+
+
+def _note_acquire(name: str, injected: bool = False) -> None:
+    held = _held()
+    if held:
+        for h in held:
+            if h == name:
+                continue  # re-entrant (RLock) or same-name sibling
+            # graftsync: disable=HS001 -- deliberate lock-free fast path; a stale read only means we take _STATE_LOCK and re-check below
+            if (h, name) in _SEEN_EDGES:
+                continue  # edge already known and validated
+            with _STATE_LOCK:
+                if (h, name) in _SEEN_EDGES:
+                    continue
+                conflict = _path_exists_locked(name, h)
+                _ORDER.setdefault(h, set()).add(name)
+                _SEEN_EDGES.add((h, name))
+            if conflict:
+                _violation(h, name, injected)
+    held.append(name)
+
+
+def _note_release(name: str) -> None:
+    held = _held()
+    # remove the most recent acquisition of this name (lock release
+    # order need not be LIFO; Python allows arbitrary release order)
+    for i in range(len(held) - 1, -1, -1):
+        if held[i] == name:
+            del held[i]
+            return
+
+
+def _all_thread_stacks() -> Dict[str, List[str]]:
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out: Dict[str, List[str]] = {}
+    for ident, frame in sys._current_frames().items():
+        label = f"{names.get(ident, 'unknown')}({ident})"
+        out[label] = [
+            line.rstrip("\n")
+            for line in traceback.format_stack(frame)[-12:]
+        ]
+    return out
+
+
+def _violation(held_name: str, acquiring: str, injected: bool) -> None:
+    """The witness caught an order inversion: record a ``lock_order``
+    flight event with every thread's stack, warn, and keep going —
+    never raise, never block the acquiring thread's progress."""
+    event = {
+        "locks": [held_name, acquiring],
+        "edge": f"{held_name}->{acquiring}",
+        "conflict": f"{acquiring}->{held_name}",
+        "thread": threading.current_thread().name,
+        "injected": bool(injected),
+        "stacks": _all_thread_stacks(),
+    }
+    with _STATE_LOCK:
+        _VIOLATIONS.append(event)
+        flights = [ref() for ref in _FLIGHTS]
+    try:
+        print(
+            "syncdebug: LOCK-ORDER VIOLATION: acquiring "
+            f"{acquiring!r} while holding {held_name!r} contradicts the "
+            f"known order {acquiring} -> {held_name}"
+            + (" [injected self-test]" if injected else ""),
+            file=sys.stderr,
+        )
+    except Exception:
+        pass
+    for flight in flights:
+        if flight is None:
+            continue
+        try:
+            flight.record("lock_order", **event)
+        except Exception:
+            pass  # a witness must never take the run down
+
+
+def _maybe_inject() -> None:
+    """``HYDRAGNN_INJECT_LOCK_ORDER="A,B"`` one-shot: once both locks
+    are registered, synthesize the A→B order then the B→A inversion —
+    bookkeeping only, no real lock is taken, so the self-test cannot
+    deadlock anything."""
+    global _INJECT_FIRED
+    spec = knobs.get_str("HYDRAGNN_INJECT_LOCK_ORDER")
+    if not spec or "," not in spec:
+        return
+    a, b = (s.strip() for s in spec.split(",", 1))
+    with _STATE_LOCK:
+        if _INJECT_FIRED or a not in _REGISTERED or b not in _REGISTERED:
+            return
+        _INJECT_FIRED = True
+    _note_acquire(a, injected=True)
+    _note_acquire(b, injected=True)
+    _note_release(b)
+    _note_release(a)
+    _note_acquire(b, injected=True)
+    _note_acquire(a, injected=True)  # <- fires: a->b is on record
+    _note_release(a)
+    _note_release(b)
+
+
+class WitnessLock:
+    """Order-witnessing wrapper around a ``Lock``/``RLock``/``Condition``.
+
+    Supports the full context-manager + acquire/release protocol;
+    ``Condition.wait``/``wait_for`` pop the lock from the held stack for
+    the duration (wait releases the underlying lock) and re-note it on
+    return. Everything else delegates.
+    """
+
+    __slots__ = ("_inner", "_name")
+
+    def __init__(self, inner, name: str):
+        self._inner = inner
+        self._name = name
+
+    # -- lock protocol -----------------------------------------------------
+
+    def acquire(self, *args, **kwargs):
+        got = self._inner.acquire(*args, **kwargs)
+        if got is not False:
+            _note_acquire(self._name)
+        return got
+
+    def release(self) -> None:
+        self._inner.release()
+        _note_release(self._name)
+
+    def __enter__(self):
+        self._inner.__enter__()
+        _note_acquire(self._name)
+        return self
+
+    def __exit__(self, *exc):
+        _note_release(self._name)
+        return self._inner.__exit__(*exc)
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    # -- condition protocol ------------------------------------------------
+
+    def wait(self, timeout=None):
+        _note_release(self._name)
+        try:
+            return self._inner.wait(timeout)
+        finally:
+            _note_acquire(self._name)
+
+    def wait_for(self, predicate, timeout=None):
+        _note_release(self._name)
+        try:
+            return self._inner.wait_for(predicate, timeout)
+        finally:
+            _note_acquire(self._name)
+
+    def __getattr__(self, attr):
+        return getattr(self._inner, attr)
+
+    def __repr__(self) -> str:
+        return f"WitnessLock({self._name!r}, {self._inner!r})"

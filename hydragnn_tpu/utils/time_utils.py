@@ -13,6 +13,7 @@ from typing import Dict
 
 from hydragnn_tpu.utils.print_utils import print_distributed
 
+# graftsync: thread-safe=process-global stopwatch registry touched only from the run-driving thread
 _REGISTRY: Dict[str, "Timer"] = {}
 
 

@@ -18,7 +18,7 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 # hardware ledger — measured ~2+ minutes across the suite's dozens of
 # training runs, which blows the tier-1 time budget. The dedicated
 # introspection tests (tests/test_introspect.py, the flight-record e2e
-# in test_obs.py) and the ci.sh stage-4 smoke opt back in explicitly.
+# in test_obs.py) and the ci.sh telemetry smoke opt back in explicitly.
 os.environ.setdefault("HYDRAGNN_DIAGNOSTICS", "0")
 # Persistent compilation cache: repeated test runs skip recompilation.
 # Gated OFF on jax < 0.5: the 0.4.x persistent cache round-trips jitted

@@ -1,0 +1,1376 @@
+"""graftsync: per-HS-rule true-positive / near-miss fixtures, the
+annotation grammar and suppression forms, lock-order cycle detection and
+the --order-graph export, baseline machinery, the runtime lock-order
+witness (HYDRAGNN_LOCK_DEBUG), and regression tests pinning the
+concurrency bugs the analyzer's first full-tree run surfaced.
+
+Fixtures are written to tmp_path (outside the repo) so the HS rules'
+path policy (tests/ and lint/fixtures are exempt) doesn't mask them;
+every run builds a fresh rule set — HS006 accumulates cross-file
+lock-order state per scan.
+"""
+
+import importlib.util
+import json
+import os
+import textwrap
+import threading
+import time
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_cli():
+    path = os.path.join(REPO_ROOT, "tools", "graftsync.py")
+    spec = importlib.util.spec_from_file_location("_graftsync_cli", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+CLI = _load_cli()
+CORE, CONC = CLI._load_lint_pkg()
+
+BASELINE = os.path.join(REPO_ROOT, "tools", "graftsync_baseline.json")
+
+
+def sync_lint(tmp_path, source, rule_ids=None, name="fixture.py",
+              full_tree=False):
+    """Write ``source`` to a tmp file and analyze it with fresh rules.
+    HS006 only reports from finalize(), which run_lint calls on
+    full-tree scans — pass full_tree=True for cycle fixtures."""
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(source))
+    rules = CONC.concurrency_rules(REPO_ROOT)
+    if rule_ids:
+        rules = [r for r in rules if r.id in set(rule_ids)]
+    return CORE.run_lint(
+        REPO_ROOT, rules, paths=[str(p)], full_tree=full_tree
+    )
+
+
+# ---------------------------------------------------------------- HS001
+
+
+class TestUnguardedSharedState:
+    def test_flags_undeclared_mutation_in_concurrent_class(self, tmp_path):
+        findings = sync_lint(
+            tmp_path,
+            """
+            import threading
+
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._items = []
+
+                def add(self, x):
+                    self._items.append(x)
+            """,
+            ["HS001"],
+        )
+        assert [f.rule for f in findings] == ["HS001"]
+        assert "_items" in findings[0].message
+
+    def test_guarded_access_under_lock_is_clean(self, tmp_path):
+        findings = sync_lint(
+            tmp_path,
+            """
+            import threading
+
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    # graftsync: guarded-by=fixture.Box._lock
+                    self._items = []
+
+                def add(self, x):
+                    with self._lock:
+                        self._items.append(x)
+            """,
+            ["HS001"],
+        )
+        assert findings == []
+
+    def test_flags_guarded_access_without_lock(self, tmp_path):
+        findings = sync_lint(
+            tmp_path,
+            """
+            import threading
+
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    # graftsync: guarded-by=fixture.Box._lock
+                    self._items = []
+
+                def add(self, x):
+                    with self._lock:
+                        self._items.append(x)
+
+                def peek(self):
+                    return list(self._items)
+            """,
+            ["HS001"],
+        )
+        assert [f.rule for f in findings] == ["HS001"]
+        assert "without holding" in findings[0].message
+
+    def test_holds_annotation_transfers_the_obligation(self, tmp_path):
+        src = """
+        import threading
+
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                # graftsync: guarded-by=fixture.Box._lock
+                self._items = []
+
+            # graftsync: holds=fixture.Box._lock
+            def _append(self, x):
+                self._items.append(x)
+
+            def add(self, x):
+                with self._lock:
+                    self._append(x)
+        """
+        assert sync_lint(tmp_path, src, ["HS001"]) == []
+
+        # calling a holds= method WITHOUT the lock is the violation
+        findings = sync_lint(
+            tmp_path,
+            src
+            + "\n            def sneak(self, x):\n"
+            "                self._append(x)\n",
+            ["HS001"],
+        )
+        assert any("holds=" in f.message for f in findings)
+
+    def test_flags_unguarded_module_global(self, tmp_path):
+        findings = sync_lint(
+            tmp_path,
+            """
+            _COUNT = 0
+
+
+            def bump():
+                global _COUNT
+                _COUNT += 1
+            """,
+            ["HS001"],
+        )
+        assert [f.rule for f in findings] == ["HS001"]
+        assert "_COUNT" in findings[0].message
+
+    def test_thread_safe_declaration_needs_a_reason(self, tmp_path):
+        findings = sync_lint(
+            tmp_path,
+            """
+            import threading
+
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    # graftsync: thread-safe=
+                    self._n = 0
+
+                def bump(self):
+                    self._n = self._n + 1
+            """,
+            ["HS001"],
+        )
+        assert [f.rule for f in findings] == ["HS001"]
+        assert "needs a reason" in findings[0].message
+
+    def test_thread_safe_with_reason_is_clean(self, tmp_path):
+        findings = sync_lint(
+            tmp_path,
+            """
+            import threading
+
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    # graftsync: thread-safe=GIL-atomic one-way latch
+                    self._n = 0
+
+                def bump(self):
+                    self._n = self._n + 1
+            """,
+            ["HS001"],
+        )
+        assert findings == []
+
+    def test_lockless_class_is_not_concurrent(self, tmp_path):
+        # no lock, no thread targets, no shared annotation: plain object
+        findings = sync_lint(
+            tmp_path,
+            """
+            class Plain:
+                def __init__(self):
+                    self._items = []
+
+                def add(self, x):
+                    self._items.append(x)
+            """,
+            ["HS001"],
+        )
+        assert findings == []
+
+    def test_shared_annotation_makes_a_class_concurrent(self, tmp_path):
+        findings = sync_lint(
+            tmp_path,
+            """
+            # graftsync: shared
+            class Shared:
+                def __init__(self):
+                    self._items = []
+
+                def add(self, x):
+                    self._items.append(x)
+            """,
+            ["HS001"],
+        )
+        assert [f.rule for f in findings] == ["HS001"]
+
+
+# ---------------------------------------------------------------- HS002
+
+
+class TestAcquireWithoutRelease:
+    def test_flags_bare_acquire(self, tmp_path):
+        findings = sync_lint(
+            tmp_path,
+            """
+            import threading
+
+            _L = threading.Lock()
+
+
+            def f(work):
+                _L.acquire()
+                work()
+                _L.release()
+            """,
+            ["HS002"],
+        )
+        assert [f.rule for f in findings] == ["HS002"]
+        assert "finally" in findings[0].message
+
+    def test_try_finally_release_is_clean(self, tmp_path):
+        findings = sync_lint(
+            tmp_path,
+            """
+            import threading
+
+            _L = threading.Lock()
+
+
+            def f(work):
+                _L.acquire()
+                try:
+                    work()
+                finally:
+                    _L.release()
+            """,
+            ["HS002"],
+        )
+        assert findings == []
+
+    def test_with_statement_is_clean(self, tmp_path):
+        findings = sync_lint(
+            tmp_path,
+            """
+            import threading
+
+            _L = threading.Lock()
+
+
+            def f(work):
+                with _L:
+                    work()
+            """,
+            ["HS002"],
+        )
+        assert findings == []
+
+
+# ---------------------------------------------------------------- HS003
+
+
+class TestBlockingCallUnderLock:
+    def test_flags_sleep_under_lock(self, tmp_path):
+        findings = sync_lint(
+            tmp_path,
+            """
+            import threading
+            import time
+
+            _L = threading.Lock()
+
+
+            def f():
+                with _L:
+                    time.sleep(0.1)
+            """,
+            ["HS003"],
+        )
+        assert [f.rule for f in findings] == ["HS003"]
+        assert "sleep" in findings[0].message
+
+    def test_sleep_outside_lock_is_clean(self, tmp_path):
+        findings = sync_lint(
+            tmp_path,
+            """
+            import threading
+            import time
+
+            _L = threading.Lock()
+
+
+            def f():
+                with _L:
+                    pass
+                time.sleep(0.1)
+            """,
+            ["HS003"],
+        )
+        assert findings == []
+
+    def test_condition_wait_on_the_held_lock_is_clean(self, tmp_path):
+        # Condition.wait RELEASES the condition it waits on — the one
+        # blocking-while-held pattern that is the whole point of a CV
+        findings = sync_lint(
+            tmp_path,
+            """
+            import threading
+
+
+            class W:
+                def __init__(self):
+                    self._cv = threading.Condition()
+
+                def wait_ready(self):
+                    with self._cv:
+                        self._cv.wait()
+            """,
+            ["HS003"],
+        )
+        assert findings == []
+
+    def test_wait_with_a_second_lock_held_is_flagged(self, tmp_path):
+        findings = sync_lint(
+            tmp_path,
+            """
+            import threading
+
+
+            class W:
+                def __init__(self):
+                    self._cv = threading.Condition()
+                    self._other = threading.Lock()
+
+                def bad(self):
+                    with self._other:
+                        with self._cv:
+                            self._cv.wait()
+            """,
+            ["HS003"],
+        )
+        assert [f.rule for f in findings] == ["HS003"]
+
+    def test_flags_future_resolution_under_lock(self, tmp_path):
+        # set_exception runs done-callbacks synchronously — resolving
+        # futures under the queue lock is the batcher bug this PR fixed
+        findings = sync_lint(
+            tmp_path,
+            """
+            import threading
+
+
+            class Q:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def fail_all(self, futs, exc):
+                    with self._lock:
+                        for f in futs:
+                            f.set_exception(exc)
+            """,
+            ["HS003"],
+        )
+        assert [f.rule for f in findings] == ["HS003"]
+        assert "done-callbacks" in findings[0].message
+
+
+# ---------------------------------------------------------------- HS004
+
+
+class TestSpawnPolicy:
+    def test_flags_spawn_without_policy(self, tmp_path):
+        findings = sync_lint(
+            tmp_path,
+            """
+            import threading
+
+
+            def work():
+                pass
+
+
+            def main():
+                t = threading.Thread(target=work)
+                t.start()
+            """,
+            ["HS004"],
+        )
+        assert [f.rule for f in findings] == ["HS004"]
+
+    def test_daemon_spawn_is_clean(self, tmp_path):
+        findings = sync_lint(
+            tmp_path,
+            """
+            import threading
+
+
+            def work():
+                pass
+
+
+            def main():
+                t = threading.Thread(target=work, daemon=True)
+                t.start()
+            """,
+            ["HS004"],
+        )
+        assert findings == []
+
+    def test_joined_spawn_is_clean(self, tmp_path):
+        findings = sync_lint(
+            tmp_path,
+            """
+            import threading
+
+
+            def work():
+                pass
+
+
+            def main():
+                t = threading.Thread(target=work)
+                t.start()
+                t.join()
+            """,
+            ["HS004"],
+        )
+        assert findings == []
+
+    def test_cancelled_timer_is_clean(self, tmp_path):
+        findings = sync_lint(
+            tmp_path,
+            """
+            import threading
+
+
+            def work():
+                pass
+
+
+            def main():
+                t = threading.Timer(5.0, work)
+                t.start()
+                t.cancel()
+            """,
+            ["HS004"],
+        )
+        assert findings == []
+
+    def test_local_timer_class_is_not_a_spawn(self, tmp_path):
+        # the repo's utils.time_utils.Timer is a stopwatch; spawn
+        # detection is import-aware and must not flag it
+        findings = sync_lint(
+            tmp_path,
+            """
+            class Timer:
+                def __init__(self, name):
+                    self.name = name
+
+
+            def main():
+                t = Timer("total_training")
+                return t
+            """,
+            ["HS004", "HS005"],
+        )
+        assert findings == []
+
+    def test_threading_import_alias_is_a_spawn(self, tmp_path):
+        findings = sync_lint(
+            tmp_path,
+            """
+            import threading as th
+
+
+            def work():
+                pass
+
+
+            def main():
+                t = th.Thread(target=work)
+                t.start()
+            """,
+            ["HS004"],
+        )
+        assert [f.rule for f in findings] == ["HS004"]
+
+    def test_from_import_timer_is_a_spawn(self, tmp_path):
+        findings = sync_lint(
+            tmp_path,
+            """
+            from threading import Timer
+
+
+            def work():
+                pass
+
+
+            def main():
+                Timer(5.0, work).start()
+            """,
+            ["HS004"],
+        )
+        assert [f.rule for f in findings] == ["HS004"]
+
+
+# ---------------------------------------------------------------- HS005
+
+
+class TestUndeclaredThreadRoot:
+    def test_flags_unannotated_target(self, tmp_path):
+        findings = sync_lint(
+            tmp_path,
+            """
+            import threading
+
+
+            def work():
+                pass
+
+
+            def main():
+                threading.Thread(target=work, daemon=True).start()
+            """,
+            ["HS005"],
+        )
+        assert [f.rule for f in findings] == ["HS005"]
+        assert "thread-root" in findings[0].message
+
+    def test_annotated_target_is_clean(self, tmp_path):
+        findings = sync_lint(
+            tmp_path,
+            """
+            import threading
+
+
+            # graftsync: thread-root
+            def work():
+                pass
+
+
+            def main():
+                threading.Thread(target=work, daemon=True).start()
+            """,
+            ["HS005"],
+        )
+        assert findings == []
+
+    def test_lambda_target_is_flagged(self, tmp_path):
+        findings = sync_lint(
+            tmp_path,
+            """
+            import threading
+
+
+            def main():
+                threading.Thread(target=lambda: None, daemon=True).start()
+            """,
+            ["HS005"],
+        )
+        assert [f.rule for f in findings] == ["HS005"]
+        assert "lambda" in findings[0].message
+
+    def test_dynamic_target_stays_quiet(self, tmp_path):
+        # an unresolvable callable: guessing would be noise
+        findings = sync_lint(
+            tmp_path,
+            """
+            import threading
+
+
+            class S:
+                def __init__(self, target):
+                    self._target = target
+
+                def start(self):
+                    threading.Thread(
+                        target=self._target, daemon=True
+                    ).start()
+            """,
+            ["HS005"],
+        )
+        assert findings == []
+
+    def test_annotated_method_target_is_clean(self, tmp_path):
+        findings = sync_lint(
+            tmp_path,
+            """
+            import threading
+
+
+            class S:
+                # graftsync: thread-root
+                def _run(self):
+                    pass
+
+                def start(self):
+                    threading.Thread(target=self._run, daemon=True).start()
+            """,
+            ["HS005"],
+        )
+        assert findings == []
+
+
+# ---------------------------------------------------------------- HS006
+
+
+CYCLE_SRC = """
+import threading
+
+
+class A:
+    def __init__(self):
+        self._la = threading.Lock()
+        self._lb = threading.Lock()
+
+    def ab(self):
+        with self._la:
+            with self._lb:
+                pass
+
+    def ba(self):
+        with self._lb:
+            with self._la:
+                pass
+"""
+
+DAG_SRC = """
+import threading
+
+
+class A:
+    def __init__(self):
+        self._la = threading.Lock()
+        self._lb = threading.Lock()
+
+    def ab(self):
+        with self._la:
+            with self._lb:
+                pass
+
+    def also_ab(self):
+        with self._la:
+            with self._lb:
+                pass
+"""
+
+
+class TestPotentialDeadlock:
+    def test_flags_lock_order_cycle(self, tmp_path):
+        findings = sync_lint(
+            tmp_path, CYCLE_SRC, ["HS006"], full_tree=True
+        )
+        assert [f.rule for f in findings] == ["HS006"]
+        assert "cycle" in findings[0].message
+        assert "fixture.A._la" in findings[0].message
+
+    def test_consistent_order_is_a_dag(self, tmp_path):
+        findings = sync_lint(tmp_path, DAG_SRC, ["HS006"], full_tree=True)
+        assert findings == []
+
+    def test_cycle_through_a_held_call_is_found(self, tmp_path):
+        # m1 holds la and calls m2 (which acquires lb); m3 holds lb and
+        # calls m4 (which acquires la): la->lb->la without any
+        # syntactically nested acquire
+        findings = sync_lint(
+            tmp_path,
+            """
+            import threading
+
+
+            class A:
+                def __init__(self):
+                    self._la = threading.Lock()
+                    self._lb = threading.Lock()
+
+                def m2(self):
+                    with self._lb:
+                        pass
+
+                def m1(self):
+                    with self._la:
+                        self.m2()
+
+                def m4(self):
+                    with self._la:
+                        pass
+
+                def m3(self):
+                    with self._lb:
+                        self.m4()
+            """,
+            ["HS006"],
+            full_tree=True,
+        )
+        assert [f.rule for f in findings] == ["HS006"]
+
+    def test_order_graph_export(self, tmp_path):
+        p = tmp_path / "graph_fixture.py"
+        p.write_text(textwrap.dedent(DAG_SRC))
+        graph = CONC.build_lock_order(REPO_ROOT, paths=[str(p)])
+        assert "graph_fixture.A._la" in graph["locks"]
+        assert any(
+            e["from"] == "graph_fixture.A._la"
+            and e["to"] == "graph_fixture.A._lb"
+            for e in graph["edges"]
+        )
+
+    def test_repo_lock_order_graph_is_a_dag(self):
+        # the property the runtime witness asserts against: the shipped
+        # tree's static lock-order graph must be cycle-free
+        rules = CONC.concurrency_rules(REPO_ROOT)
+        hs006 = [r for r in rules if r.id == "HS006"]
+        findings = CORE.run_lint(
+            REPO_ROOT, hs006, baseline=None, full_tree=True
+        )
+        assert findings == [], "\n".join(f.render() for f in findings)
+
+
+# -------------------------------------------------- annotation grammar
+
+
+class TestAnnotationGrammar:
+    def test_lock_annotation_names_the_lock(self, tmp_path):
+        p = tmp_path / "named.py"
+        p.write_text(
+            textwrap.dedent(
+                """
+                import threading
+
+                _GL = threading.Lock()  # graftsync: lock=custom.global_lock
+
+
+                class C:
+                    def __init__(self):
+                        self._l = threading.Lock()  # graftsync: lock=custom.inner
+
+                    def both(self):
+                        with _GL:
+                            with self._l:
+                                pass
+                """
+            )
+        )
+        graph = CONC.build_lock_order(REPO_ROOT, paths=[str(p)])
+        assert "custom.global_lock" in graph["locks"]
+        assert any(
+            e["from"] == "custom.global_lock" and e["to"] == "custom.inner"
+            for e in graph["edges"]
+        )
+
+    def test_maybe_wrap_name_arg_names_the_lock(self, tmp_path):
+        p = tmp_path / "wrapped.py"
+        p.write_text(
+            textwrap.dedent(
+                """
+                import threading
+
+                from hydragnn_tpu.utils import syncdebug
+
+
+                class C:
+                    def __init__(self):
+                        self._a = syncdebug.maybe_wrap(
+                            threading.Lock(), "wrapped.A"
+                        )
+                        self._b = syncdebug.maybe_wrap(
+                            threading.Lock(), "wrapped.B"
+                        )
+
+                    def nested(self):
+                        with self._a:
+                            with self._b:
+                                pass
+                """
+            )
+        )
+        graph = CONC.build_lock_order(REPO_ROOT, paths=[str(p)])
+        assert "wrapped.A" in graph["locks"]
+        assert any(
+            e["from"] == "wrapped.A" and e["to"] == "wrapped.B"
+            for e in graph["edges"]
+        )
+
+
+# ------------------------------------------------------- suppressions
+
+
+class TestSuppressions:
+    def test_same_line_suppression(self, tmp_path):
+        # the module-global finding anchors on the ``global`` statement
+        findings = sync_lint(
+            tmp_path,
+            """
+            _COUNT = 0
+
+
+            def bump():
+                global _COUNT  # graftsync: disable=HS001 -- test fixture
+                _COUNT += 1
+            """,
+            ["HS001"],
+        )
+        assert findings == []
+
+    def test_line_above_suppression(self, tmp_path):
+        findings = sync_lint(
+            tmp_path,
+            """
+            _COUNT = 0
+
+
+            def bump():
+                # graftsync: disable=HS001 -- test fixture
+                global _COUNT
+                _COUNT += 1
+            """,
+            ["HS001"],
+        )
+        assert findings == []
+
+    def test_wrong_rule_suppression_does_not_mask(self, tmp_path):
+        findings = sync_lint(
+            tmp_path,
+            """
+            _COUNT = 0
+
+
+            def bump():
+                global _COUNT  # graftsync: disable=HS003 -- wrong rule
+                _COUNT += 1
+            """,
+            ["HS001"],
+        )
+        assert [f.rule for f in findings] == ["HS001"]
+
+
+# ------------------------------------------------------------ baseline
+
+
+class TestBaseline:
+    SRC = (
+        "import threading\n\n_L = threading.Lock()\n\n\n"
+        "def f(work):\n    _L.acquire()\n    work()\n    _L.release()\n"
+    )
+
+    def test_round_trip_silences_grandfathered_findings(self, tmp_path):
+        fixture = tmp_path / "legacy.py"
+        fixture.write_text(self.SRC)
+
+        def rules():
+            return [
+                r for r in CONC.concurrency_rules(REPO_ROOT)
+                if r.id == "HS002"
+            ]
+
+        findings = CORE.run_lint(REPO_ROOT, rules(), paths=[str(fixture)])
+        assert len(findings) == 1
+
+        baseline = tmp_path / "baseline.json"
+        CORE.write_baseline(str(baseline), findings, tool="graftsync")
+        again = CORE.run_lint(
+            REPO_ROOT, rules(), paths=[str(fixture)],
+            baseline=str(baseline),
+        )
+        assert again == []
+
+    def test_fingerprint_survives_line_churn(self, tmp_path):
+        fixture = tmp_path / "churn.py"
+        fixture.write_text(self.SRC)
+
+        def rules():
+            return [
+                r for r in CONC.concurrency_rules(REPO_ROOT)
+                if r.id == "HS002"
+            ]
+
+        (f1,) = CORE.run_lint(REPO_ROOT, rules(), paths=[str(fixture)])
+        fixture.write_text("import os\n\n\n" + self.SRC)
+        (f2,) = CORE.run_lint(REPO_ROOT, rules(), paths=[str(fixture)])
+        assert f1.line != f2.line
+        assert f1.fingerprint() == f2.fingerprint()
+
+    def test_committed_baseline_is_empty(self):
+        with open(BASELINE) as f:
+            data = json.load(f)
+        assert data["findings"] == []
+        assert "graftsync" in data["comment"]
+
+
+# ----------------------------------------------------------------- CLI
+
+
+RULE_FIXTURES = {
+    "HS001": (
+        "import threading\n\n\nclass Box:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._items = []\n\n"
+        "    def add(self, x):\n"
+        "        self._items.append(x)\n"
+    ),
+    "HS002": TestBaseline.SRC,
+    "HS003": (
+        "import threading\nimport time\n\n_L = threading.Lock()\n\n\n"
+        "def f():\n    with _L:\n        time.sleep(0.1)\n"
+    ),
+    "HS004": (
+        "import threading\n\n\ndef work():\n    pass\n\n\n"
+        "def main():\n    t = threading.Thread(target=work)\n"
+        "    t.start()\n"
+    ),
+    "HS005": (
+        "import threading\n\n\ndef work():\n    pass\n\n\n"
+        "def main():\n"
+        "    threading.Thread(target=work, daemon=True).start()\n"
+    ),
+    "HS006": textwrap.dedent(CYCLE_SRC),
+}
+
+
+class TestCli:
+    @pytest.mark.parametrize("rule_id", sorted(RULE_FIXTURES))
+    def test_each_rule_individually_rejects_its_fixture(
+        self, tmp_path, rule_id
+    ):
+        # the ci.sh self-test contract: one injected violation per HS
+        # rule, each must fail the gate on its own
+        fixture = tmp_path / f"{rule_id.lower()}_fixture.py"
+        fixture.write_text(RULE_FIXTURES[rule_id])
+        rc = CLI.main(
+            [str(fixture), "--rule", rule_id, "--strict", "--no-baseline"]
+        )
+        assert rc == 1, f"{rule_id} did not reject its fixture"
+
+    def test_json_artifact(self, tmp_path):
+        fixture = tmp_path / "bad.py"
+        fixture.write_text(RULE_FIXTURES["HS002"])
+        out = tmp_path / "findings.json"
+        rc = CLI.main(
+            [str(fixture), "--rule", "HS002", "--strict", "--no-baseline",
+             "--json", str(out)]
+        )
+        assert rc == 1
+        payload = json.loads(out.read_text())
+        assert payload["count"] == 1
+        assert payload["findings"][0]["rule"] == "HS002"
+
+    def test_order_graph_export(self, tmp_path):
+        out = tmp_path / "graph.json"
+        rc = CLI.main(["--order-graph", str(out)])
+        assert rc == 0
+        graph = json.loads(out.read_text())
+        assert set(graph) == {"locks", "edges"}
+
+    def test_unknown_rule_is_usage_error(self):
+        assert CLI.main(["--rule", "HS999"]) == 2
+
+    def test_list_rules(self, capsys):
+        assert CLI.main(["--list-rules"]) == 0
+        listed = capsys.readouterr().out
+        for rid in ("HS001", "HS006"):
+            assert rid in listed
+
+
+# ----------------------------------------------------- runtime witness
+
+
+from hydragnn_tpu.utils import syncdebug  # noqa: E402
+
+
+@pytest.fixture
+def witness(monkeypatch):
+    """Enable the witness with a clean slate; static seeding is skipped
+    (it scans the whole tree) except where a test re-arms it."""
+    monkeypatch.setenv("HYDRAGNN_LOCK_DEBUG", "1")
+    monkeypatch.delenv("HYDRAGNN_INJECT_LOCK_ORDER", raising=False)
+    syncdebug.reset()
+    syncdebug._STATIC_SEEDED = True
+    yield syncdebug
+    syncdebug.reset()
+
+
+class TestRuntimeWitness:
+    def test_off_by_default_returns_the_raw_lock(self, monkeypatch):
+        monkeypatch.delenv("HYDRAGNN_LOCK_DEBUG", raising=False)
+        syncdebug.reset()
+        try:
+            lock = threading.Lock()
+            assert syncdebug.maybe_wrap(lock, "off.raw") is lock
+        finally:
+            syncdebug.reset()
+
+    def test_consistent_order_records_no_violation(self, witness):
+        a = witness.maybe_wrap(threading.Lock(), "w1.A")
+        b = witness.maybe_wrap(threading.Lock(), "w1.B")
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+        assert witness.violations() == []
+
+    def test_inversion_fires_once_per_edge(self, witness):
+        a = witness.maybe_wrap(threading.Lock(), "w2.A")
+        b = witness.maybe_wrap(threading.Lock(), "w2.B")
+        with a:
+            with b:
+                pass
+        for _ in range(2):  # the edge dedupes: one violation, not two
+            with b:
+                with a:
+                    pass
+        v = witness.violations()
+        assert len(v) == 1
+        assert v[0]["locks"] == ["w2.B", "w2.A"]  # [held, acquiring]
+        assert v[0]["conflict"] == "w2.A->w2.B"
+        assert v[0]["stacks"]  # every thread's stack is attached
+        assert v[0]["injected"] is False
+
+    def test_transitive_inversion_is_caught(self, witness):
+        a = witness.maybe_wrap(threading.Lock(), "w3.A")
+        b = witness.maybe_wrap(threading.Lock(), "w3.B")
+        c = witness.maybe_wrap(threading.Lock(), "w3.C")
+        with a:
+            with b:
+                pass
+        with b:
+            with c:
+                pass
+        with c:  # A->B->C is on record; C->A closes the cycle
+            with a:
+                pass
+        v = witness.violations()
+        assert len(v) == 1 and v[0]["locks"] == ["w3.C", "w3.A"]
+
+    def test_acquire_release_protocol_and_wait(self, witness):
+        cv = witness.maybe_wrap(threading.Condition(), "w4.cv")
+        other = witness.maybe_wrap(threading.Lock(), "w4.other")
+        assert cv.acquire()
+        cv.wait(timeout=0.01)  # releases + re-notes; must not corrupt
+        cv.release()
+        got = other.acquire(timeout=1)
+        assert got
+        other.release()
+        assert witness.violations() == []
+
+    def test_violation_lands_in_flight_record(self, witness, tmp_path):
+        from hydragnn_tpu.obs import flight as flight_mod
+
+        path = str(tmp_path / "flight.jsonl")
+        fr = flight_mod.FlightRecorder(path, enabled=True)
+        a = witness.maybe_wrap(threading.Lock(), "w5.A")
+        b = witness.maybe_wrap(threading.Lock(), "w5.B")
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+        fr.close()
+        events = [json.loads(l) for l in open(path)]
+        (ev,) = [e for e in events if e["kind"] == "lock_order"]
+        assert ev["locks"] == ["w5.B", "w5.A"]
+        assert ev["stacks"]
+        # the event validates against the flight schema
+        assert flight_mod.validate_flight_record(events) == []
+
+    def test_witness_survives_a_raising_flight_recorder(self, witness):
+        class Exploding:
+            def record(self, kind, **payload):
+                raise RuntimeError("flight write failed")
+
+        exploding = Exploding()
+        witness.register_flight(exploding)
+        a = witness.maybe_wrap(threading.Lock(), "w6.A")
+        b = witness.maybe_wrap(threading.Lock(), "w6.B")
+        with a:
+            with b:
+                pass
+        with b:
+            with a:  # must not raise despite the recorder exploding
+                pass
+        assert len(witness.violations()) == 1
+
+    def test_injection_is_one_shot(self, witness, monkeypatch):
+        monkeypatch.setenv("HYDRAGNN_INJECT_LOCK_ORDER", "w7.A,w7.B")
+        witness.maybe_wrap(threading.Lock(), "w7.A")
+        witness.maybe_wrap(threading.Lock(), "w7.B")
+        v = witness.violations()
+        assert len(v) == 1 and v[0]["injected"] is True
+        assert v[0]["locks"] == ["w7.B", "w7.A"]
+        # registering more locks does not re-fire the injection
+        witness.maybe_wrap(threading.Lock(), "w7.C")
+        assert len(witness.violations()) == 1
+
+    def test_static_seed_loads_the_graftsync_graph(self, monkeypatch):
+        monkeypatch.setenv("HYDRAGNN_LOCK_DEBUG", "1")
+        monkeypatch.delenv("HYDRAGNN_INJECT_LOCK_ORDER", raising=False)
+        syncdebug.reset()
+        try:
+            syncdebug.maybe_wrap(threading.Lock(), "seed.trigger")
+            static = CONC.build_lock_order(REPO_ROOT)
+            edges = {
+                (e["from"], e["to"]) for e in static["edges"]
+            }
+            with syncdebug._STATE_LOCK:
+                seen = set(syncdebug._SEEN_EDGES)
+            assert edges <= seen
+        finally:
+            syncdebug.reset()
+
+    def test_contradicting_a_static_edge_fires(self, monkeypatch):
+        monkeypatch.setenv("HYDRAGNN_LOCK_DEBUG", "1")
+        monkeypatch.delenv("HYDRAGNN_INJECT_LOCK_ORDER", raising=False)
+        syncdebug.reset()
+        try:
+            syncdebug.maybe_wrap(threading.Lock(), "seed.trigger2")
+            static = CONC.build_lock_order(REPO_ROOT)
+            if not static["edges"]:
+                pytest.skip("tree has no static lock-order edges")
+            edge = static["edges"][0]
+            a = syncdebug.maybe_wrap(threading.Lock(), edge["from"])
+            b = syncdebug.maybe_wrap(threading.Lock(), edge["to"])
+            with b:  # contradicts the STATIC order without any runtime
+                with a:  # observation of the forward direction
+                    pass
+            v = syncdebug.violations()
+            assert len(v) == 1
+            assert v[0]["locks"] == [edge["to"], edge["from"]]
+        finally:
+            syncdebug.reset()
+
+
+# -------------------------------------- concurrency-fix regressions
+
+
+class TestConcurrencyRegressions:
+    def test_cancel_pending_survives_reentrant_done_callback(self):
+        # resolving a future runs its done-callbacks synchronously; a
+        # callback that touches the queue used to deadlock on the
+        # non-reentrant Condition (futures were resolved under _cv)
+        from hydragnn_tpu.serve.batcher import MicroBatchQueue
+
+        q = MicroBatchQueue(
+            num_buckets=1, max_batch=8, max_delay_s=0.5, max_pending=16
+        )
+        depths = []
+        fut = q.put(0, "item")
+        fut.add_done_callback(lambda f: depths.append(q.depth()))
+
+        boom = RuntimeError("teardown")
+        result = {}
+
+        def cancel():
+            result["n"] = q.cancel_pending(boom)
+
+        t = threading.Thread(target=cancel, daemon=True)
+        t.start()
+        t.join(timeout=5)
+        assert not t.is_alive(), "cancel_pending deadlocked on re-entry"
+        assert result["n"] == 1
+        assert fut.exception() is boom
+        assert depths == [0]  # the callback really re-entered the queue
+
+    def test_flight_record_racing_close_mid_serialization(self, tmp_path):
+        # _jsonable calls payload.tolist() BEFORE taking the recorder
+        # lock; a tolist that closes the recorder used to leave record()
+        # writing to a closed file
+        from hydragnn_tpu.obs.flight import FlightRecorder
+
+        path = str(tmp_path / "f.jsonl")
+        fr = FlightRecorder(path, enabled=True)
+
+        class ClosesDuringSerialization:
+            def tolist(self):
+                fr.close()
+                return [1, 2]
+
+        fr.record("error", error="x", error_type="E",
+                  data=ClosesDuringSerialization())  # must not raise
+        for line in open(path):
+            json.loads(line)  # no partial line ever hit the file
+
+    def test_profile_capture_slot_stays_busy_through_stop(
+        self, tmp_path, monkeypatch
+    ):
+        # stop_trace blocks (device sync); the slot must read busy until
+        # it returns or a concurrent try_start would start a trace this
+        # teardown then kills
+        from hydragnn_tpu.utils import profile
+
+        entered = threading.Event()
+        release = threading.Event()
+        monkeypatch.setattr(
+            profile.jax.profiler, "start_trace", lambda prefix: None
+        )
+
+        def slow_stop():
+            entered.set()
+            assert release.wait(5)
+
+        monkeypatch.setattr(profile.jax.profiler, "stop_trace", slow_stop)
+
+        assert profile.try_start_capture(str(tmp_path / "p1"))
+        assert profile.capture_active()
+        t = threading.Thread(target=profile.stop_capture, daemon=True)
+        t.start()
+        assert entered.wait(5)
+        assert profile.capture_active()  # "stopping" still occupies it
+        assert not profile.try_start_capture(str(tmp_path / "p2"))
+        release.set()
+        t.join(timeout=5)
+        assert not t.is_alive()
+        assert not profile.capture_active()
+        # the slot is reusable after a full stop
+        assert profile.try_start_capture(str(tmp_path / "p3"))
+        profile.stop_capture()
+        assert not profile.capture_active()
+
+    def test_registry_rank_resolves_outside_the_lock(self, monkeypatch):
+        # jax.process_index can block on backend init for seconds; the
+        # probe acquires the registry lock from inside it — held-lock
+        # resolution would deadlock (caught by the timeout)
+        import hydragnn_tpu.obs.registry as obs_registry
+
+        reg = obs_registry.MetricsRegistry()
+
+        class _FakeJax:
+            @staticmethod
+            def process_index():
+                got = reg._lock.acquire(timeout=2)
+                assert got, "rank resolved while holding the registry lock"
+                reg._lock.release()
+                return 7
+
+        import sys as _sys
+
+        monkeypatch.setitem(_sys.modules, "jax", _FakeJax())
+        assert reg.rank == 7
+        assert reg.rank == 7  # cached; the fake is not re-entered
+
+    def test_trace_to_dict_snapshots_spans(self):
+        from hydragnn_tpu.obs.trace import RequestTrace
+
+        tr = RequestTrace("deadbeefdeadbeef", seq=1, attrs={"k": "v"})
+        tr.mark("route")
+        d = tr.to_dict()
+        assert d["spans"] is not tr.spans
+        assert d["attrs"] is not tr.attrs
+        before = len(d["spans"])
+        tr.mark("late")  # a late mark must not mutate the export
+        assert len(d["spans"]) == before
+
+    def test_compile_monitor_registers_one_dispatcher(self, monkeypatch):
+        import sys as _sys
+
+        import hydragnn_tpu.obs.compile_monitor as cmon
+
+        registrations = []
+
+        class _FakeMonitoring:
+            @staticmethod
+            def register_event_duration_secs_listener(fn):
+                registrations.append(fn)
+
+        # `import jax.monitoring as mon` binds via getattr(jax, ...)
+        # when jax is already loaded, so patch both lookup paths
+        import jax as _jax
+
+        fake = _FakeMonitoring()
+        monkeypatch.setitem(_sys.modules, "jax.monitoring", fake)
+        monkeypatch.setattr(_jax, "monitoring", fake, raising=False)
+        monkeypatch.setattr(cmon, "_dispatcher_registered", False)
+
+        barrier = threading.Barrier(4)
+        monitors = [cmon.CompileMonitor() for _ in range(4)]
+
+        def start(m):
+            barrier.wait(timeout=5)
+            m.start()
+
+        threads = [
+            threading.Thread(target=start, args=(m,), daemon=True)
+            for m in monitors
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=5)
+        assert len(registrations) == 1, (
+            "concurrent starts double-registered the dispatcher: every "
+            "compile would be counted twice forever"
+        )
+        for m in monitors:
+            assert m.available
+            m.stop()
+
+    def test_diststore_close_is_lock_disciplined(self):
+        # close() drains the connection map under the lock and closes
+        # the sockets outside it — a concurrent fetch either keeps its
+        # conn (and gets ConnectionError) or re-caches a fresh one
+        import socket as socket_mod
+
+        from hydragnn_tpu.data.diststore import DistSampleStore
+
+        store = DistSampleStore.__new__(DistSampleStore)
+        store._lock = threading.Lock()
+        s1, s2 = socket_mod.socketpair()
+        store._conns = {1: s1}
+        store._server = None
+        store.close()
+        assert store._conns == {}
+        assert s1.fileno() == -1  # really closed
+        s2.close()
+
+
+# ------------------------------------------------------------ meta-test
+
+
+class TestShippedTree:
+    def test_tree_is_graftsync_clean_with_committed_baseline(self):
+        findings = CORE.run_lint(
+            REPO_ROOT,
+            CONC.concurrency_rules(REPO_ROOT),
+            baseline=BASELINE,
+            full_tree=True,
+        )
+        assert findings == [], "\n" + "\n".join(
+            f.render() for f in findings
+        )

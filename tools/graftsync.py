@@ -1,0 +1,205 @@
+#!/usr/bin/env python3
+"""graftsync — run the repo's thread-safety/lock-discipline analyzer
+(docs/LINT.md, HS rules).
+
+Usage:
+    python tools/graftsync.py                       # full tree, all rules
+    python tools/graftsync.py --changed             # fast pre-commit loop
+    python tools/graftsync.py --rule HS003 --strict hydragnn_tpu/serve
+    python tools/graftsync.py --order-graph -       # static lock-order graph
+    python tools/graftsync.py --list-rules
+
+Exit codes: 0 clean, 1 findings, 2 usage/internal error.
+
+Like tools/graftlint.py, the lint package is loaded standalone
+(importlib, not ``import hydragnn_tpu``): the package root pulls in
+jax-adjacent subpackages, and the analyzer must run in milliseconds on
+any container with a bare CPython.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_lint_pkg():
+    """Load ``hydragnn_tpu.lint`` as a standalone package named
+    ``_graftsync`` so relative imports inside it resolve without ever
+    executing ``hydragnn_tpu/__init__.py``."""
+    pkg_dir = os.path.join(REPO_ROOT, "hydragnn_tpu", "lint")
+    spec = importlib.util.spec_from_file_location(
+        "_graftsync",
+        os.path.join(pkg_dir, "__init__.py"),
+        submodule_search_locations=[pkg_dir],
+    )
+    pkg = importlib.util.module_from_spec(spec)
+    sys.modules["_graftsync"] = pkg
+    spec.loader.exec_module(pkg)
+    core = importlib.import_module("_graftsync.core")
+    concurrency = importlib.import_module("_graftsync.concurrency")
+    return core, concurrency
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="graftsync", description=__doc__.splitlines()[0]
+    )
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        help="files/directories to analyze (default: the whole tree)",
+    )
+    parser.add_argument(
+        "--rule",
+        action="append",
+        default=None,
+        metavar="HSNNN",
+        help="run only this rule id (repeatable)",
+    )
+    parser.add_argument(
+        "--strict",
+        action="store_true",
+        help="exit nonzero on any finding regardless of severity",
+    )
+    parser.add_argument(
+        "--json",
+        metavar="PATH",
+        default=None,
+        help="also write findings as JSON ('-' for stdout)",
+    )
+    parser.add_argument(
+        "--baseline",
+        metavar="PATH",
+        default=os.path.join("tools", "graftsync_baseline.json"),
+        help="baseline file of grandfathered findings "
+        "(default: tools/graftsync_baseline.json)",
+    )
+    parser.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="ignore the baseline file",
+    )
+    parser.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="write current findings to the baseline file and exit 0",
+    )
+    parser.add_argument(
+        "--changed",
+        action="store_true",
+        help="analyze only files git reports as changed vs HEAD",
+    )
+    parser.add_argument(
+        "--order-graph",
+        metavar="PATH",
+        default=None,
+        help="dump the static lock-order graph as JSON ('-' for stdout) "
+        "and exit (the runtime witness asserts against this graph)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalog"
+    )
+    args = parser.parse_args(argv)
+
+    core, concurrency = _load_lint_pkg()
+    all_rules = concurrency.concurrency_rules(REPO_ROOT)
+
+    if args.list_rules:
+        for rule in all_rules:
+            print(f"{rule.id}  {rule.name:40s} [{rule.severity}] "
+                  f"{rule.description}")
+        return 0
+
+    if args.order_graph is not None:
+        graph = concurrency.build_lock_order(REPO_ROOT, args.paths or None)
+        payload = json.dumps(graph, indent=2)
+        if args.order_graph == "-":
+            print(payload)
+        else:
+            with open(args.order_graph, "w") as f:
+                f.write(payload + "\n")
+            print(
+                f"graftsync: wrote lock-order graph "
+                f"({len(graph['locks'])} locks, {len(graph['edges'])} "
+                f"edges) to {args.order_graph}"
+            )
+        return 0
+
+    rules = all_rules
+    if args.rule:
+        wanted = {r.upper() for r in args.rule}
+        rules = [r for r in all_rules if r.id in wanted]
+        unknown = wanted - {r.id for r in all_rules}
+        if unknown:
+            print(f"graftsync: unknown rule id(s): {sorted(unknown)}",
+                  file=sys.stderr)
+            return 2
+
+    paths = args.paths or None
+    if args.changed:
+        paths = core.changed_paths(REPO_ROOT)
+        if not paths:
+            print("graftsync: no changed python files")
+            return 0
+
+    baseline = None if (args.no_baseline or args.write_baseline) else (
+        args.baseline
+        if os.path.isabs(args.baseline)
+        else os.path.join(REPO_ROOT, args.baseline)
+    )
+    # full_tree=True even for path-restricted scans: HS006's cycle
+    # detection is an aggregate that must run on whatever was scanned
+    findings = core.run_lint(
+        REPO_ROOT, rules, paths=paths, baseline=baseline, full_tree=True
+    )
+
+    if args.write_baseline:
+        out = (
+            args.baseline
+            if os.path.isabs(args.baseline)
+            else os.path.join(REPO_ROOT, args.baseline)
+        )
+        core.write_baseline(out, findings, tool="graftsync")
+        print(f"graftsync: wrote {len(findings)} finding(s) to {out}")
+        return 0
+
+    for f in findings:
+        print(f.render())
+    _emit_json(args.json, findings)
+    errors = [f for f in findings if f.severity == "error"]
+    if (args.strict and findings) or errors:
+        print(
+            f"graftsync: {len(findings)} finding(s) "
+            f"({len(errors)} error(s))"
+        )
+        return 1
+    if findings:
+        print(f"graftsync: {len(findings)} warning(s) (non-strict: ok)")
+    else:
+        print("graftsync: clean")
+    return 0
+
+
+def _emit_json(dest, findings) -> None:
+    if not dest:
+        return
+    payload = json.dumps(
+        {"version": 1, "count": len(findings),
+         "findings": [f.to_json() for f in findings]},
+        indent=2,
+    )
+    if dest == "-":
+        print(payload)
+    else:
+        with open(dest, "w") as f:
+            f.write(payload + "\n")
+
+
+if __name__ == "__main__":
+    sys.exit(main())
