@@ -41,6 +41,18 @@ the quarantine/restart/reload counts match the injection plan in both
 the metrics and the flight record, and post-recovery traffic paid 0
 new compile misses. The headline value is the worst not-ready gap
 (recovery time); exit 1 on any violated invariant.
+
+Fleet mode (``python bench_serve.py --fleet``, or SERVE_FLEET=1): the
+fleet chaos acceptance run (docs/FLEET.md). Measures sustained QPS at
+fixed p99 through an N=2 replica fleet (vs an N=1 baseline — the
+scale-out efficiency headline), then runs the three fleet chaos
+scenarios against live traffic: replica-kill mid-traffic (controller
+reaps + replaces, router death-retry absorbs in-flights), scale-up
+under sustained queue breach (trigger verdict spawns a replica), and a
+fleet-wide rolling reload. Every scenario asserts p99 under
+FLEET_SLO_P99_MS and zero lost futures; every post-first replica must
+warm-start from the shared exec cache with 0 AOT compiles. Writes the
+committed, schema-validated BENCH_FLEET.json.
 """
 
 from __future__ import annotations
@@ -480,8 +492,304 @@ def chaos() -> None:
         raise SystemExit(1)
 
 
+def fleet_chaos() -> None:
+    """Fleet acceptance run (``--fleet``, docs/FLEET.md): sustained QPS
+    at fixed p99 through an N>=2 replica fleet on one host, then the
+    three fleet chaos scenarios against live traffic — replica-kill
+    mid-traffic (controller restores capacity), scale-up-under-load
+    (trigger verdict spawns a replica), and a fleet-wide rolling reload
+    — each asserting p99 under the SLO throughout and ZERO lost
+    futures (result or typed error; the router's death-retry absorbs
+    the kill). Every post-first replica must warm-start from the shared
+    exec cache with 0 AOT compiles; scale-out efficiency (QPS at N=2 vs
+    N=1) lands in the committed, schema-validated BENCH_FLEET.json.
+    Per-replica SLO trigger rules stay armed, so any breach
+    auto-captures an incident bundle (counted in the record)."""
+    from bench import init_device_with_flight, open_bench_flight
+
+    metric = "fleet_sustained_qps"
+    flight = open_bench_flight("BENCH_FLEET_FLIGHT.jsonl")
+    device, init_retries = init_device_with_flight(metric, flight)
+
+    import tempfile
+
+    import numpy as np
+
+    from hydragnn_tpu.fleet import ControllerConfig, Fleet, FleetController
+    from hydragnn_tpu.flagship import build_flagship
+    from hydragnn_tpu.serve import ModelRegistry, ServeConfig
+
+    n_requests = int(os.environ.get("SERVE_REQUESTS", 96))
+    n_threads = int(os.environ.get("SERVE_THREADS", 4))
+    max_batch = int(os.environ.get("SERVE_MAX_BATCH", 8))
+    n_samples = int(os.environ.get("SERVE_SAMPLES", 64))
+    hidden = int(os.environ.get("SERVE_HIDDEN", 16))
+    layers = int(os.environ.get("SERVE_LAYERS", 2))
+    slo_p99_ms = float(os.environ.get("FLEET_SLO_P99_MS", 3000.0))
+    out_path = os.environ.get("FLEET_BENCH_OUT", "BENCH_FLEET.json")
+
+    cache_dir = os.environ.get("SERVE_EXEC_CACHE") or tempfile.mkdtemp(
+        prefix="fleet_exec_cache_"
+    )
+    incident_dir = tempfile.mkdtemp(prefix="fleet_incidents_")
+
+    _, model, variables, loader = build_flagship(
+        n_samples=n_samples,
+        hidden_dim=hidden,
+        num_conv_layers=layers,
+        batch_size=max(max_batch, 2),
+        unit_cells=(2, 4),
+    )
+    registry = ModelRegistry()
+    requests = list(loader.all_samples)
+    serve_cfg = ServeConfig(
+        max_batch=max_batch,
+        max_delay_ms=3.0,
+        max_pending=max(8 * n_requests, 256),
+        dispatch_backoff_base_s=0.2,
+        slo_p99_ms=slo_p99_ms,
+        incident_dir=incident_dir,
+    )
+    rng = np.random.default_rng(0)
+    failures: list = []
+    lost_total = 0
+
+    def run_traffic(fleet, n: int, tag: str) -> dict:
+        """Closed-loop clients through the ROUTER; returns QPS + p99 +
+        the resolve ledger (every submitted future accounted for)."""
+        nonlocal lost_total
+        order = rng.integers(0, len(requests), size=n)
+        per_thread = np.array_split(order, n_threads)
+        latencies: list = []
+        ledger = {"results": 0, "typed": 0, "lost": 0}
+        ledger_lock = threading.Lock()
+
+        # graftsync: thread-root
+        def client(idx_list) -> None:
+            from hydragnn_tpu.serve import Overloaded, RequestFailed
+            from hydragnn_tpu.serve.batcher import ServerClosed
+
+            for i in idx_list:
+                t0 = time.perf_counter()
+                try:
+                    fleet.predict(requests[int(i)], timeout=120)
+                    with ledger_lock:
+                        latencies.append(time.perf_counter() - t0)
+                        ledger["results"] += 1
+                except (RequestFailed, Overloaded, ServerClosed):
+                    with ledger_lock:
+                        ledger["typed"] += 1
+                except BaseException:
+                    with ledger_lock:
+                        ledger["lost"] += 1
+
+        # graftsync: disable=HS004 -- every element is joined in the loop below
+        threads = [threading.Thread(target=client, args=(ix,)) for ix in per_thread]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        lat_sorted = sorted(latencies)
+        p99 = (
+            lat_sorted[min(len(lat_sorted) - 1, int(round(0.99 * (len(lat_sorted) - 1))))]
+            * 1e3
+            if lat_sorted
+            else 0.0
+        )
+        lost_total += ledger["lost"]
+        if ledger["lost"]:
+            failures.append(f"{tag}: {ledger['lost']} futures failed UNtyped")
+        if p99 > slo_p99_ms:
+            failures.append(f"{tag}: p99 {p99:.0f}ms over SLO {slo_p99_ms:g}ms")
+        return {
+            "qps": round(n / wall, 2),
+            "p99_ms": round(p99, 1),
+            "wall_s": round(wall, 2),
+            **ledger,
+        }
+
+    scenarios = {}
+
+    # -- phase A: N=1 baseline QPS (pays the one-time AOT compiles) --------
+    fleet1 = Fleet(exec_cache_dir=cache_dir, flight=flight)
+    fleet1.add_model("flagship", registry.register("fleet_n1", model, variables),
+                     requests, serve_cfg, replicas=1)
+    scenarios["baseline_n1"] = run_traffic(fleet1, n_requests, "baseline_n1")
+    fleet1.stop()
+    qps_n1 = scenarios["baseline_n1"]["qps"]
+
+    # -- phase B: N=2 fleet from the same cache (both replicas warm) -------
+    fleet = Fleet(exec_cache_dir=cache_dir, flight=flight)
+    reps = fleet.add_model(
+        "flagship", registry.register("fleet_n2", model, variables),
+        requests, serve_cfg, replicas=2,
+    )
+    warm_aot = sum(r.server.metrics_snapshot()["compile_warmup"] for r in reps)
+    if warm_aot:
+        failures.append(
+            f"{warm_aot} AOT compiles in the N=2 fleet — the shared exec "
+            "cache did not cover the ladder"
+        )
+    ctl = FleetController(
+        fleet,
+        registry=fleet.registry,
+        config=ControllerConfig(
+            min_replicas=1, max_replicas=3, cooldown_s=0.0, quiet_for_s=3600.0,
+            slo_queue_depth=4.0, breach_evals=2,
+        ),
+        flight=flight,
+    )
+
+    scenarios["sustained_n2"] = run_traffic(fleet, n_requests, "sustained_n2")
+    qps_n2 = scenarios["sustained_n2"]["qps"]
+
+    # -- scenario: replica-kill mid-traffic --------------------------------
+    victim = fleet.replicas()[0]
+    killer = threading.Timer(0.05, victim.kill)
+    killer.start()
+    kill_stats = run_traffic(fleet, n_requests, "replica_kill")
+    killer.join()
+    ctl.step()  # reap + replace, outside any cooldown
+    replacement = [
+        r for r in fleet.replicas() if r.name not in (victim.name,)
+    ]
+    kill_stats["replaced"] = fleet.replica_count() == 2
+    kill_stats["replacement_aot_compiles"] = sum(
+        r.server.metrics_snapshot()["compile_warmup"]
+        for r in replacement
+    )
+    if not kill_stats["replaced"]:
+        failures.append("replica_kill: controller did not restore capacity")
+    if kill_stats["replacement_aot_compiles"]:
+        failures.append("replica_kill: replacement replica paid AOT compiles")
+    if not all(r.ready for r in fleet.replicas()):
+        failures.append("replica_kill: fleet not READY after replacement")
+    scenarios["replica_kill"] = kill_stats
+
+    # -- scenario: scale-up under load -------------------------------------
+    burst = [fleet.submit(requests[int(i)]) for i in
+             rng.integers(0, len(requests), size=6 * max_batch)]
+    decisions = []
+    deadline = time.perf_counter() + 30.0
+    while time.perf_counter() < deadline:
+        if fleet.total_load() <= 4:
+            # keep the queue over the trigger threshold until the
+            # controller has seen a SUSTAINED breach (breach_evals=2)
+            burst += [
+                fleet.submit(requests[int(i)])
+                for i in rng.integers(0, len(requests), size=2 * max_batch)
+            ]
+        decisions += ctl.step()
+        if any(d["action"] == "up" for d in decisions):
+            break
+    burst_lost = 0
+    for f in burst:
+        try:
+            f.result(timeout=120)
+        except BaseException as exc:
+            from hydragnn_tpu.serve import Overloaded, RequestFailed
+
+            if not isinstance(exc, (RequestFailed, Overloaded)):
+                burst_lost += 1
+    lost_total += burst_lost
+    scaled = any(d["action"] == "up" for d in decisions)
+    new_replicas = [r for r in fleet.replicas()]
+    scenarios["scale_up_under_load"] = {
+        "scaled": scaled,
+        "replicas_after": fleet.replica_count(),
+        "burst": len(burst),
+        "lost": burst_lost,
+        "new_replica_aot_compiles": sum(
+            r.server.metrics_snapshot()["compile_warmup"] for r in new_replicas
+        ),
+        "decisions": [d["action"] for d in decisions],
+    }
+    if not scaled:
+        failures.append("scale_up: no up decision under sustained queue breach")
+    if burst_lost:
+        failures.append(f"scale_up: {burst_lost} burst futures failed UNtyped")
+    if scenarios["scale_up_under_load"]["new_replica_aot_compiles"]:
+        failures.append("scale_up: scaled-up replica paid AOT compiles")
+    if not all(r.ready for r in fleet.replicas()):
+        failures.append("scale_up: fleet not READY after scale-up")
+
+    # -- scenario: fleet-wide rolling reload mid-traffic -------------------
+    roller_result: list = []
+
+    # graftsync: thread-root
+    def roller() -> None:
+        try:
+            roller_result.append(
+                fleet.rolling_reload("flagship", variables=dict(variables))
+            )
+        except BaseException as exc:  # pragma: no cover - surfaced below
+            roller_result.append(exc)
+
+    roll_t = threading.Thread(target=roller)
+    roll_t.start()
+    reload_stats = run_traffic(fleet, n_requests, "rolling_reload")
+    roll_t.join(timeout=120)
+    ok = (
+        roller_result
+        and isinstance(roller_result[0], list)
+        and all(o["ok"] for o in roller_result[0])
+        and len(roller_result[0]) == fleet.replica_count()
+    )
+    reload_stats["reloaded_replicas"] = (
+        len(roller_result[0]) if ok else 0
+    )
+    if not ok:
+        failures.append(f"rolling_reload failed: {roller_result[:1]!r}")
+    if not all(r.ready for r in fleet.replicas()):
+        failures.append("rolling_reload: fleet not READY at end")
+    scenarios["rolling_reload"] = reload_stats
+
+    health = fleet.health()
+    fleet.stop()
+
+    incidents = sum(
+        1 for root, dirs, files in os.walk(incident_dir)
+        if "trigger.json" in files
+    )
+    record = {
+        "metric": metric,
+        "value": qps_n2,
+        "unit": "graphs/sec",
+        "init_retries": init_retries,
+        "replicas": 2,
+        "requests_per_phase": n_requests,
+        "threads": n_threads,
+        "slo_p99_ms": slo_p99_ms,
+        "qps_n1": qps_n1,
+        "qps_n2": qps_n2,
+        "scaleout_efficiency": round(qps_n2 / max(2 * qps_n1, 1e-9), 3),
+        "warm_replica_aot_compiles": warm_aot,
+        "lost_futures": lost_total,
+        "incidents_captured": incidents,
+        "final_health": {
+            k: health[k] for k in ("replica_count", "ready_count", "live_count")
+        },
+        "scenarios": scenarios,
+        "failures": failures,
+    }
+    flight.record("bench_result", record=record, passed=not failures)
+    flight.close()
+    with open(out_path, "w") as f:
+        json.dump(record, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(json.dumps(record))
+    if failures:
+        for msg in failures:
+            print(f"FAIL: {msg}", file=sys.stderr)
+        raise SystemExit(1)
+
+
 if __name__ == "__main__":
-    if "--chaos" in sys.argv or os.environ.get("SERVE_CHAOS") == "1":
+    if "--fleet" in sys.argv or os.environ.get("SERVE_FLEET") == "1":
+        fleet_chaos()
+    elif "--chaos" in sys.argv or os.environ.get("SERVE_CHAOS") == "1":
         chaos()
     elif "--cold-warm" in sys.argv or os.environ.get("SERVE_COLD_WARM") == "1":
         cold_warm()

@@ -717,6 +717,141 @@ EOF
 python tools/serve_probe.py --prom "$SERVE_DIR/witness.prom" --verbose
 rm -rf "$SERVE_DIR"
 
+echo "== fleet smoke (2-replica fleet from one checkpoint; replica-kill under traffic -> capacity restored warm; rolling reload bit-identical; merged flight validates) =="
+FLEET_DIR="$(mktemp -d)"
+JAX_PLATFORMS=cpu python - "$FLEET_DIR" <<'EOF'
+import glob
+import os
+import sys
+import threading
+
+import numpy as np
+
+out = sys.argv[1]
+
+from hydragnn_tpu.api import prepare_loaders_and_config, run_training
+from hydragnn_tpu.data.synthetic import deterministic_graph_data
+from hydragnn_tpu.flagship import flagship_config
+from hydragnn_tpu.fleet import ControllerConfig, Fleet, FleetController
+from hydragnn_tpu.obs import FlightRecorder
+from hydragnn_tpu.obs.flight import read_flight_record, validate_flight_record
+from hydragnn_tpu.serve import ModelRegistry, Overloaded, ServeConfig, ServerClosed
+from hydragnn_tpu.serve.server import RequestFailed
+
+
+def cfg():
+    return flagship_config(hidden_dim=8, num_conv_layers=2, batch_size=5, num_epoch=1)
+
+
+def data():
+    return deterministic_graph_data(
+        number_configurations=20,
+        unit_cell_x_range=(2, 3),
+        unit_cell_y_range=(2, 3),
+        unit_cell_z_range=(2, 3),
+        seed=0,
+    )
+
+
+# ONE trained checkpoint feeds every replica in the fleet
+run_training(cfg(), samples=data(), log_dir=out + "/logs/")
+log_name = os.path.basename(os.path.dirname(glob.glob(out + "/logs/*/flight.jsonl")[0]))
+
+train_loader, val_loader, test_loader, config = prepare_loaders_and_config(cfg(), data())
+reference = (
+    list(train_loader.all_samples)
+    + list(val_loader.all_samples)
+    + list(test_loader.all_samples)
+)
+served = ModelRegistry(out + "/logs/").load(
+    log_name, config["NeuralNetwork"], example_graph=reference[0]
+)
+
+flight = FlightRecorder(out + "/fleet_flight.jsonl")
+fleet = Fleet(exec_cache_dir=out + "/exec_cache", flight=flight)
+reps = fleet.add_model(
+    "flagship", served, reference,
+    ServeConfig(max_batch=4, num_buckets=2, max_delay_ms=5.0), replicas=2,
+)
+# the second replica must warm-start ENTIRELY from the first's exec cache
+snap = reps[1].server.metrics_snapshot()
+assert snap["compile_warmup"] == 0, snap
+assert snap["exec_cache_hits"] > 0, snap
+
+# kill one replica while traffic flows through the router: the death
+# retry absorbs in-flights — zero futures may fail untyped
+test = (list(test_loader.all_samples) * 8)[:16]
+victim = fleet.replicas()[0]
+killer = threading.Timer(0.02, victim.kill)
+killer.start()
+futs = [fleet.submit(s) for s in test]
+lost = 0
+for f in futs:
+    try:
+        f.result(timeout=120)
+    except (RequestFailed, Overloaded, ServerClosed):
+        pass  # typed rejection is an answer; silence is the failure
+    except BaseException:
+        lost += 1
+killer.join()
+assert lost == 0, f"{lost} futures failed UNtyped after the replica kill"
+
+# the controller reaps the dead replica and restores capacity; the
+# replacement warm-starts from the shared cache with 0 compile misses
+ctl = FleetController(
+    fleet, registry=fleet.registry,
+    config=ControllerConfig(min_replicas=1, max_replicas=3),
+    flight=flight,
+)
+decisions = ctl.step()
+assert [d["action"] for d in decisions] == ["replace"], decisions
+assert fleet.replica_count() == 2 and not fleet.dead_replicas()
+replacement = [r for r in fleet.replicas() if r.name not in {x.name for x in reps}]
+assert len(replacement) == 1 and replacement[0].ready
+assert replacement[0].server.metrics_snapshot()["compile_warmup"] == 0
+for s in test[:4]:
+    fleet.predict(s, timeout=120)
+for r in fleet.replicas():
+    m = r.server.metrics_snapshot()
+    assert m["compile_misses"] == 0, (r.name, m)
+
+# fleet-wide rolling reload from the SAME saved checkpoint: one replica
+# at a time, and the answers must be bit-identical afterwards
+before = fleet.predict(test[0], timeout=120)
+outcomes = fleet.rolling_reload("flagship", log_name, log_dir=out + "/logs/")
+assert len(outcomes) == 2 and all(o["ok"] for o in outcomes), outcomes
+after = fleet.predict(test[0], timeout=120)
+for k in before:
+    np.testing.assert_allclose(after[k], before[k], rtol=0, atol=0)
+health = fleet.health()
+assert health["ready_count"] == 2 and health["live_count"] == 2, health
+
+fleet.export_probes(out + "/probes")
+fleet.stop()
+flight.close()
+
+# the MERGED flight (every replica's run_start, the scale decision, the
+# reload outcomes) must be schema-valid as one timeline
+ev = read_flight_record(out + "/fleet_flight.jsonl")
+assert sum(1 for e in ev if e.get("kind") == "run_start") >= 3, "3 replica manifests"
+scale = [e for e in ev if e.get("kind") == "fleet_scale"]
+assert [e["action"] for e in scale] == ["replace"], scale
+reloads = [e for e in ev if e.get("kind") == "fleet_reload"]
+assert len(reloads) == 2 and all(e["ok"] for e in reloads), reloads
+problems = validate_flight_record(ev)
+assert not problems, problems
+print(
+    "fleet smoke: OK (replica-kill absorbed with 0 lost futures, replacement "
+    "warm with 0 compile misses, rolling reload bit-identical, merged flight valid)"
+)
+EOF
+python tools/obs_report.py --validate "$FLEET_DIR/fleet_flight.jsonl" | tee "$FLEET_DIR/validate.out"
+if grep -q "WARNING" "$FLEET_DIR/validate.out"; then
+    echo "FAIL: fleet flight kinds not schema-known"; exit 1
+fi
+python tools/serve_probe.py --fleet "$FLEET_DIR/probes" --verbose
+rm -rf "$FLEET_DIR"
+
 echo "== incident smoke (SLO triggers: clean control -> zero incidents; injected NaN train + wedged serve -> one validated bundle each) =="
 INC_DIR="$(mktemp -d)"
 # --- clean control: triggers armed + tracing on, nothing injected ->

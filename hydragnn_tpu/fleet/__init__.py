@@ -1,0 +1,32 @@
+"""Autoscaling multi-tenant serving fleet (docs/FLEET.md).
+
+N supervised :class:`~hydragnn_tpu.serve.server.ModelServer` replicas
+behind one admission router with per-tenant quotas and priority
+classes, scaled by a trigger-driven controller and reloaded fleet-wide
+one replica at a time. Composition layer only: batching, buckets,
+canary reloads, SLO triggers, and tracing all come from ``serve/`` and
+``obs/`` unchanged.
+"""
+
+from hydragnn_tpu.fleet.controller import ControllerConfig, FleetController
+from hydragnn_tpu.fleet.fleet import Fleet
+from hydragnn_tpu.fleet.replica import FleetReplica, ReplicaFailed, write_probe_textfile
+from hydragnn_tpu.fleet.router import (
+    FleetRouter,
+    RouterConfig,
+    TenantOverloaded,
+    TenantQuota,
+)
+
+__all__ = [
+    "ControllerConfig",
+    "Fleet",
+    "FleetController",
+    "FleetReplica",
+    "FleetRouter",
+    "ReplicaFailed",
+    "RouterConfig",
+    "TenantOverloaded",
+    "TenantQuota",
+    "write_probe_textfile",
+]

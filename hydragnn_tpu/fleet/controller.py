@@ -1,0 +1,290 @@
+"""Trigger-driven fleet autoscaler: breach -> replica, quiet -> retire.
+
+The controller owns NO load math of its own — the breach signal is the
+r12 :class:`~hydragnn_tpu.obs.triggers.TriggerEngine` evaluating the
+fleet registry's aggregates (``fleet.queue_depth`` gauge,
+``fleet.latency_s`` histogram p99), the same rule kinds, the same
+injectable clock, the same evidence discipline. What the controller
+adds is the decision policy around the verdicts:
+
+  - **sustained breach** -> scale up: a verdict must repeat for
+    ``breach_evals`` consecutive evaluation steps before a replica is
+    spawned (one latency blip is not a capacity problem);
+  - **cooldown**: at most one scale decision per ``cooldown_s`` — the
+    fleet must see the effect of the last decision before making
+    another (a fresh replica needs a moment to absorb queue);
+  - **bounds**: never below ``min_replicas`` (scale-down) or above
+    ``max_replicas`` (a breach at the cap records a ``hold`` —
+    suppressed-and-counted, never silent);
+  - **quiet scale-down**: fleet load continuously at/below
+    ``quiet_load`` for ``quiet_for_s`` retires the least-loaded
+    replica (drain-then-stop — zero dropped requests);
+  - **reap**: a replica whose server is no longer live (dispatch
+    restart budget exhausted, killed) is detached and replaced
+    immediately, outside the cooldown — restoring capacity is never
+    rate-limited.
+
+Every decision — up, down, replace, hold, up_failed — is one
+``fleet_scale`` flight event with the action, the reason (trigger rule
+name, ``quiet``, ``dead_replica``...), and the resulting replica
+count. Tests drive :meth:`FleetController.step` directly under a fake
+clock; production runs the same step from the background loop thread.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from hydragnn_tpu.obs.triggers import TriggerEngine, TriggerRule
+from hydragnn_tpu.utils import knobs, syncdebug
+
+
+@dataclasses.dataclass(frozen=True)
+class ControllerConfig:
+    """Scaling policy. ``None`` fields resolve from the
+    ``HYDRAGNN_FLEET_*`` knobs at controller construction, so an
+    explicit argument always wins over the environment.
+
+    ``slo_queue_depth``/``slo_p99_ms`` parameterize the trigger rules
+    the controller builds when no engine is injected; ``quiet_load`` is
+    the fleet in-flight count at/below which the fleet counts as quiet.
+    """
+
+    min_replicas: Optional[int] = None
+    max_replicas: Optional[int] = None
+    cooldown_s: Optional[float] = None
+    quiet_for_s: Optional[float] = None
+    eval_every_s: Optional[float] = None
+    quiet_load: int = 0
+    breach_evals: int = 2
+    slo_queue_depth: Optional[float] = None
+    slo_p99_ms: Optional[float] = None
+    drain_timeout_s: float = 30.0
+
+
+class FleetController:
+    """Autoscaler over a fleet.
+
+    ``fleet`` is duck-typed (the real :class:`~hydragnn_tpu.fleet.fleet.
+    Fleet`, or a test stub): it must expose ``replica_count()``,
+    ``live_replicas()`` / ``dead_replicas()``, ``scale_up(reason)``,
+    ``scale_down(reason, timeout)`` and ``replace(name, reason)``.
+    ``engine`` defaults to a TriggerEngine over ``registry`` built from
+    the config's SLO fields (no cooldown of its own — the controller
+    owns rate limiting). ``clock`` is injectable for fake-clock tests.
+    """
+
+    def __init__(
+        self,
+        fleet,
+        registry=None,
+        config: Optional[ControllerConfig] = None,
+        engine: Optional[TriggerEngine] = None,
+        flight=None,
+        clock=time.monotonic,
+    ):
+        cfg = config or ControllerConfig()
+        self.fleet = fleet
+        self.flight = flight
+        self._clock = clock
+        self.min_replicas = (
+            cfg.min_replicas
+            if cfg.min_replicas is not None
+            else knobs.get_int("HYDRAGNN_FLEET_MIN_REPLICAS", 1)
+        )
+        self.max_replicas = (
+            cfg.max_replicas
+            if cfg.max_replicas is not None
+            else knobs.get_int("HYDRAGNN_FLEET_MAX_REPLICAS", 4)
+        )
+        self.cooldown_s = (
+            cfg.cooldown_s
+            if cfg.cooldown_s is not None
+            else knobs.get_float("HYDRAGNN_FLEET_COOLDOWN_S", 30.0)
+        )
+        self.quiet_for_s = (
+            cfg.quiet_for_s
+            if cfg.quiet_for_s is not None
+            else knobs.get_float("HYDRAGNN_FLEET_QUIET_S", 60.0)
+        )
+        self.eval_every_s = (
+            cfg.eval_every_s
+            if cfg.eval_every_s is not None
+            else knobs.get_float("HYDRAGNN_FLEET_EVAL_EVERY_S", 1.0)
+        )
+        self.quiet_load = int(cfg.quiet_load)
+        self.breach_evals = max(1, int(cfg.breach_evals))
+        self.drain_timeout_s = float(cfg.drain_timeout_s)
+        if engine is None:
+            rules = []
+            if cfg.slo_queue_depth is not None:
+                rules.append(
+                    TriggerRule(
+                        "fleet_queue_depth", "queue_depth",
+                        "fleet.queue_depth", float(cfg.slo_queue_depth),
+                    )
+                )
+            if cfg.slo_p99_ms is not None:
+                rules.append(
+                    TriggerRule(
+                        "fleet_p99", "latency_p99",
+                        "fleet.latency_s", cfg.slo_p99_ms / 1e3,
+                    )
+                )
+            # the CONTROLLER owns rate limiting (cooldown_s above); the
+            # engine must report every breach it sees, unlimited
+            engine = TriggerEngine(
+                rules, registry=registry, cooldown_s=0.0,
+                max_incidents=1_000_000_000, clock=clock,
+            )
+        self.engine = engine
+        # decision state — only step() (one caller at a time: the loop
+        # thread or a test driving it directly) mutates these
+        # graftsync: thread-safe=only the single step() caller mutates (loop thread or test)
+        self._last_scale_t: Optional[float] = None
+        # graftsync: thread-safe=only the single step() caller mutates
+        self._breach_streak = 0
+        # graftsync: thread-safe=only the single step() caller mutates
+        self._quiet_since: Optional[float] = None
+        self.decisions: List[Dict[str, Any]] = []  # graftsync: guarded-by=fleet.FleetController._lock
+        self._lock = syncdebug.maybe_wrap(
+            threading.Lock(), "fleet.FleetController._lock"
+        )
+        # graftsync: thread-safe=written before the loop thread starts; the loop reads it
+        self._loop: Optional[threading.Thread] = None
+        # graftsync: thread-safe=threading.Event is internally synchronized
+        self._stop = threading.Event()
+
+    # -- decisions -----------------------------------------------------------
+
+    def _decide(self, action: str, reason: str, **detail) -> Dict[str, Any]:
+        d = {
+            "action": action,
+            "reason": reason,
+            "replicas": self.fleet.replica_count(),
+            **detail,
+        }
+        with self._lock:
+            self.decisions.append(d)
+        if self.flight is not None:
+            self.flight.record("fleet_scale", **d)
+        return d
+
+    def _cooling(self, now: float) -> bool:
+        return (
+            self._last_scale_t is not None
+            and now - self._last_scale_t < self.cooldown_s
+        )
+
+    def step(self) -> List[Dict[str, Any]]:
+        """One evaluation pass; returns the decisions made (possibly
+        empty). Order matters: reap first (capacity restoration is
+        never rate-limited), then breach scale-up, then quiet
+        scale-down."""
+        now = self._clock()
+        out: List[Dict[str, Any]] = []
+
+        # 1. reap dead replicas — replace immediately, outside cooldown
+        for name in list(self.fleet.dead_replicas()):
+            try:
+                self.fleet.replace(name, reason="dead_replica")
+                out.append(self._decide("replace", "dead_replica", dead=name))
+            except Exception as exc:
+                out.append(
+                    self._decide(
+                        "replace_failed", "dead_replica",
+                        dead=name, error=repr(exc)[-200:],
+                    )
+                )
+            self._last_scale_t = now
+
+        # 2. breach -> scale up (sustained verdicts only)
+        verdicts = self.engine.evaluate()
+        if verdicts:
+            self._breach_streak += 1
+            self._quiet_since = None
+        else:
+            self._breach_streak = 0
+        if verdicts and self._breach_streak >= self.breach_evals:
+            rule = verdicts[0].rule
+            if self._cooling(now):
+                pass  # not a decision yet: the last one is still settling
+            elif self.fleet.replica_count() >= self.max_replicas:
+                out.append(
+                    self._decide("hold", rule, bound="max_replicas")
+                )
+                self._last_scale_t = now
+            else:
+                try:
+                    name = self.fleet.scale_up(reason=rule)
+                    out.append(self._decide("up", rule, spawned=name))
+                except Exception as exc:
+                    out.append(
+                        self._decide("up_failed", rule, error=repr(exc)[-200:])
+                    )
+                self._last_scale_t = now
+                self._breach_streak = 0
+            return out
+
+        # 3. quiet fleet -> scale down
+        if self.fleet.total_load() <= self.quiet_load:
+            if self._quiet_since is None:
+                self._quiet_since = now
+            quiet_for = now - self._quiet_since
+            if (
+                quiet_for >= self.quiet_for_s
+                and self.fleet.replica_count() > self.min_replicas
+                and not self._cooling(now)
+            ):
+                try:
+                    name = self.fleet.scale_down(
+                        reason="quiet", timeout=self.drain_timeout_s
+                    )
+                    out.append(self._decide("down", "quiet", retired=name))
+                except Exception as exc:
+                    out.append(
+                        self._decide(
+                            "down_failed", "quiet", error=repr(exc)[-200:]
+                        )
+                    )
+                self._last_scale_t = now
+                self._quiet_since = now
+        else:
+            self._quiet_since = None
+        return out
+
+    # -- background loop ----------------------------------------------------
+
+    def start(self) -> "FleetController":
+        if self._loop is not None:
+            return self
+        self._stop.clear()
+        self._loop = threading.Thread(
+            target=self._run, name="hydragnn-fleet-controller", daemon=True
+        )
+        self._loop.start()
+        return self
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        if self._loop is not None:
+            self._loop.join(timeout)
+            self._loop = None
+
+    # graftsync: thread-root
+    def _run(self) -> None:
+        while not self._stop.wait(self.eval_every_s):
+            try:
+                self.step()
+            except Exception as exc:
+                # the controller must outlive any single bad step; the
+                # failure is evidence, not a death
+                if self.flight is not None:
+                    self.flight.error(exc, where="fleet_controller")
+
+    def decision_log(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self.decisions)

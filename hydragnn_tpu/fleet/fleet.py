@@ -1,0 +1,359 @@
+"""The fleet: N supervised replicas, one router, one metrics registry.
+
+One :class:`Fleet` serves several models (the multi-model registry —
+the exec-cache key already fingerprints architecture, so mixed ladders
+share one cache directory safely) behind one admission front door.
+Everything the subsystem promises composes from pieces that already
+exist:
+
+  - replicas are plain :class:`~hydragnn_tpu.serve.server.ModelServer`
+    instances wrapped by :class:`~hydragnn_tpu.fleet.replica.
+    FleetReplica`, every one built against the SHARED ``exec_cache_dir``
+    so only the first pays AOT compiles;
+  - per-replica metrics live on the shared fleet registry under
+    ``fleet.<replica>.*`` (the :class:`~hydragnn_tpu.serve.metrics.
+    ServeMetrics` prefix seam), next to the router's fleet aggregates
+    the autoscaler triggers read;
+  - scale-up picks the busiest model group, scale-down drains the
+    least-loaded replica (never orphaning a model);
+  - :meth:`rolling_reload` walks a model's replicas one at a time —
+    router pause -> drain -> the server's own canary/rollback
+    ``reload()`` -> resume — so the fleet never has fewer than N-1
+    replicas serving and a bad candidate rolls back with the fleet
+    untouched (one ``fleet_reload`` flight event per replica).
+
+All replica servers share the fleet's flight recorder: one JSONL
+carries every replica's ``run_start`` manifest, exec-cache events,
+scale decisions, and reload outcomes — the merged timeline ci.sh
+validates.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+from typing import Any, Dict, List, Optional, Sequence
+
+from hydragnn_tpu.fleet.replica import FleetReplica, ReplicaFailed, write_probe_textfile
+from hydragnn_tpu.fleet.router import FleetRouter, RouterConfig, TenantQuota
+from hydragnn_tpu.obs.registry import MetricsRegistry
+from hydragnn_tpu.serve.buckets import build_bucket_ladder
+from hydragnn_tpu.serve.metrics import ServeMetrics
+from hydragnn_tpu.serve.server import ModelServer, ReloadFailed, ServeConfig
+from hydragnn_tpu.utils import syncdebug
+
+
+@dataclasses.dataclass
+class _ModelGroup:
+    """One registered model: what a spawn needs to build its server."""
+
+    name: str
+    served: Any  # serve/registry.py ServedModel
+    reference_samples: Sequence
+    serve_config: ServeConfig
+
+
+class Fleet:
+    """Replica orchestration over one shared router and registry.
+
+    ``exec_cache_dir`` is the warm-start seam: every replica's
+    ServeConfig is rebuilt to point at it (an explicit per-model
+    ``exec_cache_dir`` wins). ``registry`` defaults to a private
+    :class:`MetricsRegistry`; pass a shared one to co-locate fleet
+    metrics with a larger process.
+    """
+
+    def __init__(
+        self,
+        exec_cache_dir: Optional[str] = None,
+        registry: Optional[MetricsRegistry] = None,
+        flight=None,
+        router_config: Optional[RouterConfig] = None,
+        quotas: Optional[Dict[str, TenantQuota]] = None,
+    ):
+        self.exec_cache_dir = exec_cache_dir
+        self.registry = registry if registry is not None else MetricsRegistry()
+        if flight is None:
+            from hydragnn_tpu.obs import FlightRecorder
+
+            flight = FlightRecorder(None, enabled=False)
+        self.flight = flight
+        self.router = FleetRouter(
+            self.registry, flight=flight, quotas=quotas, config=router_config
+        )
+        self._lock = syncdebug.maybe_wrap(
+            threading.Lock(), "fleet.Fleet._lock"
+        )
+        # graftsync: guarded-by=fleet.Fleet._lock
+        self._models: Dict[str, _ModelGroup] = {}
+        self._next_replica = 0  # graftsync: guarded-by=fleet.Fleet._lock
+
+    # -- model registry -----------------------------------------------------
+
+    def add_model(
+        self,
+        name: str,
+        served,
+        reference_samples: Sequence,
+        serve_config: Optional[ServeConfig] = None,
+        replicas: int = 1,
+    ) -> List[FleetReplica]:
+        """Register one model and spawn its initial replicas."""
+        cfg = serve_config or ServeConfig()
+        cfg = dataclasses.replace(
+            cfg,
+            exec_cache_dir=cfg.exec_cache_dir or self.exec_cache_dir,
+            # per-replica registries share the fleet one; the registry-
+            # wide textfile would not speak the probe contract, so probe
+            # export goes through export_probes() instead
+            prometheus_path=None,
+        )
+        with self._lock:
+            if name in self._models:
+                raise ValueError(f"model {name!r} already registered")
+            self._models[name] = _ModelGroup(name, served, reference_samples, cfg)
+        return [self._spawn(name) for _ in range(max(1, int(replicas)))]
+
+    def models(self) -> List[str]:
+        with self._lock:
+            return sorted(self._models)
+
+    # -- replica lifecycle --------------------------------------------------
+
+    def _spawn(self, model: str) -> FleetReplica:
+        """Build + start one replica for ``model`` and attach it to the
+        router. Any failure is wrapped in :class:`ReplicaFailed` — the
+        fleet (and controller) survive a bad spawn."""
+        with self._lock:
+            group = self._models.get(model)
+            rname = f"r{self._next_replica}"
+            self._next_replica += 1
+        if group is None:
+            raise ReplicaFailed(f"unknown model {model!r}")
+        try:
+            cfg = group.serve_config
+            # the ladder is deterministic in (samples, config), so the
+            # prefixed metrics facade can be sized before the server
+            # builds its own identical ladder
+            n_buckets = len(
+                build_bucket_ladder(
+                    group.reference_samples,
+                    cfg.max_batch,
+                    num_buckets=cfg.num_buckets,
+                    node_multiple=cfg.node_multiple,
+                    edge_multiple=cfg.edge_multiple,
+                )
+            )
+            metrics = ServeMetrics(
+                n_buckets,
+                latency_window=cfg.latency_window,
+                registry=self.registry,
+                prefix=f"fleet.{rname}",
+            )
+            server = ModelServer(
+                group.served,
+                group.reference_samples,
+                cfg,
+                metrics=metrics,
+                flight=self.flight,
+            )
+            server.start()
+        except Exception as exc:
+            raise ReplicaFailed(
+                f"spawning replica {rname} for model {model!r} failed: {exc!r}"
+            ) from exc
+        replica = FleetReplica(rname, model, server)
+        self.router.attach(replica)
+        return replica
+
+    def replica_count(self) -> int:
+        return len(self.router.replicas())
+
+    def replicas(self) -> List[FleetReplica]:
+        return self.router.replicas()
+
+    def get_replica(self, name: str) -> Optional[FleetReplica]:
+        for r in self.router.replicas():
+            if r.name == name:
+                return r
+        return None
+
+    def dead_replicas(self) -> List[str]:
+        """Names of attached replicas that are no longer live (the
+        controller's reap input)."""
+        return [r.name for r in self.router.replicas() if not r.live]
+
+    def total_load(self) -> int:
+        return self.router.total_load()
+
+    # -- scaling primitives (the controller's verbs) ------------------------
+
+    def scale_up(self, reason: str = "manual") -> str:
+        """Spawn one replica for the busiest model group; returns the
+        new replica's name."""
+        with self._lock:
+            names = sorted(self._models)
+        if not names:
+            raise ReplicaFailed("no model registered")
+        loads = {n: 0 for n in names}
+        for r in self.router.replicas():
+            if r.model in loads:
+                loads[r.model] += r.load()
+        busiest = max(names, key=lambda n: loads[n])
+        return self._spawn(busiest).name
+
+    def scale_down(
+        self, reason: str = "manual", timeout: Optional[float] = 30.0
+    ) -> str:
+        """Retire the least-loaded replica whose model keeps at least
+        one other replica; drain-then-stop so nothing in flight is
+        lost. Returns the retired replica's name."""
+        replicas = self.router.replicas()
+        per_model: Dict[str, int] = {}
+        for r in replicas:
+            per_model[r.model] = per_model.get(r.model, 0) + 1
+        candidates = [r for r in replicas if per_model[r.model] > 1]
+        if not candidates and len(per_model) == 1:
+            candidates = replicas  # single model: the controller's
+            # min_replicas bound is the floor, not model coverage
+        if not candidates:
+            raise ReplicaFailed("no replica can be retired without orphaning a model")
+        victim = min(candidates, key=lambda r: r.load())
+        self.router.detach(victim.name)
+        victim.drain_stop(timeout)
+        return victim.name
+
+    def replace(self, name: str, reason: str = "dead_replica") -> str:
+        """Reap one dead replica and spawn its replacement (same
+        model). The dead server is stopped for finalization only — its
+        queue already failed everything typed when it died."""
+        dead = self.router.detach(name)
+        if dead is None:
+            raise ReplicaFailed(f"no attached replica named {name!r}")
+        try:
+            dead.server.stop(timeout=1.0)
+        except Exception:
+            pass  # already loudly dead; finalization is best-effort
+        return self._spawn(dead.model).name
+
+    # -- fleet-wide rolling reload ------------------------------------------
+
+    def rolling_reload(
+        self,
+        model: str,
+        checkpoint: Optional[str] = None,
+        *,
+        variables: Optional[Dict[str, Any]] = None,
+        log_dir: Optional[str] = None,
+        drain_timeout_s: float = 30.0,
+    ) -> List[Dict[str, Any]]:
+        """Reload every replica of ``model`` one at a time: the router
+        stops placing on a replica, its in-flight work drains, the
+        server's own canary-gated ``reload()`` swaps weights (rollback
+        built in), and the replica rejoins placement — N-1 replicas
+        serve throughout. A failed canary aborts the roll with the
+        remaining replicas untouched on the old weights and raises
+        :class:`~hydragnn_tpu.serve.server.ReloadFailed`."""
+        targets = [r for r in self.router.replicas() if r.model == model]
+        if not targets:
+            raise ReplicaFailed(f"no replicas serving model {model!r}")
+        outcomes: List[Dict[str, Any]] = []
+        for r in sorted(targets, key=lambda x: x.name):
+            self.router.pause(r.name)
+            r.drain(drain_timeout_s)
+            try:
+                info = r.server.reload(
+                    checkpoint, variables=variables, log_dir=log_dir
+                )
+            except ReloadFailed as exc:
+                # old weights still serving on THIS replica too — put it
+                # back in rotation before surfacing the abort
+                r.undrain()
+                self.router.resume(r.name)
+                self.flight.record(
+                    "fleet_reload",
+                    model=model,
+                    replica=r.name,
+                    ok=False,
+                    error=repr(exc)[-200:],
+                    aborted_roll=True,
+                )
+                raise
+            r.undrain()
+            self.router.resume(r.name)
+            outcome = {"replica": r.name, "ok": True, **info}
+            outcomes.append(outcome)
+            self.flight.record(
+                "fleet_reload", model=model, replica=r.name, ok=True,
+                swap_s=info.get("swap_s"),
+            )
+        return outcomes
+
+    # -- request path -------------------------------------------------------
+
+    def submit(self, sample, tenant: str = "default", model: Optional[str] = None):
+        return self.router.submit(sample, tenant=tenant, model=model)
+
+    def predict(
+        self,
+        sample,
+        tenant: str = "default",
+        model: Optional[str] = None,
+        timeout: Optional[float] = None,
+    ):
+        return self.router.predict(sample, tenant=tenant, model=model, timeout=timeout)
+
+    # -- health / probes ----------------------------------------------------
+
+    def health(self) -> Dict[str, Any]:
+        replicas = {r.name: r.health() for r in self.router.replicas()}
+        ready = sum(1 for h in replicas.values() if h["ready"])
+        live = sum(1 for h in replicas.values() if h["live"])
+        return {
+            "replicas": replicas,
+            "replica_count": len(replicas),
+            "ready_count": ready,
+            "live_count": live,
+            "total_load": self.total_load(),
+            "models": self.models(),
+        }
+
+    def export_probes(self, directory: str) -> List[str]:
+        """One probe textfile per replica (``<name>.prom``) plus the
+        router's own ``router.prom`` (ready = at least one replica
+        routable), all under the standard ``hydragnn_serve_*`` gauge
+        names — the files ``tools/serve_probe.py --fleet`` aggregates."""
+        os.makedirs(directory, exist_ok=True)
+        paths: List[str] = []
+        replicas = self.router.replicas()
+        for r in replicas:
+            p = os.path.join(directory, f"{r.name}.prom")
+            r.export_probe(p)
+            paths.append(p)
+        router_path = os.path.join(directory, "router.prom")
+        write_probe_textfile(
+            router_path,
+            live=any(r.live for r in replicas),
+            ready=any(r.ready for r in replicas),
+        )
+        paths.append(router_path)
+        return paths
+
+    # -- teardown -----------------------------------------------------------
+
+    def stop(self, timeout: Optional[float] = 10.0) -> None:
+        """Drain-stop every replica (detaching each from the router
+        first so nothing new lands while it drains)."""
+        for r in self.router.replicas():
+            self.router.detach(r.name)
+            try:
+                r.drain_stop(timeout)
+            except Exception:
+                pass  # teardown is best-effort; servers finalize themselves
+
+    def __enter__(self) -> "Fleet":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -1,0 +1,214 @@
+"""One fleet replica: a supervised :class:`ModelServer` lifecycle wrapper.
+
+A replica IS a ModelServer — same bucket ladder, same dispatch
+supervisor, same health surface — plus the lifecycle the fleet layer
+needs around it:
+
+  - **warm spawn**: every replica is built against the fleet's shared
+    ``exec_cache_dir``, so the first replica pays the AOT compiles and
+    every later one deserializes the whole ladder from disk
+    (0 compiles, ``exec_cache_hits == len(buckets)`` — the ~0.14s
+    cold-start the exec-cache PR measured);
+  - **in-flight accounting**: the router routes on
+    :meth:`load` (queued + executing requests) and retirement waits on
+    it — a drained replica has zero unresolved futures by definition;
+  - **drain-then-stop retirement**: :meth:`drain_stop` stops admitting
+    (the router un-targets it first), waits for in-flight work, then
+    stops the server — scale-down never fails a request;
+  - **probe export**: :meth:`export_probe` writes a per-replica
+    Prometheus textfile with the STANDARD ``hydragnn_serve_ready`` /
+    ``hydragnn_serve_live`` gauge names, so ``tools/serve_probe.py``
+    (and its ``--fleet`` aggregate mode) probes a replica exactly like
+    a standalone server. (The replica's registry metrics are prefixed
+    ``fleet.<name>.*`` to avoid aliasing in the shared fleet registry,
+    which would render as ``hydragnn_fleet_<name>_ready`` — not the
+    probe contract — hence this dedicated writer.)
+
+Health verdicts come from ``ModelServer.health()`` unchanged: a replica
+whose dispatch supervisor gave up reports ``live=False`` and the fleet
+controller reaps and replaces it.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Dict, Optional
+
+from hydragnn_tpu.serve.batcher import ServerClosed
+from hydragnn_tpu.serve.server import ModelServer
+from hydragnn_tpu.utils import syncdebug
+
+
+class ReplicaFailed(RuntimeError):
+    """Spawning or retiring a replica failed; the fleet itself survives
+    (the controller records the failure and keeps its bounds)."""
+
+
+class FleetReplica:
+    """Lifecycle wrapper around one started-or-starting ModelServer.
+
+    States: ``starting`` (built, ladder warming) -> ``ready``
+    (serving) -> ``draining`` (no new admissions, in-flight work
+    finishing) -> ``stopped``. A replica that died under its server's
+    restart budget shows ``live=False`` in any state — state tracks
+    intent, health tracks reality.
+    """
+
+    def __init__(self, name: str, model: str, server: ModelServer):
+        self.name = name
+        self.model = model
+        self.server = server
+        self._lock = syncdebug.maybe_wrap(
+            threading.Condition(), "fleet.FleetReplica._lock"
+        )
+        self._inflight = 0  # graftsync: guarded-by=fleet.FleetReplica._lock
+        self._draining = False  # graftsync: guarded-by=fleet.FleetReplica._lock
+        self._stopped = False  # graftsync: guarded-by=fleet.FleetReplica._lock
+        self.spawned_t = time.monotonic()
+
+    # -- request path (router only) ----------------------------------------
+
+    def submit(self, sample: Any, seq: int = -1) -> Future:
+        """Admit one request on this replica's server, counting it
+        in-flight until its future resolves (the drain barrier)."""
+        with self._lock:
+            if self._draining or self._stopped:
+                raise ServerClosed(
+                    f"replica {self.name} is "
+                    f"{'draining' if self._draining else 'stopped'}"
+                )
+            self._inflight += 1
+        try:
+            fut = self.server.submit(sample)
+        except BaseException:
+            self._dec_inflight()
+            raise
+        fut.add_done_callback(lambda _f: self._dec_inflight())
+        return fut
+
+    def _dec_inflight(self) -> None:
+        with self._lock:
+            self._inflight = max(0, self._inflight - 1)
+            if self._inflight == 0:
+                self._lock.notify_all()
+
+    def load(self) -> int:
+        """Unresolved requests on this replica (queued + executing) —
+        the router's least-loaded placement signal; a superset of the
+        server's queue depth that also covers batches in flight."""
+        with self._lock:
+            return self._inflight
+
+    def queue_depth(self) -> int:
+        return self.server.queue_depth()
+
+    # -- health -------------------------------------------------------------
+
+    def health(self) -> Dict[str, Any]:
+        h = self.server.health()
+        h["replica"] = self.name
+        h["model"] = self.model
+        h["state"] = self.state
+        h["inflight"] = self.load()
+        return h
+
+    @property
+    def live(self) -> bool:
+        return bool(self.server.health()["live"])
+
+    @property
+    def ready(self) -> bool:
+        """Routable: the server says READY and the fleet has not begun
+        retiring or pausing this replica."""
+        with self._lock:
+            if self._draining or self._stopped:
+                return False
+        return bool(self.server.health()["ready"])
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            if self._stopped:
+                return "stopped"
+            if self._draining:
+                return "draining"
+        return "ready" if self.server.health()["ready"] else "starting"
+
+    # -- retirement ---------------------------------------------------------
+
+    def drain(self, timeout: Optional[float] = 30.0) -> bool:
+        """Stop admitting and wait until every in-flight request has
+        resolved; returns False on timeout (requests still pending —
+        the caller decides whether to stop anyway)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            self._draining = True
+            while self._inflight > 0:
+                remaining = (
+                    None if deadline is None else deadline - time.monotonic()
+                )
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._lock.wait(timeout=remaining)
+        return True
+
+    def undrain(self) -> None:
+        """Re-open admissions (rolling reload resumes a paused replica;
+        a stopped replica stays stopped)."""
+        with self._lock:
+            if not self._stopped:
+                self._draining = False
+
+    def drain_stop(self, timeout: Optional[float] = 30.0) -> bool:
+        """Graceful retirement: drain, then stop the server (which
+        flushes its own queue and finalizes its flight record). Returns
+        whether the drain completed before the timeout."""
+        drained = self.drain(timeout)
+        with self._lock:
+            self._stopped = True
+        self.server.stop()
+        return drained
+
+    def kill(self) -> None:
+        """Simulated abrupt replica death (chaos/test hook): the
+        dispatch restart budget is marked exhausted and every queued
+        request fails with the typed dispatch error — exactly the
+        observable state of a replica whose supervisor gave up, which
+        is what the controller's reap path keys on."""
+        sup = self.server._supervisor
+        if sup is not None:
+            sup.failed = True
+        self.server._on_dispatch_giveup(ReplicaFailed(f"replica {self.name} killed"))
+
+    # -- probe export --------------------------------------------------------
+
+    def export_probe(self, path: str) -> None:
+        """Write this replica's probe textfile with the standard
+        ``hydragnn_serve_{live,ready}`` gauge names (the
+        ``tools/serve_probe.py`` contract), atomically."""
+        h = self.server.health()
+        ready = h["ready"]
+        with self._lock:
+            ready = ready and not (self._draining or self._stopped)
+        write_probe_textfile(path, live=h["live"], ready=ready)
+
+
+def write_probe_textfile(path: str, *, live: bool, ready: bool) -> None:
+    """Minimal probe exposition: the two gauges ``serve_probe`` parses,
+    under the standard names regardless of the writer's registry
+    prefix. Atomic rename so a probe never reads a half-written file."""
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    body = (
+        "# TYPE hydragnn_serve_live gauge\n"
+        f"hydragnn_serve_live {1 if live else 0}\n"
+        "# TYPE hydragnn_serve_ready gauge\n"
+        f"hydragnn_serve_ready {1 if ready else 0}\n"
+    )
+    tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.tmp"
+    with open(tmp, "w") as f:
+        f.write(body)
+    os.replace(tmp, path)

@@ -1,0 +1,399 @@
+"""Fleet admission front door: tenancy, quotas, priorities, placement.
+
+Every request enters the fleet here, not at a replica. Admission runs
+four gates in order, each with its own typed rejection and counter:
+
+  1. **tenant quota** — a token bucket per tenant (``rate`` tokens/s
+     refill up to ``burst``; one admission costs one token). An empty
+     bucket raises :class:`TenantOverloaded` — an
+     :class:`~hydragnn_tpu.serve.batcher.Overloaded` subclass carrying
+     the tenant and the admission trace ID, so a 429 can name who was
+     throttled and the flight timeline can show why.
+  2. **priority shedding** — quotas carry a priority class
+     (``premium`` / ``standard`` / ``batch``). When the fleet-wide
+     in-flight load reaches ``RouterConfig.shed_load``, ``batch``
+     traffic is shed first (typed Overloaded), keeping headroom for the
+     interactive classes. Disabled when ``shed_load`` is None.
+  3. **placement** — least-loaded routing: among READY replicas serving
+     the requested model (excluding paused/draining ones), the replica
+     with the fewest unresolved requests wins. No READY replica ->
+     Overloaded (the caller's retry/shed decision, exactly as for a
+     single overloaded server).
+  4. **replica-death retry** — a future that fails with the dispatch
+     death signature (``RequestFailed(reason="dispatch")`` /
+     ``ServerClosed``) is resubmitted once to a DIFFERENT replica:
+     a replica killed mid-traffic costs latency, not answers.
+
+Per-tenant metrics land on the shared fleet registry
+(``fleet.tenant.<tenant>.{requests,rejected,latency_s}``) next to the
+fleet aggregates (``fleet.queue_depth``, ``fleet.latency_s``) the
+autoscaler's trigger rules watch. A trace is begun AT ADMISSION with
+the tenant and model stamped in its attrs, so per-tenant debugging
+rides the same r12 timeline as everything else.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional
+
+from hydragnn_tpu.fleet.replica import FleetReplica
+from hydragnn_tpu.obs.trace import Tracer
+from hydragnn_tpu.serve.batcher import Overloaded, ServerClosed
+from hydragnn_tpu.serve.server import RequestFailed
+from hydragnn_tpu.utils import knobs, syncdebug
+
+PRIORITIES = ("premium", "standard", "batch")
+
+
+class TenantOverloaded(Overloaded):
+    """A tenant's admission quota (or the shed gate) rejected the
+    request. Carries ``tenant`` and the admission ``trace_id`` so the
+    rejection is attributable end to end."""
+
+    def __init__(self, message: str, tenant: str, trace_id: Optional[str] = None):
+        super().__init__(message)
+        self.tenant = tenant
+        self.trace_id = trace_id
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantQuota:
+    """One tenant's admission contract: ``rate`` requests/s refill up
+    to ``burst`` tokens (0 rate = unlimited), plus the priority class
+    the shed gate orders by."""
+
+    rate: float = 0.0
+    burst: float = 32.0
+    priority: str = "standard"
+
+    def __post_init__(self):
+        if self.priority not in PRIORITIES:
+            raise ValueError(
+                f"unknown priority {self.priority!r} (one of {PRIORITIES})"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
+class RouterConfig:
+    """Router policy. ``default_rate``/``default_burst`` default from
+    the ``HYDRAGNN_FLEET_TENANT_*`` knobs and apply to tenants without
+    an explicit quota; ``shed_load`` is the fleet-wide in-flight count
+    at which ``batch``-priority traffic sheds (None = never);
+    ``max_death_retries`` bounds per-request replica-death retries."""
+
+    default_rate: Optional[float] = None
+    default_burst: Optional[float] = None
+    shed_load: Optional[int] = None
+    max_death_retries: int = 1
+
+
+class _TokenBucket:
+    """Classic token bucket; not thread-safe on its own (the router's
+    lock serializes access)."""
+
+    def __init__(self, rate: float, burst: float, clock):
+        self.rate = float(rate)
+        self.burst = float(burst)
+        self._clock = clock
+        self._tokens = float(burst)
+        self._last = clock()
+
+    def try_take(self) -> bool:
+        if self.rate <= 0:
+            return True  # unlimited tenant
+        now = self._clock()
+        self._tokens = min(self.burst, self._tokens + (now - self._last) * self.rate)
+        self._last = now
+        if self._tokens >= 1.0:
+            self._tokens -= 1.0
+            return True
+        return False
+
+
+class FleetRouter:
+    """Shared admission front door over a set of :class:`FleetReplica`.
+
+    The fleet attaches/detaches replicas as the controller scales;
+    ``pause``/``resume`` take a replica out of placement without
+    draining it (the rolling-reload primitive). ``clock`` is injectable
+    for deterministic quota tests.
+    """
+
+    def __init__(
+        self,
+        registry,
+        flight=None,
+        quotas: Optional[Dict[str, TenantQuota]] = None,
+        config: Optional[RouterConfig] = None,
+        clock=time.monotonic,
+    ):
+        cfg = config or RouterConfig()
+        self.config = cfg
+        self.registry = registry
+        self.flight = flight
+        self._clock = clock
+        self._default_rate = (
+            cfg.default_rate
+            if cfg.default_rate is not None
+            else knobs.get_float("HYDRAGNN_FLEET_TENANT_RATE", 0.0)
+        )
+        self._default_burst = (
+            cfg.default_burst
+            if cfg.default_burst is not None
+            else knobs.get_float("HYDRAGNN_FLEET_TENANT_BURST", 32.0)
+        )
+        self._tracer = Tracer(flight=flight)
+        self._lock = syncdebug.maybe_wrap(
+            threading.Lock(), "fleet.FleetRouter._lock"
+        )
+        # graftsync: guarded-by=fleet.FleetRouter._lock
+        self._replicas: Dict[str, FleetReplica] = {}
+        self._paused: set = set()  # graftsync: guarded-by=fleet.FleetRouter._lock
+        self._quotas: Dict[str, TenantQuota] = dict(quotas or {})  # graftsync: guarded-by=fleet.FleetRouter._lock
+        self._buckets: Dict[str, _TokenBucket] = {}  # graftsync: guarded-by=fleet.FleetRouter._lock
+        self._tenant_metrics: Dict[str, dict] = {}  # graftsync: guarded-by=fleet.FleetRouter._lock
+        r = registry
+        self._requests = r.counter("fleet.requests_total")
+        self._results = r.counter("fleet.results_total")
+        self._rejected_quota = r.counter("fleet.rejected_quota")
+        self._rejected_shed = r.counter("fleet.rejected_shed")
+        self._rejected_no_replica = r.counter("fleet.rejected_no_replica")
+        self._death_retries = r.counter("fleet.death_retries")
+        self._failed = r.counter("fleet.failed")
+        self._queue_depth = r.gauge("fleet.queue_depth")
+        self._latency = r.histogram("fleet.latency_s")
+
+    # -- replica set --------------------------------------------------------
+
+    def attach(self, replica: FleetReplica) -> None:
+        with self._lock:
+            self._replicas[replica.name] = replica
+            self._paused.discard(replica.name)
+
+    def detach(self, name: str) -> Optional[FleetReplica]:
+        with self._lock:
+            self._paused.discard(name)
+            return self._replicas.pop(name, None)
+
+    def pause(self, name: str) -> None:
+        """Take a replica out of placement (it keeps serving what it
+        already holds) — the rolling-reload drain step."""
+        with self._lock:
+            self._paused.add(name)
+
+    def resume(self, name: str) -> None:
+        with self._lock:
+            self._paused.discard(name)
+
+    def replicas(self) -> List[FleetReplica]:
+        with self._lock:
+            return list(self._replicas.values())
+
+    def set_quota(self, tenant: str, quota: TenantQuota) -> None:
+        with self._lock:
+            self._quotas[tenant] = quota
+            self._buckets.pop(tenant, None)  # rebuilt from the new quota
+
+    # -- metrics helpers ----------------------------------------------------
+
+    # graftsync: holds=fleet.FleetRouter._lock
+    def _tenant(self, tenant: str) -> dict:
+        """Per-tenant metric bundle, created lazily under the lock —
+        every caller already holds it."""
+        m = self._tenant_metrics.get(tenant)
+        if m is None:
+            p = f"fleet.tenant.{tenant.replace('.', '_')}"
+            m = {
+                "requests": self.registry.counter(f"{p}.requests"),
+                "rejected": self.registry.counter(f"{p}.rejected"),
+                "latency": self.registry.histogram(f"{p}.latency_s"),
+            }
+            self._tenant_metrics[tenant] = m
+        return m
+
+    def total_load(self) -> int:
+        """Unresolved requests across the whole fleet — the aggregate
+        the shed gate and the autoscaler's queue_depth rule read."""
+        return sum(r.load() for r in self.replicas())
+
+    def _set_queue_gauge(self) -> None:
+        self._queue_depth.set(self.total_load())
+
+    # -- admission ----------------------------------------------------------
+
+    def submit(
+        self, sample: Any, tenant: str = "default", model: Optional[str] = None
+    ) -> Future:
+        """Admit one request for ``tenant``; returns a router-owned
+        Future resolving to the model's result dict. Raises
+        :class:`TenantOverloaded` (quota/shed) or
+        :class:`~hydragnn_tpu.serve.batcher.Overloaded` (no READY
+        replica) — typed and immediate."""
+        self._requests.inc()
+        trace = self._tracer.begin(tenant=tenant, model=model or "default")
+        trace_id = trace.trace_id if trace is not None else None
+        with self._lock:
+            tm = self._tenant(tenant)
+            tm["requests"].inc()
+            quota = self._quotas.get(tenant)
+            bucket = self._buckets.get(tenant)
+            if bucket is None:
+                bucket = _TokenBucket(
+                    quota.rate if quota else self._default_rate,
+                    quota.burst if quota else self._default_burst,
+                    self._clock,
+                )
+                self._buckets[tenant] = bucket
+            admitted = bucket.try_take()
+        if not admitted:
+            tm["rejected"].inc()
+            self._rejected_quota.inc()
+            self._finish_reject(trace, "quota", tenant)
+            raise TenantOverloaded(
+                f"tenant {tenant!r} over admission quota "
+                f"(rate {bucket.rate:g}/s, burst {bucket.burst:g})",
+                tenant=tenant,
+                trace_id=trace_id,
+            )
+        priority = quota.priority if quota else "standard"
+        shed = self.config.shed_load
+        if shed is not None and priority == "batch" and self.total_load() >= shed:
+            tm["rejected"].inc()
+            self._rejected_shed.inc()
+            self._finish_reject(trace, "shed", tenant)
+            raise TenantOverloaded(
+                f"batch-priority tenant {tenant!r} shed (fleet load >= {shed})",
+                tenant=tenant,
+                trace_id=trace_id,
+            )
+        outer: Future = Future()
+        t0 = time.monotonic()
+        self._dispatch(
+            sample, tenant, model, outer, trace, t0,
+            tried=[], retries_left=self.config.max_death_retries,
+        )
+        self._set_queue_gauge()
+        return outer
+
+    def _pick(self, model: Optional[str], exclude) -> Optional[FleetReplica]:
+        """Least-loaded READY replica serving ``model`` (any model when
+        None), skipping paused and excluded names."""
+        with self._lock:
+            candidates = [
+                r
+                for name, r in self._replicas.items()
+                if name not in self._paused
+                and name not in exclude
+                and (model is None or r.model == model)
+            ]
+        ready = [r for r in candidates if r.ready]
+        if not ready:
+            return None
+        return min(ready, key=lambda r: r.load())
+
+    def _dispatch(
+        self, sample, tenant, model, outer: Future, trace, t0: float,
+        tried: List[str], retries_left: int,
+    ) -> None:
+        replica = self._pick(model, exclude=set(tried))
+        if replica is None and tried:
+            # retry path: every untried replica is unready — fall back
+            # to ANY ready replica (a restarted replacement may reuse a
+            # tried name's slot) before giving up
+            replica = self._pick(model, exclude=set())
+        if replica is None:
+            self._rejected_no_replica.inc()
+            self._finish_reject(trace, "no_replica", tenant)
+            outer.set_exception(
+                Overloaded(
+                    f"no READY replica for model {model or 'default'!r} "
+                    f"(fleet of {len(self.replicas())})"
+                )
+            )
+            return
+        if trace is not None:
+            trace.mark("fleet.admit", replica=replica.name)
+        try:
+            inner = replica.submit(sample)
+        except (Overloaded, ServerClosed) as exc:
+            if retries_left > 0:
+                self._death_retries.inc()
+                self._dispatch(
+                    sample, tenant, model, outer, trace, t0,
+                    tried=tried + [replica.name], retries_left=retries_left - 1,
+                )
+                return
+            self._finish_reject(trace, "replica_rejected", tenant)
+            outer.set_exception(exc)
+            return
+        inner.add_done_callback(
+            lambda f: self._on_result(
+                f, sample, tenant, model, outer, trace, t0,
+                tried + [replica.name], retries_left, replica.name,
+            )
+        )
+
+    def _on_result(
+        self, inner: Future, sample, tenant, model, outer: Future, trace,
+        t0: float, tried: List[str], retries_left: int, replica_name: str,
+    ) -> None:
+        exc = inner.exception()
+        if exc is None:
+            latency = time.monotonic() - t0
+            self._latency.observe(latency)
+            with self._lock:
+                self._tenant(tenant)["latency"].observe(latency)
+            self._results.inc()
+            if trace is not None:
+                trace.mark("fleet.complete", replica=replica_name)
+                self._tracer.finish(trace)
+            outer.set_result(inner.result())
+            self._set_queue_gauge()
+            return
+        died = isinstance(exc, ServerClosed) or (
+            isinstance(exc, RequestFailed) and exc.reason == "dispatch"
+        )
+        if died and retries_left > 0:
+            self._death_retries.inc()
+            if trace is not None:
+                trace.mark(
+                    "fleet.retry", replica=replica_name, error=type(exc).__name__
+                )
+            self._dispatch(
+                sample, tenant, model, outer, trace, t0,
+                tried=tried, retries_left=retries_left - 1,
+            )
+            return
+        self._failed.inc()
+        if trace is not None:
+            trace.mark(
+                "fleet.failed", replica=replica_name, error=type(exc).__name__
+            )
+            self._tracer.finish(trace)
+        outer.set_exception(exc)
+        self._set_queue_gauge()
+
+    def _finish_reject(self, trace, reason: str, tenant: str) -> None:
+        if trace is not None:
+            trace.mark("fleet.reject", reason=reason, tenant=tenant)
+            self._tracer.finish(trace)
+
+    # -- convenience --------------------------------------------------------
+
+    def predict(
+        self,
+        sample: Any,
+        tenant: str = "default",
+        model: Optional[str] = None,
+        timeout: Optional[float] = None,
+    ):
+        return self.submit(sample, tenant=tenant, model=model).result(timeout)
+
+    def traces(self):
+        """The admission tracer's finished-trace ring (tests assert the
+        tenant rode the trace)."""
+        return self._tracer.traces()

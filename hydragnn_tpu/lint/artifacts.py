@@ -32,10 +32,14 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from .core import Finding
 
-#: committed flight artifacts validated by the CI stage, repo-relative
+#: committed flight artifacts validated by the CI stage, repo-relative.
+#: BENCH_FLIGHT.jsonl (the device bench's flight) is deliberately NOT
+#: listed: it is rewritten per driver round on the TPU host and is not
+#: a committed artifact — listing it made the gate fail on every
+#: checkout without device-round evidence.
 DEFAULT_ARTIFACTS = (
-    "BENCH_FLIGHT.jsonl",
     "BENCH_SERVE_WARM_FLIGHT.jsonl",
+    "BENCH_FLEET_FLIGHT.jsonl",
 )
 
 
@@ -61,7 +65,11 @@ _NUM = (int, float)
 def _check_bench(data: Any) -> List[str]:
     """BENCH_r*.json: one TPU-attempt record (bench driver wrapper).
     ``parsed`` is the bench.py metric block when the run got far enough
-    to print one, else null (BENCH_r05 died at init: rc!=0, tail only)."""
+    to print one, else null. A failed attempt (rc != 0) must be a
+    STRUCTURED failed-run record — ``status: "failed"``, the retry
+    count ``init_backend_with_retry`` burned, and a ``failure`` block
+    naming the stage and error type — not just a raw traceback tail
+    (BENCH_r05 is the committed example)."""
     problems = _require(
         data, {"n": (int,), "cmd": (str,), "rc": (int,), "tail": (str,)}
     )
@@ -76,6 +84,63 @@ def _check_bench(data: Any) -> List[str]:
                 parsed, {"metric": (str,), "value": _NUM, "unit": (str,)}
             )
         ]
+    if data["rc"] != 0:
+        problems += _require(
+            data, {"status": (str,), "retries": (int,), "failure": (dict,)}
+        )
+        if isinstance(data.get("status"), str) and data["status"] != "failed":
+            problems.append(
+                f"rc={data['rc']} but status is {data['status']!r},"
+                " expected 'failed'"
+            )
+        if isinstance(data.get("failure"), dict):
+            problems += [
+                f"failure.{p}" for p in _require(
+                    data["failure"],
+                    {"stage": (str,), "error_type": (str,), "error": (str,)},
+                )
+            ]
+    return problems
+
+
+#: the five scenario rows bench_serve.py --fleet always records — a
+#: missing one means a chaos scenario silently did not run.
+_FLEET_SCENARIOS = (
+    "baseline_n1",
+    "sustained_n2",
+    "replica_kill",
+    "scale_up_under_load",
+    "rolling_reload",
+)
+
+
+def _check_fleet(data: Any) -> List[str]:
+    """BENCH_FLEET.json: the fleet chaos acceptance record
+    (bench_serve.py --fleet, docs/FLEET.md): scale-out efficiency
+    headline plus one row per chaos scenario."""
+    problems = _require(
+        data,
+        {
+            "metric": (str,),
+            "value": _NUM,
+            "unit": (str,),
+            "replicas": (int,),
+            "qps_n1": _NUM,
+            "qps_n2": _NUM,
+            "scaleout_efficiency": _NUM,
+            "warm_replica_aot_compiles": (int,),
+            "lost_futures": (int,),
+            "slo_p99_ms": _NUM,
+            "scenarios": (dict,),
+            "failures": (list,),
+        },
+    )
+    if problems:
+        return problems
+    for name in _FLEET_SCENARIOS:
+        row = data["scenarios"].get(name)
+        if not isinstance(row, dict):
+            problems.append(f"scenarios.{name} missing (chaos scenario not run)")
     return problems
 
 
@@ -234,6 +299,7 @@ MACHINE_SCHEMAS: Dict[str, Tuple[str, Callable[[Any], List[str]]]] = {
     "SCALING_*.json": ("scaling sweep/estimate", _check_scaling),
     "TUNE_TILES.json": ("kernel tile sweep", _check_tune_tiles),
     "BENCH_CI_BASELINE.json": ("CI perf baseline", _check_ci_baseline),
+    "BENCH_FLEET.json": ("fleet chaos acceptance record", _check_fleet),
 }
 
 #: runtime-artifact kinds: produced by RUNS (never committed at the

@@ -89,6 +89,12 @@ _REQUIRED: Dict[str, tuple] = {
     # records to the same schema bar as training flight logs
     "bench_config": ("name", "result"),
     "bench_result": ("record", "passed"),
+    # serving-fleet events (hydragnn_tpu/fleet, docs/FLEET.md): every
+    # autoscaler decision (up / down / replace / hold / up_failed, with
+    # the trigger rule or quiet-timer reason and the resulting replica
+    # count) and every per-replica step of a fleet-wide rolling reload
+    "fleet_scale": ("action", "reason", "replicas"),
+    "fleet_reload": ("model", "replica", "ok"),
 }
 
 # the fault-history subset tools/obs_report.py --faults narrates
@@ -106,6 +112,8 @@ FAULT_KINDS = (
     "reload_failed",
     "incident",
     "lock_order",
+    "fleet_scale",
+    "fleet_reload",
 )
 
 _MANIFEST_REQUIRED = ("jax_version", "backend", "num_processes")

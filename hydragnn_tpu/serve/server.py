@@ -560,6 +560,12 @@ class ModelServer:
     def metrics_snapshot(self) -> dict:
         return self.metrics.snapshot()
 
+    def queue_depth(self) -> int:
+        """Requests currently queued (all buckets) — the load signal the
+        fleet router's least-queue-depth placement reads per admission,
+        kept public so callers never reach into the batcher."""
+        return self._queue.depth()
+
     # -- health / probes ---------------------------------------------------
 
     def health(self) -> Dict[str, Any]:

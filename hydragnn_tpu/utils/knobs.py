@@ -77,6 +77,25 @@ KNOBS: Dict[str, Knob] = {
            "inert. Deliberately survives supervisor restart env-strips."),
         _K("HYDRAGNN_EXEC_CACHE_MAX_MB", "float", "512", "utils/exec_cache.py",
            "LRU size bound for the executable cache directory."),
+        _K("HYDRAGNN_FLEET_COOLDOWN_S", "float", "30", "fleet/controller.py",
+           "Minimum seconds between autoscaler scale decisions (up, down, "
+           "or replace each re-arm it)."),
+        _K("HYDRAGNN_FLEET_EVAL_EVERY_S", "float", "1.0", "fleet/controller.py",
+           "Period of the fleet controller's background evaluation loop."),
+        _K("HYDRAGNN_FLEET_MAX_REPLICAS", "int", "4", "fleet/controller.py",
+           "Upper replica bound: a breach verdict at the cap records a "
+           "fleet_scale hold event instead of spawning."),
+        _K("HYDRAGNN_FLEET_MIN_REPLICAS", "int", "1", "fleet/controller.py",
+           "Lower replica bound the quiet-fleet scale-down never crosses."),
+        _K("HYDRAGNN_FLEET_QUIET_S", "float", "60", "fleet/controller.py",
+           "Seconds the fleet queue must stay below the quiet threshold "
+           "before the controller retires a replica."),
+        _K("HYDRAGNN_FLEET_TENANT_BURST", "float", "32", "fleet/router.py",
+           "Default per-tenant token-bucket burst capacity (tokens; one "
+           "admission costs one token)."),
+        _K("HYDRAGNN_FLEET_TENANT_RATE", "float", "0", "fleet/router.py",
+           "Default per-tenant admission refill rate in requests/s for "
+           "tenants without an explicit quota; 0 = unlimited."),
         _K("HYDRAGNN_FULL_MATRIX", "flag", None, "tests/test_train_matrix.py",
            "Opt into the full 7-model acceptance matrix (~15 min)."),
         _K("HYDRAGNN_GRAFTCHECK", "bool", "1", "train/loop.py",

@@ -1,0 +1,463 @@
+"""Fleet subsystem tests (hydragnn_tpu/fleet): fake-clock autoscaler
+decision policy, router admission (quotas, priorities, placement,
+death-retry), and the real-fleet integration contracts — warm-start
+from the shared exec cache, kill-then-replace, rolling reload
+bit-identity.
+
+The controller suite drives :meth:`FleetController.step` directly under
+an injected clock against a stub fleet, so every decision (sustained
+breach scale-up, cooldown suppression, quiet scale-down, min/max
+bounds, dead-replica reap) is asserted deterministically — no sleeps,
+no wall clock. The router suite uses stub replicas for the same reason.
+Integration tests build a real smoke-sized fleet (CPU, conftest's
+virtual mesh), warmed once through a shared exec cache.
+"""
+
+import os
+import time
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+from hydragnn_tpu.fleet import (
+    ControllerConfig,
+    Fleet,
+    FleetController,
+    RouterConfig,
+    FleetRouter,
+    TenantOverloaded,
+    TenantQuota,
+)
+from hydragnn_tpu.obs.registry import MetricsRegistry
+from hydragnn_tpu.serve import ModelRegistry, Overloaded, ServeConfig
+from hydragnn_tpu.serve.server import RequestFailed
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+class FakeFleet:
+    """Duck-typed fleet for controller tests: scaling verbs record
+    their calls and mutate a replica counter."""
+
+    def __init__(self, replicas: int = 1, load: int = 0):
+        self.n = replicas
+        self.load = load
+        self.dead: list = []
+        self.calls: list = []
+        self.fail_scale_up = False
+        self.fail_replace = False
+
+    def replica_count(self) -> int:
+        return self.n
+
+    def dead_replicas(self) -> list:
+        return list(self.dead)
+
+    def total_load(self) -> int:
+        return self.load
+
+    def scale_up(self, reason: str = "manual") -> str:
+        self.calls.append(("up", reason))
+        if self.fail_scale_up:
+            raise RuntimeError("spawn exploded")
+        self.n += 1
+        return f"r{self.n}"
+
+    def scale_down(self, reason: str = "manual", timeout=None) -> str:
+        self.calls.append(("down", reason))
+        self.n -= 1
+        return "r0"
+
+    def replace(self, name: str, reason: str = "dead_replica") -> str:
+        self.calls.append(("replace", name))
+        if self.fail_replace:
+            raise RuntimeError("respawn exploded")
+        self.dead.remove(name)
+        return f"{name}bis"
+
+
+def _controller(fleet, clk, **cfg_kw):
+    """Controller + its private registry's fleet.queue_depth gauge."""
+    reg = MetricsRegistry()
+    gauge = reg.gauge("fleet.queue_depth")
+    defaults = dict(
+        min_replicas=1, max_replicas=3, cooldown_s=60.0, quiet_for_s=120.0,
+        eval_every_s=1.0, breach_evals=2, slo_queue_depth=8.0,
+    )
+    defaults.update(cfg_kw)
+    ctl = FleetController(
+        fleet, registry=reg, config=ControllerConfig(**defaults), clock=clk
+    )
+    return ctl, gauge
+
+
+# ---------------------------------------------------------------------------
+# autoscaler decision policy (fake clock, stub fleet)
+# ---------------------------------------------------------------------------
+
+
+def test_sustained_breach_scales_up_once():
+    fleet, clk = FakeFleet(replicas=1), FakeClock()
+    ctl, gauge = _controller(fleet, clk)
+    gauge.set(20)  # over slo_queue_depth=8
+    assert ctl.step() == []  # one breach is a blip, not a capacity problem
+    clk.advance(1.0)
+    out = ctl.step()  # second consecutive breach: sustained
+    assert [d["action"] for d in out] == ["up"]
+    assert out[0]["reason"] == "fleet_queue_depth"
+    assert out[0]["spawned"] == "r2"
+    assert fleet.n == 2
+    assert [d["action"] for d in ctl.decision_log()] == ["up"]
+
+
+def test_cooldown_suppresses_then_rearms():
+    fleet, clk = FakeFleet(replicas=1), FakeClock()
+    ctl, gauge = _controller(fleet, clk)
+    gauge.set(20)
+    ctl.step()
+    clk.advance(1.0)
+    assert [d["action"] for d in ctl.step()] == ["up"]
+    # still breaching, but the last decision is settling: no decision
+    for _ in range(5):
+        clk.advance(1.0)
+        assert ctl.step() == []
+    assert fleet.n == 2
+    clk.advance(60.0)  # past cooldown_s
+    out = ctl.step()
+    assert [d["action"] for d in out] == ["up"] and fleet.n == 3
+
+
+def test_breach_at_max_replicas_records_hold():
+    fleet, clk = FakeFleet(replicas=3), FakeClock()
+    ctl, gauge = _controller(fleet, clk, max_replicas=3)
+    gauge.set(20)
+    ctl.step()
+    clk.advance(1.0)
+    out = ctl.step()
+    assert [d["action"] for d in out] == ["hold"]
+    assert out[0]["bound"] == "max_replicas"
+    assert fleet.n == 3 and fleet.calls == []  # suppressed, counted, no spawn
+
+
+def test_quiet_fleet_scales_down_to_min_and_stops():
+    fleet, clk = FakeFleet(replicas=3, load=0), FakeClock()
+    ctl, gauge = _controller(fleet, clk, min_replicas=2)
+    gauge.set(0)
+    ctl.step()  # starts the quiet timer
+    clk.advance(119.0)
+    assert ctl.step() == []  # not quiet for long enough yet
+    clk.advance(1.0)
+    out = ctl.step()
+    assert [d["action"] for d in out] == ["down"] and fleet.n == 2
+    # at min_replicas now: quiet forever, never goes below the floor
+    clk.advance(500.0)
+    assert ctl.step() == []
+    assert fleet.n == 2
+
+
+def test_load_resets_quiet_timer():
+    fleet, clk = FakeFleet(replicas=2, load=0), FakeClock()
+    ctl, gauge = _controller(fleet, clk)
+    ctl.step()
+    clk.advance(100.0)
+    fleet.load = 5  # traffic returns mid-countdown
+    assert ctl.step() == []
+    fleet.load = 0
+    clk.advance(119.0)
+    assert ctl.step() == []  # timer restarts HERE: quiet counted from now
+    clk.advance(119.0)
+    assert ctl.step() == []  # 119s since restart, needs 120
+    clk.advance(2.0)
+    assert [d["action"] for d in ctl.step()] == ["down"]
+
+
+def test_dead_replica_replaced_even_during_cooldown():
+    fleet, clk = FakeFleet(replicas=2), FakeClock()
+    ctl, gauge = _controller(fleet, clk)
+    gauge.set(20)
+    ctl.step()
+    clk.advance(1.0)
+    assert [d["action"] for d in ctl.step()] == ["up"]  # starts cooldown
+    fleet.dead = ["r1"]  # replica dies while the scale-up settles
+    clk.advance(1.0)
+    out = ctl.step()
+    assert ("replace", "r1") in fleet.calls
+    actions = [d["action"] for d in out]
+    assert "replace" in actions  # capacity restoration is never rate-limited
+    assert out[actions.index("replace")]["dead"] == "r1"
+
+
+def test_scale_failures_become_decisions_not_crashes():
+    fleet, clk = FakeFleet(replicas=1), FakeClock()
+    fleet.fail_scale_up = True
+    ctl, gauge = _controller(fleet, clk)
+    gauge.set(20)
+    ctl.step()
+    clk.advance(1.0)
+    out = ctl.step()
+    assert [d["action"] for d in out] == ["up_failed"]
+    assert "spawn exploded" in out[0]["error"]
+    fleet2, clk2 = FakeFleet(replicas=2), FakeClock()
+    fleet2.fail_replace = True
+    fleet2.dead = ["r9"]
+    ctl2, _ = _controller(fleet2, clk2)
+    out2 = ctl2.step()
+    assert [d["action"] for d in out2] == ["replace_failed"]
+
+
+def test_decisions_are_flight_events(tmp_path):
+    from hydragnn_tpu.obs import FlightRecorder
+    from hydragnn_tpu.obs.flight import read_flight_record, validate_flight_record
+
+    path = str(tmp_path / "fleet_flight.jsonl")
+    flight = FlightRecorder(path)
+    fleet, clk = FakeFleet(replicas=1), FakeClock()
+    reg = MetricsRegistry()
+    reg.gauge("fleet.queue_depth").set(20)
+    ctl = FleetController(
+        fleet,
+        registry=reg,
+        config=ControllerConfig(
+            min_replicas=1, max_replicas=2, cooldown_s=0.0, quiet_for_s=1e9,
+            breach_evals=1, slo_queue_depth=8.0,
+        ),
+        flight=flight,
+        clock=clk,
+    )
+    ctl.step()
+    flight.close()
+    events = read_flight_record(path)
+    scale = [e for e in events if e.get("kind") == "fleet_scale"]
+    assert len(scale) == 1
+    assert scale[0]["action"] == "up" and scale[0]["replicas"] == 2
+    assert validate_flight_record(events) == []
+
+
+# ---------------------------------------------------------------------------
+# router admission (stub replicas)
+# ---------------------------------------------------------------------------
+
+
+class FakeReplica:
+    def __init__(self, name: str, model: str = "m", load: int = 0):
+        self.name = name
+        self.model = model
+        self._load = load
+        self.ready = True
+        self.live = True
+        self.submitted: list = []
+        self.fail_with = None
+
+    def load(self) -> int:
+        return self._load
+
+    def queue_depth(self) -> int:
+        return self._load
+
+    def submit(self, sample) -> Future:
+        fut: Future = Future()
+        self.submitted.append((sample, fut))
+        if self.fail_with is not None:
+            fut.set_exception(self.fail_with)
+        return fut
+
+
+def _router(**kw):
+    reg = MetricsRegistry()
+    return FleetRouter(reg, **kw), reg
+
+
+def test_quota_rejection_is_typed_with_tenant_in_trace():
+    clk = FakeClock()
+    router, reg = _router(clock=clk)
+    router.attach(FakeReplica("r0"))
+    router.set_quota("acme", TenantQuota(rate=1e-9, burst=1.0))
+    fut = router.submit("s0", tenant="acme")  # burns the only token
+    with pytest.raises(TenantOverloaded) as ei:
+        router.submit("s1", tenant="acme")
+    assert ei.value.tenant == "acme"
+    assert ei.value.trace_id  # attributable end to end
+    assert reg.get("fleet.rejected_quota").value == 1
+    assert reg.get("fleet.tenant.acme.rejected").value == 1
+    # the admission trace carries the tenant and the reject span
+    rejected = [
+        t for t in router.traces()
+        if t.attrs.get("tenant") == "acme"
+        and any(s["name"] == "fleet.reject" for s in t.spans)
+    ]
+    assert rejected and rejected[0].trace_id == ei.value.trace_id
+    # an unrelated tenant is not throttled by acme's bucket
+    router.submit("s2", tenant="other")
+    assert len(router.replicas()[0].submitted) == 2
+    fut.cancel()
+
+
+def test_shed_gate_drops_batch_priority_only():
+    router, _ = _router(config=RouterConfig(shed_load=1))
+    busy = FakeReplica("r0", load=5)
+    router.attach(busy)
+    router.set_quota("bulk", TenantQuota(priority="batch"))
+    with pytest.raises(TenantOverloaded):
+        router.submit("s", tenant="bulk")
+    router.submit("s", tenant="interactive")  # standard priority rides through
+    assert len(busy.submitted) == 1
+
+
+def test_least_loaded_ready_replica_wins():
+    router, _ = _router()
+    heavy = FakeReplica("r0", load=5)
+    light = FakeReplica("r1", load=1)
+    router.attach(heavy)
+    router.attach(light)
+    router.submit("s")
+    assert len(light.submitted) == 1 and heavy.submitted == []
+    # paused replicas leave placement without detaching
+    router.pause("r1")
+    router.submit("s2")
+    assert len(heavy.submitted) == 1
+    router.resume("r1")
+    # not-ready replicas are skipped too
+    heavy.ready = False
+    router.submit("s3")
+    assert len(light.submitted) == 2
+
+
+def test_no_ready_replica_is_typed_overloaded():
+    router, reg = _router()
+    fut = router.submit("s")
+    with pytest.raises(Overloaded):
+        fut.result(timeout=5)
+    assert reg.get("fleet.rejected_no_replica").value == 1
+
+
+def test_replica_death_retries_on_another_replica():
+    router, reg = _router()
+    dying = FakeReplica("r0", load=0)
+    dying.fail_with = RequestFailed("dispatch died", reason="dispatch")
+    healthy = FakeReplica("r1", load=3)  # heavier, so the dying one is picked
+    router.attach(dying)
+    router.attach(healthy)
+    fut = router.submit("s")
+    assert len(dying.submitted) == 1 and len(healthy.submitted) == 1
+    healthy.submitted[0][1].set_result({"e": 1.0})
+    assert fut.result(timeout=5) == {"e": 1.0}
+    assert reg.get("fleet.death_retries").value == 1
+    assert reg.get("fleet.failed").value == 0
+    # a non-death failure (poison request) is NOT retried: same answer
+    # everywhere, so the typed error surfaces immediately
+    healthy.fail_with = RequestFailed("nan", reason="nonfinite")
+    dying.ready = False
+    fut2 = router.submit("s2")
+    with pytest.raises(RequestFailed):
+        fut2.result(timeout=5)
+    assert reg.get("fleet.death_retries").value == 1
+
+
+# ---------------------------------------------------------------------------
+# real fleet integration (smoke-sized, shared exec cache)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def flagship():
+    from hydragnn_tpu.flagship import build_flagship
+
+    _, model, variables, loader = build_flagship(
+        n_samples=24,
+        hidden_dim=8,
+        num_conv_layers=2,
+        batch_size=4,
+        unit_cells=(2, 3),
+    )
+    registry = ModelRegistry()
+    served = registry.register("fleet_smoke", model, variables)
+    return served, variables, list(loader.all_samples)
+
+
+@pytest.fixture(scope="module")
+def exec_cache(tmp_path_factory):
+    return str(tmp_path_factory.mktemp("fleet_exec_cache"))
+
+
+def _serve_cfg():
+    return ServeConfig(max_batch=4, num_buckets=2, max_delay_ms=2.0)
+
+
+def test_fleet_second_replica_warm_starts_and_serves(flagship, exec_cache, tmp_path):
+    served, _, samples = flagship
+    with Fleet(exec_cache_dir=exec_cache) as fleet:
+        reps = fleet.add_model("m", served, samples, _serve_cfg(), replicas=2)
+        snap = reps[1].server.metrics_snapshot()
+        assert snap["compile_warmup"] == 0, (
+            "second replica paid AOT compiles despite the shared exec cache"
+        )
+        assert snap["exec_cache_hits"] > 0
+        out = fleet.predict(samples[0], timeout=60)
+        assert isinstance(out, dict) and out
+        h = fleet.health()
+        assert h["replica_count"] == 2 and h["ready_count"] == 2
+        # probe aggregation over the exported textfiles
+        import sys
+
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+        try:
+            from serve_probe import probe_fleet
+        finally:
+            sys.path.pop(0)
+        probe_dir = str(tmp_path / "probes")
+        fleet.export_probes(probe_dir)
+        rc, rows = probe_fleet(probe_dir)
+        assert rc == 0, rows
+        assert {name for name, _, _ in rows} == {"router", "r0", "r1"}
+
+
+def test_kill_then_controller_restores_capacity(flagship, exec_cache):
+    served, _, samples = flagship
+    with Fleet(exec_cache_dir=exec_cache) as fleet:
+        fleet.add_model("m", served, samples, _serve_cfg(), replicas=2)
+        victim = fleet.replicas()[0]
+        victim.kill()
+        assert fleet.dead_replicas() == [victim.name]
+        ctl = FleetController(
+            fleet,
+            registry=fleet.registry,
+            config=ControllerConfig(min_replicas=1, max_replicas=3),
+        )
+        out = ctl.step()
+        assert [d["action"] for d in out] == ["replace"]
+        assert fleet.dead_replicas() == []
+        assert fleet.replica_count() == 2
+        replacement = [r for r in fleet.replicas() if r.name != victim.name]
+        assert all(r.ready for r in replacement)
+        # the replacement warm-started from the shared cache
+        assert all(
+            r.server.metrics_snapshot()["compile_warmup"] == 0
+            for r in replacement
+        )
+        assert isinstance(fleet.predict(samples[1], timeout=60), dict)
+
+
+def test_rolling_reload_is_bit_identical_for_same_weights(flagship, exec_cache):
+    served, variables, samples = flagship
+    with Fleet(exec_cache_dir=exec_cache) as fleet:
+        fleet.add_model("m", served, samples, _serve_cfg(), replicas=2)
+        before = fleet.predict(samples[0], timeout=60)
+        outcomes = fleet.rolling_reload("m", variables=variables)
+        assert [o["ok"] for o in outcomes] == [True, True]
+        assert all(r.ready for r in fleet.replicas())
+        after = fleet.predict(samples[0], timeout=60)
+        assert sorted(before) == sorted(after)
+        for key in before:
+            np.testing.assert_array_equal(
+                np.asarray(before[key]), np.asarray(after[key])
+            )

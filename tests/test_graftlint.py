@@ -572,6 +572,50 @@ class TestArtifacts:
         )
         assert [f.message for f in findings] == ["flight artifact missing"]
 
+    def test_failed_bench_attempt_must_be_structured(self, tmp_path):
+        # rc != 0 with only a raw traceback tail is NOT a valid failed
+        # run record — it must carry status/retries/failure
+        art = tmp_path / "BENCH_r99.json"
+        bare = {"n": 9, "cmd": "python bench.py", "rc": 1, "tail": "boom",
+                "parsed": None}
+        art.write_text(json.dumps(bare))
+        findings = ARTIFACTS.validate_artifacts(REPO_ROOT, [str(art)])
+        msgs = " ".join(f.message for f in findings)
+        assert "status" in msgs and "retries" in msgs and "failure" in msgs
+        structured = dict(
+            bare,
+            status="failed",
+            retries=2,
+            failure={"stage": "backend_init", "error_type": "RuntimeError",
+                     "error": "UNAVAILABLE"},
+        )
+        art.write_text(json.dumps(structured))
+        assert ARTIFACTS.validate_artifacts(REPO_ROOT, [str(art)]) == []
+        # a wrong status string on a failed attempt is a finding too
+        art.write_text(json.dumps(dict(structured, status="ok")))
+        findings = ARTIFACTS.validate_artifacts(REPO_ROOT, [str(art)])
+        assert any("expected 'failed'" in f.message for f in findings)
+
+    def test_fleet_record_requires_every_chaos_scenario(self, tmp_path):
+        art = tmp_path / "BENCH_FLEET.json"
+        record = {
+            "metric": "fleet_sustained_qps", "value": 100.0,
+            "unit": "graphs/sec", "replicas": 2, "qps_n1": 60.0,
+            "qps_n2": 100.0, "scaleout_efficiency": 0.83,
+            "warm_replica_aot_compiles": 0, "lost_futures": 0,
+            "slo_p99_ms": 3000.0, "failures": [],
+            "scenarios": {
+                name: {"qps": 1.0}
+                for name in ARTIFACTS._FLEET_SCENARIOS
+            },
+        }
+        art.write_text(json.dumps(record))
+        assert ARTIFACTS.validate_artifacts(REPO_ROOT, [str(art)]) == []
+        del record["scenarios"]["replica_kill"]
+        art.write_text(json.dumps(record))
+        findings = ARTIFACTS.validate_artifacts(REPO_ROOT, [str(art)])
+        assert any("replica_kill" in f.message for f in findings)
+
 
 # ----------------------------------------------------------------- CLI
 

@@ -22,6 +22,19 @@ Exit codes:
 
 ``--verbose`` prints what was decided and why (probes are run by
 machines, so the default is silent).
+
+Fleet mode (``hydragnn_tpu/fleet``, docs/FLEET.md): ``--fleet DIR``
+probes every replica textfile plus the router's in the directory
+``Fleet.export_probes`` writes (``r*.prom`` + ``router.prom``), prints
+a one-line-per-replica table, and aggregates:
+
+    python tools/serve_probe.py --fleet /run/fleet/
+
+    0  router serving and EVERY replica healthy
+    1  degraded-but-serving: the router still routes (>=1 ready
+       replica) but some replica is down, not ready, or stale
+    2  fleet down: the router reports not-ready, its file is
+       missing/stale, or there are no replica files at all
 """
 
 from __future__ import annotations
@@ -68,13 +81,58 @@ def probe(path: str, mode: str = "ready", max_age_s: float = 60.0):
     return 1, f"{gauge}={value:g} — server reports not {mode}"
 
 
+ROUTER_FILE = "router.prom"
+
+
+def probe_fleet(directory: str, mode: str = "ready", max_age_s: float = 60.0):
+    """Probe every ``*.prom`` in ``directory`` (``router.prom`` is the
+    router, the rest are replicas). Returns ``(exit_code, rows)`` with
+    one ``(name, rc, msg)`` row per file probed, router first."""
+    try:
+        names = sorted(
+            f for f in os.listdir(directory) if f.endswith(".prom")
+        )
+    except OSError as exc:
+        return 2, [("router", 2, f"no fleet probe dir {directory!r} "
+                    f"({exc.__class__.__name__})")]
+    rows = []
+    router_rc = 2
+    if ROUTER_FILE in names:
+        names.remove(ROUTER_FILE)
+        router_rc, msg = probe(
+            os.path.join(directory, ROUTER_FILE), mode=mode, max_age_s=max_age_s
+        )
+        rows.append(("router", router_rc, msg))
+    else:
+        rows.append(("router", 2, f"no {ROUTER_FILE} in {directory!r}"))
+    replica_rcs = []
+    for name in names:
+        rc, msg = probe(
+            os.path.join(directory, name), mode=mode, max_age_s=max_age_s
+        )
+        rows.append((name[: -len(".prom")], rc, msg))
+        replica_rcs.append(rc)
+    if router_rc != 0 or not replica_rcs:
+        return 2, rows
+    if all(rc == 0 for rc in replica_rcs):
+        return 0, rows
+    return 1, rows
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
-    p.add_argument(
+    src = p.add_mutually_exclusive_group(required=True)
+    src.add_argument(
         "--prom",
-        required=True,
         help="Prometheus textfile the server exports "
         "(ServeConfig.prometheus_path / ModelServer.export_prometheus)",
+    )
+    src.add_argument(
+        "--fleet",
+        metavar="DIR",
+        help="probe a whole fleet: the directory Fleet.export_probes "
+        "writes (r*.prom per replica + router.prom); aggregate exit "
+        "0 all healthy / 1 degraded-but-serving / 2 fleet down",
     )
     g = p.add_mutually_exclusive_group()
     g.add_argument(
@@ -97,6 +155,16 @@ def main(argv=None) -> int:
     p.add_argument("--verbose", action="store_true", help="print the verdict")
     args = p.parse_args(argv)
     mode = "live" if args.live else "ready"
+    if args.fleet:
+        rc, rows = probe_fleet(args.fleet, mode=mode, max_age_s=args.max_age)
+        width = max(len(name) for name, _, _ in rows)
+        for name, row_rc, msg in rows:
+            verdict = {0: "ok", 1: "not-" + mode}.get(row_rc, "no-evidence")
+            print(f"{name:<{width}}  {verdict:<11}  {msg}")
+        label = {0: "healthy", 1: "degraded-but-serving", 2: "fleet down"}[rc]
+        if args.verbose or rc != 0:
+            print(f"serve_probe[fleet/{mode}]: {label}", file=sys.stderr)
+        return rc
     rc, msg = probe(args.prom, mode=mode, max_age_s=args.max_age)
     if args.verbose or rc != 0:
         print(f"serve_probe[{mode}]: {msg}", file=sys.stderr)
