@@ -177,6 +177,10 @@ def main() -> None:
         "occupancy_mean": occ,
         "queue_depth_peak": snap["queue_depth_peak"],
         "rejected_overload": snap["rejected_overload"],
+        # whether drift monitoring / the request spool was armed for
+        # this bench (obs_report --validate surfaces the same from the
+        # flight manifest)
+        "observability": server.obs_arming,
         "errors": errors[:3],
     }
     # server.stop() already logged its run_end (metrics snapshot); the
@@ -266,6 +270,7 @@ def cold_warm() -> None:
             "exec_cache_hits": snap["exec_cache_hits"],
             "exec_cache_misses": snap["exec_cache_misses"],
             "exec_cache_miss_reasons": snap["exec_cache_miss_reasons"],
+            "observability": server.obs_arming,
         }
 
     cold = one_start("cold")
@@ -481,6 +486,7 @@ def chaos() -> None:
             "reload_failed", "errors", "compile_misses",
         )},
         "flight_counts": fcounts,
+        "observability": server.obs_arming,
         "failures": failures,
     }
     flight.record("bench_result", record=record, passed=not failures)
@@ -746,6 +752,11 @@ def fleet_chaos() -> None:
         failures.append("rolling_reload: fleet not READY at end")
     scenarios["rolling_reload"] = reload_stats
 
+    # every replica shares one ServeConfig, so one replica's arming
+    # blocks describe the whole fleet's drift-observability posture
+    reps = fleet.replicas()
+    obs_arming = reps[0].server.obs_arming if reps else None
+
     health = fleet.health()
     fleet.stop()
 
@@ -772,6 +783,7 @@ def fleet_chaos() -> None:
             k: health[k] for k in ("replica_count", "ready_count", "live_count")
         },
         "scenarios": scenarios,
+        "observability": obs_arming,
         "failures": failures,
     }
     flight.record("bench_result", record=record, passed=not failures)

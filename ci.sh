@@ -1110,6 +1110,227 @@ grep -q "exec_cache: evicted entry" "$EXEC_DIR/corrupt.err" || {
 }
 rm -rf "$EXEC_DIR"
 
+echo "== drift smoke (request spool + drift plane: clean traffic -> zero incidents + bounded spool overhead; injected covariate shift -> one validated feature_drift bundle) =="
+DRIFT_DIR="$(mktemp -d)"
+# --- train the reference: run_training stamps the per-channel stats
+#     block (moments, quantiles, histogram fractions) into its flight
+#     manifest — that flight IS the drift_ref a server arms against
+JAX_PLATFORMS=cpu python - "$DRIFT_DIR/train" <<'EOF'
+import glob
+import sys
+
+from hydragnn_tpu.api import run_training
+from hydragnn_tpu.data.synthetic import deterministic_graph_data
+from hydragnn_tpu.flagship import flagship_config
+from hydragnn_tpu.obs.drift import load_reference
+
+out = sys.argv[1]
+cfg = flagship_config(hidden_dim=8, num_conv_layers=2, batch_size=5, num_epoch=2)
+samples = deterministic_graph_data(
+    number_configurations=24,
+    unit_cell_x_range=(2, 3),
+    unit_cell_y_range=(2, 3),
+    unit_cell_z_range=(2, 3),
+    seed=0,
+)
+run_training(cfg, samples=samples, log_dir=out + "/logs/")
+flight = glob.glob(out + "/logs/*/flight.jsonl")[0]
+ref = load_reference(flight)  # raises if the stats block is absent/invalid
+assert ref["num_rows"] > 0 and ref["feature"]["channels"], ref.keys()
+print(f"drift smoke (train ref): OK ({ref['num_rows']} reference rows)")
+EOF
+DRIFT_REF="$(ls "$DRIFT_DIR"/train/logs/*/flight.jsonl)"
+# --- clean serve: spool + drift armed against the training reference.
+#     In-distribution traffic must produce ZERO incidents, a run_end
+#     spool block with its measured overhead fraction, and shards that
+#     reload bit-compatibly through the training batcher (the retrain
+#     contract). The smoke's wall time is ~1 s, so the overhead gate is
+#     a sanity bound, not a production SLO.
+JAX_PLATFORMS=cpu python - "$DRIFT_DIR" "$DRIFT_DIR/train" "$DRIFT_REF" <<'EOF'
+import os
+import sys
+
+import numpy as np
+
+out, ckpt, ref_path = sys.argv[1], sys.argv[2], sys.argv[3]
+
+from hydragnn_tpu.api import prepare_loaders_and_config, serve_model
+from hydragnn_tpu.data.synthetic import deterministic_graph_data
+from hydragnn_tpu.flagship import flagship_config
+from hydragnn_tpu.graph.batch import batch_graphs
+from hydragnn_tpu.obs import FlightRecorder, read_flight_record
+from hydragnn_tpu.obs.spool import list_shards, read_shard_manifest, read_spool
+from hydragnn_tpu.obs.triggers import list_incidents
+from hydragnn_tpu.serve import ServeConfig
+from hydragnn_tpu.serve.server import request_to_dict
+
+
+def cfg():
+    return flagship_config(hidden_dim=8, num_conv_layers=2, batch_size=5, num_epoch=2)
+
+
+def data():
+    return deterministic_graph_data(
+        number_configurations=24,
+        unit_cell_x_range=(2, 3),
+        unit_cell_y_range=(2, 3),
+        unit_cell_z_range=(2, 3),
+        seed=0,
+    )
+
+
+flight = FlightRecorder(out + "/clean_flight.jsonl")
+server = serve_model(
+    cfg(),
+    samples=data(),
+    log_dir=ckpt + "/logs/",
+    serve_config=ServeConfig(
+        max_batch=4,
+        max_delay_ms=5.0,
+        incident_dir=out + "/clean_incidents",
+        spool=True,
+        spool_sample=2,
+        spool_shard_mb=0.05,
+        spool_dir=out + "/spool",
+        drift_ref=ref_path,
+        drift_min_count=16,
+    ),
+    flight=flight,
+)
+train_loader, _, _, _ = prepare_loaders_and_config(cfg(), data())
+reqs = list(train_loader.all_samples) * 2  # in-distribution traffic
+for s in reqs:
+    server.predict(s, timeout=120)
+server.stop()
+assert list_incidents(out + "/clean_incidents") == [], "clean traffic drifted?"
+ev = read_flight_record(out + "/clean_flight.jsonl")
+start = next(e for e in ev if e.get("kind") == "run_start")
+man = start["manifest"]
+assert man["spool"]["enabled"] and man["drift"]["armed"], man
+end = [e for e in ev if e.get("kind") == "run_end"][-1]
+sp, dr = end["spool"], end["drift"]
+assert sp["spooled"] >= len(reqs) // 2, sp
+assert 0.0 <= sp["overhead_frac"] < 0.05, f"spool overhead over 5%: {sp}"
+assert dr["feature_rows"] > 0 and dr["feature_psi_max"] < 0.25, dr
+# the spooled window reloads through the training batcher: same node
+# payload (f32) and identical edge_occupancy as the original requests
+shards = list_shards(out + "/spool")
+assert shards, "clean serve spooled nothing"
+mans = [read_shard_manifest(s) for s in shards]
+assert sum(m["num_samples"] for m in mans) == sp["spooled"], (mans, sp)
+back = sorted(read_spool(out + "/spool"), key=lambda s: s.meta["spool"]["seq"])
+seqs = [s.meta["spool"]["seq"] for s in back]
+orig = [reqs[i] for i in seqs]
+want = batch_graphs([request_to_dict(s) for s in orig])
+got = batch_graphs([request_to_dict(s) for s in back])
+assert int(want.edge_occupancy) == int(got.edge_occupancy)
+np.testing.assert_array_equal(
+    np.asarray(want.nodes), np.asarray(got.nodes)
+)
+print(
+    f"drift smoke (clean serve): OK (0 incidents, {sp['spooled']} spooled, "
+    f"overhead_frac={sp['overhead_frac']}, feature_psi_max={dr['feature_psi_max']})"
+)
+EOF
+# --- injected covariate shift: every admitted request's node features
+#     move by +5.0; the feature_drift rule must open exactly ONE
+#     incident whose bundle carries a schema-valid drift_report.json
+#     and the spool window holding the offending traffic
+JAX_PLATFORMS=cpu HYDRAGNN_INJECT_DRIFT=5.0 \
+    python - "$DRIFT_DIR" "$DRIFT_DIR/train" "$DRIFT_REF" <<'EOF'
+import json
+import os
+import sys
+
+out, ckpt, ref_path = sys.argv[1], sys.argv[2], sys.argv[3]
+
+from hydragnn_tpu.api import prepare_loaders_and_config, serve_model
+from hydragnn_tpu.data.synthetic import deterministic_graph_data
+from hydragnn_tpu.flagship import flagship_config
+from hydragnn_tpu.obs import FlightRecorder, read_flight_record
+from hydragnn_tpu.obs.drift import validate_drift_report
+from hydragnn_tpu.obs.triggers import list_incidents, validate_incident_bundle
+from hydragnn_tpu.serve import ServeConfig
+
+
+def cfg():
+    return flagship_config(hidden_dim=8, num_conv_layers=2, batch_size=5, num_epoch=2)
+
+
+def data():
+    return deterministic_graph_data(
+        number_configurations=24,
+        unit_cell_x_range=(2, 3),
+        unit_cell_y_range=(2, 3),
+        unit_cell_z_range=(2, 3),
+        seed=0,
+    )
+
+
+flight = FlightRecorder(out + "/shift_flight.jsonl")
+server = serve_model(
+    cfg(),
+    samples=data(),
+    log_dir=ckpt + "/logs/",
+    serve_config=ServeConfig(
+        max_batch=4,
+        max_delay_ms=5.0,
+        incident_dir=out + "/shift_incidents",
+        spool=True,
+        spool_sample=2,
+        spool_shard_mb=0.05,
+        spool_dir=out + "/shift_spool",
+        drift_ref=ref_path,
+        drift_min_count=16,
+        trigger_eval_every_s=0.05,
+    ),
+    flight=flight,
+)
+train_loader, _, _, _ = prepare_loaders_and_config(cfg(), data())
+for s in list(train_loader.all_samples) * 2:
+    server.predict(s, timeout=120)
+server.stop()
+bundles = list_incidents(out + "/shift_incidents")
+assert len(bundles) == 1, f"expected exactly one drift incident, got {bundles}"
+problems = validate_incident_bundle(bundles[0])
+assert not problems, problems
+with open(os.path.join(bundles[0], "incident_manifest.json")) as f:
+    man = json.load(f)
+assert man["rule"] == "serve_feature_drift", man
+assert man["trigger"]["kind"] == "feature_drift", man["trigger"]
+report_path = os.path.join(bundles[0], "drift_report.json")
+with open(report_path) as f:
+    report = json.load(f)
+assert validate_drift_report(report) == [], validate_drift_report(report)
+assert report["feature"]["psi_max"] > 0.25, report["feature"]
+assert (report.get("spool_window") or {}).get("dir"), report.get("spool_window")
+ev = read_flight_record(out + "/shift_flight.jsonl")
+drift_ev = [e for e in ev if e.get("kind") == "drift"]
+assert len(drift_ev) == 1 and drift_ev[0]["rule_kind"] == "feature_drift", drift_ev
+print(
+    "drift smoke (injected shift): OK (one bundle, "
+    f"observed psi={drift_ev[0]['observed']:.3f} > {drift_ev[0]['threshold']})"
+)
+EOF
+# the artifacts pass the lint gate and every reader renders/validates them
+python tools/graftlint.py --artifacts \
+    "$DRIFT_DIR"/shift_incidents/*/incident_manifest.json \
+    "$DRIFT_DIR"/shift_incidents/*/drift_report.json \
+    "$DRIFT_DIR"/spool/*/spool_manifest.json
+python tools/incident_report.py --validate "$DRIFT_DIR/shift_incidents"
+python tools/drift_report.py --validate \
+    "$DRIFT_REF" "$DRIFT_DIR/clean_flight.jsonl" "$DRIFT_DIR/spool" \
+    "$DRIFT_DIR"/shift_incidents/*/drift_report.json
+python tools/drift_report.py --no-trend \
+    "$DRIFT_DIR/shift_flight.jsonl" "$DRIFT_DIR/spool" \
+    "$DRIFT_DIR"/shift_incidents/*/drift_report.json \
+    | tee "$DRIFT_DIR/report.out"
+grep -q "breaches: 1" "$DRIFT_DIR/report.out" || {
+    echo "FAIL: drift_report.py did not render the breach"; exit 1; }
+# the breach appears in the fault timeline (and the record validates)
+python tools/obs_report.py --faults "$DRIFT_DIR/shift_flight.jsonl"
+rm -rf "$DRIFT_DIR"
+
 echo "== perf gate (tiny fixed-config bench vs committed baseline) =="
 # fails on a >15% graphs/sec regression (and MFU regression on TPU)
 # against BENCH_CI_BASELINE.json, keyed per backend:device so every CI

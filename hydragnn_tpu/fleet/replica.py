@@ -71,9 +71,11 @@ class FleetReplica:
 
     # -- request path (router only) ----------------------------------------
 
-    def submit(self, sample: Any, seq: int = -1) -> Future:
+    def submit(self, sample: Any, seq: int = -1, tenant: str = "default") -> Future:
         """Admit one request on this replica's server, counting it
-        in-flight until its future resolves (the drain barrier)."""
+        in-flight until its future resolves (the drain barrier).
+        ``tenant`` flows through to the server's request spool so
+        per-tenant traffic stays attributable in the spooled shards."""
         with self._lock:
             if self._draining or self._stopped:
                 raise ServerClosed(
@@ -82,7 +84,7 @@ class FleetReplica:
                 )
             self._inflight += 1
         try:
-            fut = self.server.submit(sample)
+            fut = self.server.submit(sample, tenant=tenant)
         except BaseException:
             self._dec_inflight()
             raise

@@ -318,7 +318,9 @@ class FleetRouter:
         if trace is not None:
             trace.mark("fleet.admit", replica=replica.name)
         try:
-            inner = replica.submit(sample)
+            # tenant rides to the replica's server so spooled requests
+            # stay attributable per tenant (obs/spool.py)
+            inner = replica.submit(sample, tenant=tenant)
         except (Overloaded, ServerClosed) as exc:
             if retries_left > 0:
                 self._death_retries.inc()

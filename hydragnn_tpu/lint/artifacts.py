@@ -247,6 +247,9 @@ _INCIDENT_RULE_KINDS = (
     "latency_p99",
     "queue_depth",
     "queue_age",
+    "feature_drift",
+    "pred_drift",
+    "error_drift",
     "mfu_drop",
     "loss_spike",
     "nonfinite_burst",
@@ -302,12 +305,68 @@ MACHINE_SCHEMAS: Dict[str, Tuple[str, Callable[[Any], List[str]]]] = {
     "BENCH_FLEET.json": ("fleet chaos acceptance record", _check_fleet),
 }
 
+def _check_drift_report(data: Any) -> List[str]:
+    """Drift report sidecar an incident bundle carries for the drift
+    rule kinds (obs/drift.py:DriftMonitor.report()); the richer
+    ``validate_drift_report`` lives there — this duplicates the fields
+    downstream tools read so the linter stays package-free."""
+    problems = _require(
+        data,
+        {"schema": (int,), "counts": (dict,), "feature": (dict,),
+         "heads": (dict,), "error": (dict,)},
+    )
+    if problems:
+        return problems
+    if data["schema"] != 1:
+        problems.append(f"unsupported drift report schema {data['schema']!r}")
+    problems += [
+        f"counts.{p}" for p in _require(
+            data["counts"],
+            {"feature_rows": _NUM, "pred_rows": _NUM, "labeled_rows": _NUM},
+        )
+    ]
+    problems += [
+        f"feature.{p}" for p in _require(
+            data["feature"],
+            {"psi_max": _NUM, "qshift_max": _NUM, "channels": (list,)},
+        )
+    ]
+    return problems
+
+
+def _check_spool_manifest(data: Any) -> List[str]:
+    """Per-shard manifest the request spool writes next to each HGC
+    shard (obs/spool.py); pins the fields drift_report / retraining
+    tooling read to pick a spool window."""
+    problems = _require(
+        data,
+        {"schema": (int,), "shard": (str,), "num_samples": (int,),
+         "model_fingerprint": (str,), "sample_every": (int,),
+         "tenants": (list,), "seq_range": (list,), "t_range": (list,)},
+    )
+    if problems:
+        return problems
+    if data["schema"] != 1:
+        problems.append(f"unsupported spool manifest schema {data['schema']!r}")
+    if data["num_samples"] < 1:
+        problems.append("spool shard manifest with num_samples < 1")
+    if len(data["seq_range"]) != 2:
+        problems.append("seq_range must be a [first, last] pair")
+    return problems
+
+
 #: runtime-artifact kinds: produced by RUNS (never committed at the
 #: repo root), so they dispatch by name for explicit paths but are
 #: exempt from the zero-committed-matches scan above.
 RUNTIME_SCHEMAS: Dict[str, Tuple[str, Callable[[Any], List[str]]]] = {
     "incident_manifest.json": (
         "incident bundle manifest", _check_incident_manifest,
+    ),
+    "drift_report.json": (
+        "drift incident report", _check_drift_report,
+    ),
+    "spool_manifest.json": (
+        "request spool shard manifest", _check_spool_manifest,
     ),
 }
 

@@ -76,6 +76,21 @@ from hydragnn_tpu.obs.compile_monitor import (
     BACKEND_COMPILE_EVENT,
     CompileMonitor,
 )
+from hydragnn_tpu.obs.drift import (
+    DriftMonitor,
+    P2Quantile,
+    RunningMoments,
+    build_reference,
+    load_reference,
+    psi,
+    validate_drift_report,
+)
+from hydragnn_tpu.obs.spool import (
+    RequestSpool,
+    list_shards,
+    read_spool,
+    validate_spool_manifest,
+)
 from hydragnn_tpu.obs.export import (
     prometheus_name,
     registry_to_jsonl,
@@ -125,6 +140,17 @@ __all__ = [
     "validate_incident_manifest",
     "BACKEND_COMPILE_EVENT",
     "CompileMonitor",
+    "DriftMonitor",
+    "P2Quantile",
+    "RunningMoments",
+    "build_reference",
+    "load_reference",
+    "psi",
+    "validate_drift_report",
+    "RequestSpool",
+    "list_shards",
+    "read_spool",
+    "validate_spool_manifest",
     "prometheus_name",
     "registry_to_jsonl",
     "registry_to_prometheus",

@@ -95,6 +95,14 @@ _REQUIRED: Dict[str, tuple] = {
     # count) and every per-replica step of a fleet-wide rolling reload
     "fleet_scale": ("action", "reason", "replicas"),
     "fleet_reload": ("model", "replica", "ok"),
+    # served-traffic spool shard finalization (obs/spool.py): every
+    # rotation names the shard, its sample/byte footprint, and any
+    # LRU-evicted shards — the spool's disk-bound audit trail
+    "spool_rotate": ("shard", "samples", "total_bytes"),
+    # a drift trigger breached (obs/drift.py + the feature_drift /
+    # pred_drift / error_drift rule kinds): which rule, what the sketch
+    # observed vs the threshold, and where the offending spool window is
+    "drift": ("rule", "observed", "threshold"),
 }
 
 # the fault-history subset tools/obs_report.py --faults narrates
@@ -112,6 +120,7 @@ FAULT_KINDS = (
     "reload_failed",
     "incident",
     "lock_order",
+    "drift",
     "fleet_scale",
     "fleet_reload",
 )

@@ -15,6 +15,14 @@ Rule kinds (:data:`RULE_KINDS`):
   - ``latency_p99``   — registry histogram p99 over a threshold
   - ``queue_depth``   — registry gauge over a threshold
   - ``queue_age``     — registry gauge (oldest-request age) over threshold
+  - ``feature_drift`` — drift gauge (max per-channel PSI / quantile
+                        shift published by ``obs/drift.DriftMonitor``)
+                        over a threshold
+  - ``pred_drift``    — drift gauge (max per-head prediction PSI) over
+                        a threshold
+  - ``error_drift``   — drift gauge (max per-head MAE over the
+                        reference target scale, from labelled spool
+                        entries) over a threshold
   - ``mfu_drop``      — observed series falls below ``threshold`` x the
                         rolling median of the previous ``window`` values
   - ``loss_spike``    — observed series exceeds ``threshold`` x the
@@ -60,13 +68,29 @@ RULE_KINDS = (
     "latency_p99",
     "queue_depth",
     "queue_age",
+    "feature_drift",
+    "pred_drift",
+    "error_drift",
     "mfu_drop",
     "loss_spike",
     "nonfinite_burst",
 )
 
 #: which rule kinds read a registry metric (vs an observed series)
-_REGISTRY_KINDS = ("latency_p99", "queue_depth", "queue_age", "nonfinite_burst")
+_REGISTRY_KINDS = (
+    "latency_p99",
+    "queue_depth",
+    "queue_age",
+    "feature_drift",
+    "pred_drift",
+    "error_drift",
+    "nonfinite_burst",
+)
+
+#: drift kinds read a DriftMonitor-published gauge (obs/drift.py); the
+#: monitor keeps its gauges at 0.0 until its warm-up row count is met,
+#: so a plain over-threshold compare is safe from cold-start noise
+_DRIFT_KINDS = ("feature_drift", "pred_drift", "error_drift")
 
 INCIDENT_MANIFEST = "incident_manifest.json"
 INCIDENT_MANIFEST_VERSION = 1
@@ -200,6 +224,26 @@ class TriggerEngine:
                 return TriggerVerdict(
                     rule.name, rule.kind, rule.metric, round(v, 6),
                     rule.threshold, now,
+                )
+            return None
+        if rule.kind in _DRIFT_KINDS:
+            g = self.registry.get(rule.metric)
+            if g is None or not hasattr(g, "value"):
+                return None
+            v = float(g.value)
+            if v > rule.threshold:
+                # evidence: how many rows the sketch had folded in when
+                # it breached (the DriftMonitor publishes row-count
+                # gauges next to each distance gauge)
+                rows = {}
+                base = rule.metric.rsplit(".", 1)[0]
+                for key in ("feature_rows", "pred_rows", "labeled_rows"):
+                    rg = self.registry.get(f"{base}.{key}")
+                    if rg is not None and hasattr(rg, "value"):
+                        rows[key] = float(rg.value)
+                return TriggerVerdict(
+                    rule.name, rule.kind, rule.metric, round(v, 6),
+                    rule.threshold, now, detail=rows,
                 )
             return None
         if rule.kind == "nonfinite_burst":

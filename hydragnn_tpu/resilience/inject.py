@@ -59,6 +59,11 @@ poison hunt):
                                          candidate weights to NaN before the
                                          canary (the canary must fail and the
                                          old weights must keep serving)
+  HYDRAGNN_INJECT_DRIFT=SHIFT            add a deterministic covariate shift
+                                         of SHIFT (a float) to every incoming
+                                         request's node features at admission
+                                         (drives the feature_drift trigger +
+                                         spool path; obs/drift.py)
   =====================================  ======================================
 
 Step numbers are process-local dispatch counts (0-based, counted by
@@ -222,6 +227,19 @@ def injected_trigger(known_rules=None) -> Optional[str]:
         return None
     _TRIGGER_FIRED = True
     return spec
+
+
+def maybe_drift_shift(x):
+    """Return the request's node features with the injected covariate
+    shift applied (``x + SHIFT``), or unchanged when no drift is
+    injected. Deterministic: every admitted request shifts identically,
+    so the drift sketches see a clean mean/histogram displacement."""
+    spec = _spec("HYDRAGNN_INJECT_DRIFT")
+    if spec is None:
+        return x
+    import numpy as np
+
+    return np.asarray(x) + float(spec)
 
 
 def serve_torn_reload() -> bool:

@@ -47,6 +47,7 @@ class PendingRequest:
     bucket: int
     seq: int = -1  # server-wide admission sequence number
     trace: Any = None  # obs/trace.py RequestTrace (None when tracing off)
+    tenant: str = "default"  # admitting tenant (fleet router; spool attribution)
 
 
 class MicroBatchQueue:
@@ -77,7 +78,14 @@ class MicroBatchQueue:
         self._count = 0  # graftsync: guarded-by=batcher.MicroBatchQueue._cv
         self._closed = False  # graftsync: guarded-by=batcher.MicroBatchQueue._cv
 
-    def put(self, bucket: int, item: Any, seq: int = -1, trace: Any = None) -> Future:
+    def put(
+        self,
+        bucket: int,
+        item: Any,
+        seq: int = -1,
+        trace: Any = None,
+        tenant: str = "default",
+    ) -> Future:
         """Admit one request into ``bucket``'s lane; returns its Future.
         Raises :class:`Overloaded` when the queue is at capacity and
         :class:`ServerClosed` after :meth:`close` — a closed queue must
@@ -92,7 +100,9 @@ class MicroBatchQueue:
                     f"serving queue full ({self._count}/{self._max_pending} pending)"
                 )
             self._pending[bucket].append(
-                PendingRequest(item, fut, time.monotonic(), bucket, seq, trace)
+                PendingRequest(
+                    item, fut, time.monotonic(), bucket, seq, trace, tenant
+                )
             )
             self._count += 1
             self._cv.notify_all()

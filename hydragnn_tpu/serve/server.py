@@ -164,6 +164,31 @@ class ServeConfig:
     slo_queue_age_s: Optional[float] = None
     trigger_eval_every_s: float = 1.0
     incident_dir: Optional[str] = None
+    # Served-traffic spool + drift observability (obs/spool.py /
+    # obs/drift.py; docs/OBSERVABILITY.md "Drift detection").
+    # Spool: every spool_sample'th answered request (inputs +
+    # per-head predictions + trace/tenant/fingerprint) appended to
+    # rotating HGC shards under spool_dir (default
+    # <log_dir>/serve/spool), disk-bounded to spool_max_mb. Enabled by
+    # spool=True / spool_sample>0 / HYDRAGNN_SPOOL=1; the 0-defaults
+    # resolve through HYDRAGNN_SPOOL_SAMPLE / HYDRAGNN_SPOOL_MAX_MB.
+    # Drift: drift_ref (or HYDRAGNN_DRIFT_REF) names the training
+    # reference window; arming it builds a DriftMonitor and, per
+    # non-None threshold, a feature_drift / pred_drift / error_drift
+    # trigger rule on the same engine cadence as the SLO rules.
+    spool: bool = False
+    spool_sample: int = 0
+    spool_max_mb: float = 0.0
+    spool_shard_mb: float = 1.0
+    spool_dir: Optional[str] = None
+    drift_ref: Optional[str] = None
+    # pred drift is self-baselined on the session's own early window
+    # (obs/drift.py:_HeadSketch), so its clean-traffic noise floor is a
+    # two-sample PSI — the threshold sits higher than feature drift's.
+    drift_feature_psi: Optional[float] = 0.25
+    drift_pred_psi: Optional[float] = 0.5
+    drift_error_score: Optional[float] = 3.0
+    drift_min_count: int = 64
 
 
 def request_to_dict(sample: Any) -> Dict[str, Any]:
@@ -332,6 +357,18 @@ class ModelServer:
         self._incidents = None
         # graftsync: thread-safe=only the dispatch thread writes (_maybe_trigger runs on the dispatch loop)
         self._last_trigger_eval = 0.0
+        # served-traffic spool + drift monitor (obs/spool.py /
+        # obs/drift.py), built in start() when configured/armed
+        # graftsync: thread-safe=written once in start() before the dispatch thread spawns (and disarmed only by the dispatch thread); RequestSpool is internally synchronized
+        self._spool = None
+        # graftsync: thread-safe=written once in start() before the dispatch thread spawns (and disarmed only by the dispatch thread); only the dispatch thread feeds it
+        self._drift = None
+        # the spool/drift arming blocks start() stamped into run_start —
+        # public so benches can carry them in their committed records
+        # graftsync: thread-safe=written once in start() before the dispatch thread spawns
+        self.obs_arming = {"spool": {"enabled": False}, "drift": {"armed": False}}
+        # graftsync: thread-safe=written once in start() before the dispatch thread spawns
+        self._t_started = 0.0
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -370,6 +407,11 @@ class ModelServer:
                 )
             except Exception:
                 pass
+        # served-traffic spool + drift monitor — built BEFORE start_run
+        # so the manifest records whether they were armed (obs_report
+        # --validate surfaces un-armed drift monitoring on bench runs)
+        spool_block, drift_block = self._build_spool_drift()
+        self.obs_arming = {"spool": spool_block, "drift": drift_block}
         self.flight.start_run(
             {
                 "mode": "serve",
@@ -398,8 +440,13 @@ class ModelServer:
                 # which compiled-IR contracts (docs/LINT.md CC rules)
                 # the serve forward's lowered module passed
                 "graftcheck": graftcheck_block,
+                # served-traffic spool + drift observability arming
+                # (docs/OBSERVABILITY.md "Drift detection")
+                "spool": spool_block,
+                "drift": drift_block,
             }
         )
+        self._t_started = t0
         from hydragnn_tpu.resilience.supervisor import SupervisorPolicy
         from hydragnn_tpu.serve.supervise import DispatchSupervisor
 
@@ -442,6 +489,25 @@ class ModelServer:
                     f"{mp}.queue_oldest_age_s", float(cfg.slo_queue_age_s),
                 )
             )
+        if self._drift is not None:
+            # drift rules read the DriftMonitor's gauges on the same
+            # engine cadence as the SLO rules; a breach opens an
+            # incident whose bundle carries the full drift report and
+            # the offending spool window (_attach_drift_evidence)
+            from hydragnn_tpu.obs.triggers import TriggerRule
+
+            for name, kind, gauge, thresh in (
+                ("serve_feature_drift", "feature_drift",
+                 "drift.feature_psi", cfg.drift_feature_psi),
+                ("serve_pred_drift", "pred_drift",
+                 "drift.pred_psi", cfg.drift_pred_psi),
+                ("serve_error_drift", "error_drift",
+                 "drift.error_score", cfg.drift_error_score),
+            ):
+                if thresh is not None:
+                    rules.append(
+                        TriggerRule(name, kind, f"{mp}.{gauge}", float(thresh))
+                    )
         if rules:
             from hydragnn_tpu.obs.triggers import IncidentRecorder, TriggerEngine
 
@@ -490,9 +556,87 @@ class ModelServer:
                 extra["triggers"] = self._triggers.summary(
                     self._incidents.capture_s if self._incidents else 0.0
                 )
+            if self._spool is not None:
+                # flush the tail shard + stamp the measured spool cost
+                # as a fraction of serve wall time (the CI overhead gate)
+                spool_summary = self._spool.finalize()
+                wall = max(time.monotonic() - self._t_started, 1e-9)
+                spool_summary["overhead_frac"] = round(
+                    spool_summary["overhead_s"] / wall, 6
+                )
+                extra["spool"] = spool_summary
+            if self._drift is not None:
+                extra["drift"] = self._drift.summary()
             self.flight.end_run(
                 status="stopped", metrics=self.metrics_snapshot(), **extra
             )
+
+    def _build_spool_drift(self) -> tuple:
+        """Resolve spool/drift config (explicit ServeConfig fields win
+        over the HYDRAGNN_SPOOL* / HYDRAGNN_DRIFT_REF knobs), build the
+        enabled pieces, and return the two manifest blocks. A drift_ref
+        that fails to load is a loud start() failure — silently serving
+        unmonitored when monitoring was requested is the one outcome
+        this plane exists to prevent."""
+        cfg = self.config
+        spool_block: Dict[str, Any] = {"enabled": False}
+        spool_on = (
+            cfg.spool
+            or cfg.spool_sample > 0
+            or knobs.get_bool("HYDRAGNN_SPOOL", False)
+        )
+        if spool_on:
+            from hydragnn_tpu.obs.spool import RequestSpool
+            from hydragnn_tpu.utils.exec_cache import abstract_fingerprint
+
+            sample = cfg.spool_sample or knobs.get_int(
+                "HYDRAGNN_SPOOL_SAMPLE", 8
+            )
+            max_mb = cfg.spool_max_mb or knobs.get_float(
+                "HYDRAGNN_SPOOL_MAX_MB", 64.0
+            )
+            mcfg = self.served.cfg
+            self._spool = RequestSpool(
+                cfg.spool_dir or os.path.join(self.log_dir, "serve", "spool"),
+                sample_every=int(sample),
+                max_mb=float(max_mb),
+                shard_mb=cfg.spool_shard_mb,
+                model_fingerprint=abstract_fingerprint(self.served.variables),
+                head_kinds={
+                    mcfg.output_names[i]: mcfg.output_type[i]
+                    for i in range(mcfg.num_heads)
+                },
+                flight=self.flight,
+            )
+            spool_block = {
+                "enabled": True,
+                "dir": self._spool.root,
+                "sample_every": int(sample),
+                "max_mb": float(max_mb),
+            }
+        drift_block: Dict[str, Any] = {"armed": False}
+        ref_path = cfg.drift_ref or knobs.raw("HYDRAGNN_DRIFT_REF")
+        if ref_path:
+            from hydragnn_tpu.obs.drift import DriftMonitor, load_reference
+
+            self._drift = DriftMonitor(
+                load_reference(ref_path),
+                self.metrics.registry,
+                prefix=self.metrics.prefix,
+                min_count=cfg.drift_min_count,
+            )
+            drift_block = {
+                "armed": True,
+                "ref": ref_path,
+                "channels": self._drift.num_channels,
+                "min_count": cfg.drift_min_count,
+                "thresholds": {
+                    "feature_psi": cfg.drift_feature_psi,
+                    "pred_psi": cfg.drift_pred_psi,
+                    "error_score": cfg.drift_error_score,
+                },
+            }
+        return spool_block, drift_block
 
     def _on_dispatch_giveup(self, exc: BaseException) -> None:
         """Restart budget exhausted: a loudly dead server. Close
@@ -516,18 +660,22 @@ class ModelServer:
 
     # -- request path ------------------------------------------------------
 
-    def submit(self, sample: Any) -> Future:
+    def submit(self, sample: Any, tenant: str = "default") -> Future:
         """Admit one graph; returns a Future resolving to
         ``{head_name: np.ndarray}`` (graph heads: [d]; node heads:
         [n_nodes, d], this graph's rows only). Raises Overloaded on
         backpressure, Oversize when nothing can take the graph, and
         ServerClosed after stop() — typed and immediate, never a future
-        that can no longer resolve."""
+        that can no longer resolve. ``tenant`` rides along for spool
+        attribution (the fleet router stamps the admitting tenant)."""
         if self._stopped or (self._supervisor is not None and self._supervisor.failed):
             raise ServerClosed("server is stopped; submissions are rejected")
         if not self._started:
             raise RuntimeError("server not started (call start())")
         g = self._validated(request_to_dict(sample))
+        # deterministic covariate-shift injection (drift self-test):
+        # applied at admission so the sketches AND the model see it
+        g["x"] = inject.maybe_drift_shift(g["x"])
         n, e = _dict_sizes(g)
         seq = next(self._seq)
         trace = self._tracer.begin(seq=seq) if self._tracer is not None else None
@@ -537,7 +685,9 @@ class ModelServer:
                 trace.mark("serve.route", bucket=bucket.index)
             self.metrics.record_request(bucket.index)
             try:
-                fut = self._queue.put(bucket.index, g, seq=seq, trace=trace)
+                fut = self._queue.put(
+                    bucket.index, g, seq=seq, trace=trace, tenant=tenant
+                )
             except Overloaded:
                 self.metrics.record_reject()
                 raise
@@ -545,7 +695,7 @@ class ModelServer:
                 self._queue.depth(), self._queue.oldest_age_s()
             )
             return fut
-        return self._submit_oversize(g, n, e, seq, trace)
+        return self._submit_oversize(g, n, e, seq, trace, tenant)
 
     def predict(self, sample: Any, timeout: Optional[float] = None) -> Dict[str, np.ndarray]:
         """Blocking single-request convenience around :meth:`submit`."""
@@ -732,7 +882,13 @@ class ModelServer:
     # -- oversize fallbacks ------------------------------------------------
 
     def _submit_oversize(
-        self, g: Dict[str, Any], n: int, e: int, seq: int, trace: Any = None
+        self,
+        g: Dict[str, Any],
+        n: int,
+        e: int,
+        seq: int,
+        trace: Any = None,
+        tenant: str = "default",
     ) -> Future:
         self.metrics.record_request(None)
         fut: Future = Future()
@@ -745,7 +901,7 @@ class ModelServer:
             if trace is not None:
                 trace.mark("serve.route", oversize="largest_bucket")
             t0 = time.monotonic()
-            reqs = [PendingRequest(g, fut, t0, largest.index, seq, trace)]
+            reqs = [PendingRequest(g, fut, t0, largest.index, seq, trace, tenant)]
             self._execute_bucket(largest.index, reqs, reason="oversize")
             return fut
         if not self.config.eager_fallback:
@@ -770,6 +926,8 @@ class ModelServer:
                 return fut
             fut.set_result(result)
             self.metrics.observe_latency(time.monotonic() - t0)
+            if self._drift is not None or self._spool is not None:
+                self._observe_answered(g, result, trace, tenant, seq)
             if trace is not None:
                 trace.mark("serve.eager_execute")
                 self._tracer.finish(trace)
@@ -919,6 +1077,12 @@ class ModelServer:
             if not r.future.done():
                 r.future.set_result(result)
                 self.metrics.observe_latency(t_done - r.t_enqueue)
+                # spool/drift hook: everything in hand (inputs, sliced
+                # result) is already host-side numpy — zero device syncs
+                if self._drift is not None or self._spool is not None:
+                    self._observe_answered(
+                        r.item, result, r.trace, r.tenant, r.seq
+                    )
                 if r.trace is not None:
                     r.trace.add_span("serve.postprocess", t_exec1, time.time())
                     self._tracer.finish(r.trace)
@@ -982,6 +1146,34 @@ class ModelServer:
             self._tracer.finish(r.trace)
             r.trace = None
 
+    def _observe_answered(
+        self,
+        g: Dict[str, Any],
+        result: Dict[str, np.ndarray],
+        trace: Any,
+        tenant: str,
+        seq: int,
+    ) -> None:
+        """Post-answer spool/drift ingest. Observability must never
+        fail a request: exception-contained, and a failing plane
+        disarms itself after recording the error (one flight event, not
+        one per request)."""
+        try:
+            if self._drift is not None:
+                self._drift.observe(np.asarray(g["x"]), result)
+            if self._spool is not None:
+                self._spool.offer(
+                    g,
+                    result,
+                    trace=trace.trace_id if trace is not None else None,
+                    tenant=tenant,
+                    seq=seq,
+                )
+        except Exception as exc:
+            self.flight.error(exc, where="spool_drift")
+            self._drift = None
+            self._spool = None
+
     def _maybe_trigger(self) -> None:
         """Post-batch trigger hook: drive any open incident's bounded
         capture, then (rate-limited to ``trigger_eval_every_s``)
@@ -999,9 +1191,36 @@ class ModelServer:
             for verdict in trig.evaluate():
                 opened = inc.open_incident(verdict, flight=self.flight)
                 if opened is not None:
+                    if verdict.kind in (
+                        "feature_drift", "pred_drift", "error_drift"
+                    ):
+                        self._attach_drift_evidence(opened, verdict)
                     opened.tick()  # start the capture on this batch
         except Exception as exc:
             self.flight.error(exc, where="trigger_engine")
+
+    def _attach_drift_evidence(self, opened, verdict) -> None:
+        """A drift breach must be self-diagnosing: write the full drift
+        report + the offending spool window into the incident bundle as
+        ``drift_report.json`` and narrate the breach as a ``drift``
+        flight event."""
+        from hydragnn_tpu.obs.triggers import _atomic_json
+
+        report = self._drift.report() if self._drift is not None else {}
+        window = self._spool.window() if self._spool is not None else {}
+        report["spool_window"] = window
+        report["trigger"] = verdict.to_dict()
+        _atomic_json(os.path.join(opened.dir, "drift_report.json"), report)
+        opened.files["drift_report"] = "drift_report.json"
+        self.flight.record(
+            "drift",
+            rule=verdict.rule,
+            rule_kind=verdict.kind,
+            metric=verdict.metric,
+            observed=verdict.observed,
+            threshold=verdict.threshold,
+            spool_window=window,
+        )
 
     def export_trace(self, path: str) -> Optional[str]:
         """Dump the tracer's recent-request ring as Chrome/Perfetto

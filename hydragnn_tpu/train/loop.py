@@ -1003,6 +1003,22 @@ def train_validate_test(
     from hydragnn_tpu.lint.ir import contract_block
 
     graftcheck_block = contract_block(None)
+    # drift reference window (obs/drift.py): per-channel feature stats +
+    # per-head target stats over a bounded subsample of the training
+    # set, stamped into the manifest so a later serving run can load
+    # this flight record as its HYDRAGNN_DRIFT_REF and compare live
+    # traffic against what this model actually trained on. Telemetry:
+    # a failure degrades to an absent block, never a dead run.
+    stats_block = None
+    if telemetry_on:
+        try:
+            from hydragnn_tpu.obs.drift import build_reference
+
+            stats_block = build_reference(
+                list(train_loader.all_samples), head_names=head_names
+            )
+        except Exception:
+            stats_block = None
     if telemetry_on and knobs.get_bool("HYDRAGNN_GRAFTCHECK", True):
         try:
             # peek_batch builds the first batch without counting as an
@@ -1080,6 +1096,10 @@ def train_validate_test(
             # run's own lowered step passed — the in-run face of
             # tools/graftcheck.py
             "graftcheck": graftcheck_block,
+            # the drift reference window serving runs compare live
+            # traffic against (obs/drift.py load_reference reads it
+            # straight out of this flight record)
+            "stats": stats_block,
         }
     )
     if resumed_from is not None:

@@ -72,6 +72,10 @@ KNOBS: Dict[str, Knob] = {
         _K("HYDRAGNN_DIAGNOSTICS", "bool", "1", "train/loop.py",
            "Force-disable model introspection (per-head grad norms, MFU "
            "ledger) regardless of config; the tier-1 suite sets 0."),
+        _K("HYDRAGNN_DRIFT_REF", "path", None, "serve/server.py",
+           "Drift reference window: a training flight.jsonl (the "
+           "run_start.manifest stats block) or a bare stats JSON. Arms "
+           "the DriftMonitor + drift trigger rules on server start."),
         _K("HYDRAGNN_EXEC_CACHE", "path", None, "utils/exec_cache.py",
            "Directory of the persistent AOT executable cache; unset = "
            "inert. Deliberately survives supervisor restart env-strips."),
@@ -126,6 +130,10 @@ KNOBS: Dict[str, Knob] = {
            "utils/exec_cache.py",
            "Force the donation round-trip gate to report failure: the "
            "cached donated executable is evicted and live-compiled."),
+        _K("HYDRAGNN_INJECT_DRIFT", "spec", None, "resilience/inject.py",
+           "SHIFT: add a deterministic covariate shift of SHIFT to every "
+           "incoming request's node features at admission (drives the "
+           "feature_drift trigger end to end)."),
         _K("HYDRAGNN_INJECT_GRAFTCHECK", "spec", None, "lint/ir.py",
            "cc001..cc006 (comma-separated): plant one real compiled-IR "
            "violation per named contract for the graftcheck self-test."),
@@ -192,6 +200,15 @@ KNOBS: Dict[str, Knob] = {
         _K("HYDRAGNN_RESIDENCY_VMEM_MB", "float", "12", "ops/fused_conv.py",
            "VMEM budget the cross-layer resident conv-stack kernel may "
            "claim (a TPU core has ~16 MB; the pipeline needs headroom)."),
+        _K("HYDRAGNN_SPOOL", "bool", "0", "serve/server.py",
+           "Enable the served-traffic request spool (obs/spool.py): "
+           "sampled requests + predictions appended to rotating HGC "
+           "shards under <log_dir>/serve/spool."),
+        _K("HYDRAGNN_SPOOL_MAX_MB", "float", "64", "serve/server.py",
+           "Disk bound for the request spool; once finalized shards "
+           "exceed it, the oldest shards are LRU-evicted."),
+        _K("HYDRAGNN_SPOOL_SAMPLE", "int", "8", "serve/server.py",
+           "Spool every Nth answered request (1 = every request)."),
         _K("HYDRAGNN_TELEMETRY", "bool", "1", "obs/registry.py",
            "Process-wide telemetry gate: 0/false/off disables the "
            "registry, flight recorder, spans, and compile monitor."),

@@ -1,0 +1,576 @@
+"""Served-traffic spool + drift observability tests
+(hydragnn_tpu/obs/spool.py + obs/drift.py): sketch math against numpy
+references, HGC spool round-trip bit-parity (edge_occupancy included),
+rotation / disk bound / atomic finalization, per-tenant attribution,
+drift triggers firing on injected shift and staying quiet on clean
+traffic, and the incident bundle carrying its drift report.
+
+All CPU (conftest pins the 8-device virtual mesh); the one real-server
+test reuses a smoke-sized flagship build so the file stays tier-1-fast.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from hydragnn_tpu.obs.drift import (
+    DriftMonitor,
+    P2Quantile,
+    RunningMoments,
+    build_reference,
+    hist_counts,
+    load_reference,
+    psi,
+    validate_drift_report,
+)
+from hydragnn_tpu.obs.flight import FlightRecorder, read_flight_record
+from hydragnn_tpu.obs.registry import MetricsRegistry
+from hydragnn_tpu.obs.spool import (
+    RequestSpool,
+    list_shards,
+    read_shard_manifest,
+    read_spool,
+    validate_spool_manifest,
+)
+
+
+# ---------------------------------------------------------------------------
+# sketch math vs numpy references
+# ---------------------------------------------------------------------------
+
+
+def test_running_moments_matches_numpy():
+    rng = np.random.default_rng(0)
+    data = rng.normal(3.0, 2.0, size=(500, 4))
+    mom = RunningMoments(4)
+    for chunk in np.array_split(data, 13):
+        mom.update(chunk)
+    assert mom.count == 500
+    np.testing.assert_allclose(mom.mean, data.mean(axis=0), rtol=1e-10)
+    np.testing.assert_allclose(mom.variance, data.var(axis=0), rtol=1e-10)
+    np.testing.assert_allclose(mom.std, data.std(axis=0), rtol=1e-10)
+
+
+def test_running_moments_accepts_1d():
+    mom = RunningMoments(1)
+    mom.update(np.array([1.0, 2.0, 3.0]))
+    np.testing.assert_allclose(mom.mean, [2.0])
+
+
+def test_p2_quantile_exact_small_then_approximate():
+    est = P2Quantile(0.5)
+    for v in (5.0, 1.0, 3.0):
+        est.add(v)
+    assert est.value == 3.0  # exact while <= 5 observations
+    rng = np.random.default_rng(1)
+    data = rng.normal(0.0, 1.0, size=5000)
+    ests = {q: P2Quantile(q) for q in (0.05, 0.5, 0.95)}
+    for v in data:
+        for est in ests.values():
+            est.add(v)
+    for q, est in ests.items():
+        assert abs(est.value - np.quantile(data, q)) < 0.06
+
+
+def test_psi_zero_identical_positive_on_shift():
+    ref = [0.25, 0.25, 0.25, 0.25]
+    assert psi(ref, ref) == pytest.approx(0.0)
+    shifted = psi(ref, [0.7, 0.2, 0.05, 0.05])
+    assert shifted > 0.3
+    # symmetric-ish and finite even with empty bins on one side
+    assert np.isfinite(psi(ref, [1.0, 0.0, 0.0, 0.0]))
+
+
+def test_hist_counts_partitions_and_keeps_top_edge_inner():
+    edges = np.linspace(0.0, 1.0, 5)
+    v = np.array([-0.5, 0.0, 0.4, 1.0, 1.0, 2.0])
+    counts = hist_counts(v, edges)
+    assert counts.sum() == len(v)
+    assert counts[0] == 1  # underflow
+    assert counts[-1] == 1  # strict overflow only
+    # values exactly at the top edge stay in the last inner bin — the
+    # reference fracs use np.histogram's closed right edge and discrete
+    # features put real mass exactly at the reference max
+    assert counts[-2] == 2
+
+
+# ---------------------------------------------------------------------------
+# reference window build / load
+# ---------------------------------------------------------------------------
+
+
+def _toy_samples(n=12, nodes=6, shift=0.0, seed=0):
+    from hydragnn_tpu.data.dataset import GraphSample
+
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        x = (rng.normal(0.0, 1.0, size=(nodes, 2)) + shift).astype(np.float32)
+        ei = np.stack(
+            [np.arange(nodes), (np.arange(nodes) + 1) % nodes]
+        ).astype(np.int32)
+        out.append(
+            GraphSample(
+                x=x,
+                pos=rng.normal(size=(nodes, 3)).astype(np.float32),
+                edge_index=ei,
+                graph_targets={"energy": np.float32(rng.normal())},
+                node_targets={
+                    "forces": rng.normal(size=(nodes, 1)).astype(np.float32)
+                },
+            )
+        )
+    return out
+
+
+def test_build_reference_stats_and_errors(tmp_path):
+    samples = _toy_samples()
+    ref = build_reference(samples, head_names=["energy", "forces"])
+    assert ref["schema"] == 1
+    assert len(ref["feature"]["channels"]) == 2
+    assert set(ref["heads"]) == {"energy", "forces"}
+    ch = ref["feature"]["channels"][0]
+    xs = np.concatenate([np.asarray(s.x) for s in samples])[:, 0]
+    assert ch["mean"] == pytest.approx(float(xs.mean()), rel=1e-6)
+    assert ch["std"] == pytest.approx(float(xs.std()), rel=1e-6)
+    with pytest.raises(ValueError):
+        build_reference([])
+
+
+def test_load_reference_json_and_flight(tmp_path):
+    ref = build_reference(_toy_samples())
+    path = tmp_path / "ref.json"
+    path.write_text(json.dumps(ref))
+    loaded = load_reference(str(path))
+    assert loaded["feature"]["channels"][0]["mean"] == pytest.approx(
+        ref["feature"]["channels"][0]["mean"]
+    )
+    # flight-record form: the run_start.manifest.stats block
+    fpath = tmp_path / "flight.jsonl"
+    fr = FlightRecorder(str(fpath))
+    fr.start_run({"stats": ref})
+    fr.end_run("completed")
+    assert load_reference(str(fpath))["num_rows"] == ref["num_rows"]
+    with pytest.raises(FileNotFoundError):
+        load_reference(str(tmp_path / "missing.json"))
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"schema": 99}))
+    with pytest.raises(ValueError):
+        load_reference(str(bad))
+
+
+# ---------------------------------------------------------------------------
+# drift monitor: quiet on clean, loud on shift
+# ---------------------------------------------------------------------------
+
+
+def _monitor(ref, registry=None, **kw):
+    registry = registry or MetricsRegistry(enabled=True)
+    kw.setdefault("min_count", 32)
+    return DriftMonitor(ref, registry, **kw), registry
+
+
+def _feed(monitor, samples, head_vals=None, shift=0.0):
+    for i, s in enumerate(samples):
+        preds = {}
+        if head_vals is not None:
+            preds = {name: vals[i] for name, vals in head_vals.items()}
+        monitor.observe(np.asarray(s.x) + shift, preds)
+
+
+def test_feature_drift_quiet_then_fires():
+    samples = _toy_samples(n=40)
+    ref = build_reference(samples)
+    mon, reg = _monitor(ref)
+    _feed(mon, samples)
+    clean_psi = max(mon.feature_psi())
+    assert clean_psi < 0.1
+    assert reg.gauge("serve.drift.feature_psi").value < 0.25
+
+    mon2, reg2 = _monitor(ref)
+    _feed(mon2, samples, shift=5.0)
+    assert max(mon2.feature_psi()) > 1.0
+    assert reg2.gauge("serve.drift.feature_psi").value > 1.0
+    assert max(mon2.feature_qshift()) > 3.0
+
+
+def test_warmup_guard_keeps_gauges_zero():
+    samples = _toy_samples(n=40)
+    ref = build_reference(samples)
+    mon, reg = _monitor(ref, min_count=10_000)
+    _feed(mon, samples, shift=5.0)  # shifted, but below min_count rows
+    assert reg.gauge("serve.drift.feature_psi").value == 0.0
+    assert reg.gauge("serve.drift.feature_rows").value > 0
+
+
+def test_channel_mismatch_raises():
+    ref = build_reference(_toy_samples())
+    mon, _ = _monitor(ref)
+    with pytest.raises(ValueError):
+        mon.observe(np.zeros((4, 7)), {})
+
+
+def test_pred_drift_self_baseline_mid_session_shift():
+    samples = _toy_samples(n=200)
+    ref = build_reference(samples)
+    rng = np.random.default_rng(2)
+    stable = rng.normal(0.0, 1.0, size=200)
+    mon, reg = _monitor(ref, min_count=32)
+    # 100 stable requests: baseline freezes, live window matches it
+    _feed(mon, samples[:100], head_vals={"energy": stable[:100]})
+    assert max(mon.head_psi().values()) < 0.25
+    assert reg.gauge("serve.drift.pred_psi").value < 0.25
+    # mid-session the prediction distribution jumps
+    _feed(mon, samples[100:], head_vals={"energy": stable[100:] + 8.0})
+    assert max(mon.head_psi().values()) > 1.0
+    assert reg.gauge("serve.drift.pred_psi").value > 1.0
+
+
+def test_error_drift_track():
+    ref = build_reference(_toy_samples(), head_names=["energy"])
+    mon, reg = _monitor(ref, min_labeled=4)
+    scale = ref["heads"]["energy"]["scale"]
+    for _ in range(8):
+        mon.observe_labeled("energy", np.array([10.0 * scale]), np.array([0.0]))
+    assert mon.error_scores()["energy"] > 3.0
+    assert reg.gauge("serve.drift.error_score").value > 3.0
+
+
+def test_drift_report_validates_and_rejects_garbage():
+    samples = _toy_samples(n=40)
+    mon, _ = _monitor(build_reference(samples))
+    _feed(mon, samples)
+    report = mon.report()
+    assert validate_drift_report(report) == []
+    assert report["counts"]["feature_rows"] == mon.feature_rows
+    assert validate_drift_report({"schema": 0})  # non-empty problems
+    broken = dict(report)
+    broken.pop("feature")
+    assert any("feature" in p for p in validate_drift_report(broken))
+
+
+def test_drift_trigger_rules_fire_and_stay_quiet(tmp_path):
+    from hydragnn_tpu.obs.triggers import (
+        RULE_KINDS,
+        TriggerEngine,
+        TriggerRule,
+    )
+
+    assert {"feature_drift", "pred_drift", "error_drift"} <= set(RULE_KINDS)
+    samples = _toy_samples(n=40)
+    ref = build_reference(samples)
+    reg = MetricsRegistry(enabled=True)
+    rule = TriggerRule(
+        "serve_feature_drift", "feature_drift", "serve.drift.feature_psi", 0.25
+    )
+    engine = TriggerEngine([rule], registry=reg)
+    mon, _ = _monitor(ref, registry=reg)
+    _feed(mon, samples)
+    assert engine.evaluate() == []  # clean: no verdicts
+    _feed(mon, samples, shift=5.0)
+    verdicts = engine.evaluate()
+    assert [v.kind for v in verdicts] == ["feature_drift"]
+    assert verdicts[0].observed > 0.25
+    assert "feature_rows" in verdicts[0].detail
+
+
+# ---------------------------------------------------------------------------
+# request spool: HGC round-trip, rotation, disk bound, crash safety
+# ---------------------------------------------------------------------------
+
+
+def _request_dict(sample):
+    ei = np.asarray(sample.edge_index)
+    return {
+        "x": np.asarray(sample.x),
+        "pos": np.asarray(sample.pos),
+        "senders": ei[0],
+        "receivers": ei[1],
+    }
+
+
+def _result_for(sample, seed=0):
+    rng = np.random.default_rng(seed)
+    n = np.asarray(sample.x).shape[0]
+    return {
+        "energy": rng.normal(size=(1,)).astype(np.float32),
+        "forces": rng.normal(size=(n, 1)).astype(np.float32),
+    }
+
+
+_HEAD_KINDS = {"energy": "graph", "forces": "node"}
+
+
+def test_spool_roundtrip_bit_parity(tmp_path):
+    samples = _toy_samples(n=6)
+    spool = RequestSpool(
+        str(tmp_path / "spool"),
+        sample_every=1,
+        max_mb=8.0,
+        model_fingerprint="fp-test",
+        head_kinds=_HEAD_KINDS,
+    )
+    for i, s in enumerate(samples):
+        took = spool.offer(
+            _request_dict(s), _result_for(s, i),
+            trace=f"tr-{i}", tenant="acme", seq=i,
+        )
+        assert took
+    spool.finalize()
+    back = list(read_spool(str(tmp_path / "spool")))
+    assert len(back) == len(samples)
+    back.sort(key=lambda s: s.meta["spool"]["seq"])
+    for i, (orig, got) in enumerate(zip(samples, back)):
+        # the HGC writer stores x/pos as f32 — parity vs the f32 cast
+        assert np.array_equal(np.asarray(got.x), np.asarray(orig.x, np.float32))
+        assert np.array_equal(
+            np.asarray(got.pos), np.asarray(orig.pos, np.float32)
+        )
+        assert np.array_equal(
+            np.asarray(got.edge_index), np.asarray(orig.edge_index)
+        )
+        want = _result_for(orig, i)
+        np.testing.assert_array_equal(
+            got.graph_targets["energy"], want["energy"]
+        )
+        np.testing.assert_array_equal(got.node_targets["forces"], want["forces"])
+        blk = got.meta["spool"]
+        assert blk["trace"] == f"tr-{i}"
+        assert blk["tenant"] == "acme"
+        assert blk["model_fingerprint"] == "fp-test"
+
+
+def test_spooled_shard_batches_like_the_original(tmp_path):
+    """edge_occupancy parity: a spooled shard re-entering the batcher
+    produces bit-identical padded batches (the retraining contract)."""
+    from hydragnn_tpu.graph.batch import batch_graphs
+    from hydragnn_tpu.serve.server import request_to_dict
+
+    samples = _toy_samples(n=4)
+    spool = RequestSpool(
+        str(tmp_path / "spool"), sample_every=1, head_kinds=_HEAD_KINDS
+    )
+    for i, s in enumerate(samples):
+        spool.offer(_request_dict(s), _result_for(s, i), seq=i)
+    spool.finalize()
+    back = sorted(
+        read_spool(str(tmp_path / "spool")),
+        key=lambda s: s.meta["spool"]["seq"],
+    )
+    want = batch_graphs([request_to_dict(s) for s in samples])
+    got = batch_graphs([request_to_dict(s) for s in back])
+    assert int(want.edge_occupancy) == int(got.edge_occupancy)
+    np.testing.assert_array_equal(np.asarray(want.nodes), np.asarray(got.nodes))
+    np.testing.assert_array_equal(
+        np.asarray(want.senders), np.asarray(got.senders)
+    )
+
+
+def test_spool_sampling_rotation_and_disk_bound(tmp_path):
+    samples = _toy_samples(n=32, nodes=64)  # ~2KB/sample: forces rotations
+    events = []
+
+    class _Flight:
+        def record(self, kind, **fields):
+            events.append({"kind": kind, **fields})
+
+    spool = RequestSpool(
+        str(tmp_path / "spool"),
+        sample_every=2,
+        max_mb=0.02,  # ~2 shards' worth: forces LRU eviction
+        shard_mb=0.01,
+        head_kinds=_HEAD_KINDS,
+        flight=_Flight(),
+    )
+    for i, s in enumerate(samples):
+        spool.offer(_request_dict(s), _result_for(s, i), seq=i)
+    summary = spool.finalize()
+    assert summary["seen"] == 32
+    assert summary["spooled"] == 16  # every 2nd request
+    assert summary["rotations"] >= 2
+    assert summary["evicted"] >= 1
+    shards = list_shards(str(tmp_path / "spool"))
+    assert shards  # evicted down to the bound, never to nothing
+    total = summary["bytes"]
+    assert total <= 0.02 * 1024 * 1024 or len(shards) == 1
+    rot = [e for e in events if e["kind"] == "spool_rotate"]
+    assert len(rot) == summary["rotations"]
+    assert all("total_bytes" in e and "shard" in e for e in rot)
+    # surviving shards hold the HIGHEST seq numbers (LRU evicts oldest)
+    mans = [read_shard_manifest(s) for s in shards]
+    assert validate_spool_manifest(mans[-1]) == []
+    assert mans[-1]["seq_range"][1] == 30  # last sampled seq
+
+
+def test_spool_atomic_finalize_sweeps_crash_debris(tmp_path):
+    root = tmp_path / "spool"
+    spool = RequestSpool(str(root), sample_every=1, head_kinds=_HEAD_KINDS)
+    s = _toy_samples(n=1)[0]
+    spool.offer(_request_dict(s), _result_for(s), seq=0)
+    spool.finalize()
+    # simulate a crash mid-rotation: a dot-dir with partial contents
+    debris = root / ".shard-000099.tmp-12345"
+    debris.mkdir()
+    (debris / "junk").write_text("partial")
+    # readers never see it...
+    assert all(".shard" not in p for p in list_shards(str(root)))
+    # ...and the next spool construction sweeps it
+    RequestSpool(str(root), sample_every=1, head_kinds=_HEAD_KINDS)
+    assert not debris.exists()
+
+
+def test_spool_per_tenant_attribution(tmp_path):
+    samples = _toy_samples(n=4)
+    spool = RequestSpool(
+        str(tmp_path / "spool"), sample_every=1, head_kinds=_HEAD_KINDS
+    )
+    tenants = ["acme", "globex", "acme", "initech"]
+    for i, (s, t) in enumerate(zip(samples, tenants)):
+        spool.offer(_request_dict(s), _result_for(s, i), tenant=t, seq=i)
+    spool.finalize()
+    (shard,) = list_shards(str(tmp_path / "spool"))
+    man = read_shard_manifest(shard)
+    assert man["tenants"] == sorted(set(tenants))
+    by_tenant = {}
+    for got in read_spool(str(tmp_path / "spool")):
+        by_tenant.setdefault(got.meta["spool"]["tenant"], []).append(got)
+    assert {t: len(v) for t, v in by_tenant.items()} == {
+        "acme": 2, "globex": 1, "initech": 1,
+    }
+
+
+def test_validate_spool_manifest_rejects_garbage():
+    assert validate_spool_manifest({"schema": 1}) != []
+    assert any(
+        "num_samples" in p
+        for p in validate_spool_manifest(
+            {
+                "schema": 1, "shard": "s", "num_samples": 0,
+                "model_fingerprint": "", "sample_every": 1,
+                "tenants": [], "seq_range": [0, 0], "t_range": [0, 0],
+            }
+        )
+    )
+
+
+# ---------------------------------------------------------------------------
+# knobs + lint parity
+# ---------------------------------------------------------------------------
+
+
+def test_spool_drift_knobs_documented():
+    from hydragnn_tpu.utils import knobs
+
+    names = set(knobs.KNOBS)
+    for knob in (
+        "HYDRAGNN_SPOOL",
+        "HYDRAGNN_SPOOL_SAMPLE",
+        "HYDRAGNN_SPOOL_MAX_MB",
+        "HYDRAGNN_DRIFT_REF",
+        "HYDRAGNN_INJECT_DRIFT",
+    ):
+        assert knob in names
+    doc = open(
+        os.path.join(os.path.dirname(__file__), "..", "docs", "KNOBS.md")
+    ).read()
+    assert "HYDRAGNN_SPOOL" in doc and "HYDRAGNN_DRIFT_REF" in doc
+
+
+def test_artifact_linter_knows_spool_and_drift_schemas(tmp_path):
+    from hydragnn_tpu.lint.artifacts import RUNTIME_SCHEMAS
+
+    assert "drift_report.json" in RUNTIME_SCHEMAS
+    assert "spool_manifest.json" in RUNTIME_SCHEMAS
+    label, check = RUNTIME_SCHEMAS["spool_manifest.json"]
+    samples = _toy_samples(n=2)
+    spool = RequestSpool(
+        str(tmp_path / "spool"), sample_every=1, head_kinds=_HEAD_KINDS
+    )
+    for i, s in enumerate(samples):
+        spool.offer(_request_dict(s), _result_for(s, i), seq=i)
+    spool.finalize()
+    (shard,) = list_shards(str(tmp_path / "spool"))
+    assert check(read_shard_manifest(shard)) == []
+    mon, _ = _monitor(build_reference(samples))
+    _feed(mon, samples)
+    _, check_report = RUNTIME_SCHEMAS["drift_report.json"]
+    assert check_report(json.loads(json.dumps(mon.report()))) == []
+
+
+# ---------------------------------------------------------------------------
+# full server: spool + drift armed, injected shift -> one incident
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def flagship_setup():
+    from hydragnn_tpu.flagship import build_flagship
+    from hydragnn_tpu.serve import ModelRegistry
+
+    _, model, variables, loader = build_flagship(
+        n_samples=24,
+        hidden_dim=8,
+        num_conv_layers=2,
+        batch_size=4,
+        unit_cells=(2, 3),
+    )
+    registry = ModelRegistry()
+    served = registry.register("drift-smoke", model, variables)
+    return served, list(loader.all_samples)
+
+
+@pytest.mark.slow
+def test_server_drift_incident_end_to_end(flagship_setup, tmp_path, monkeypatch):
+    from hydragnn_tpu.obs.triggers import (
+        list_incidents,
+        validate_incident_bundle,
+    )
+    from hydragnn_tpu.serve import ModelServer, ServeConfig
+
+    served, samples = flagship_setup
+    ref = build_reference(samples)
+    ref_path = tmp_path / "ref.json"
+    ref_path.write_text(json.dumps(ref))
+    monkeypatch.setenv("HYDRAGNN_INJECT_DRIFT", "5.0")
+    flight_path = tmp_path / "flight.jsonl"
+    cfg = ServeConfig(
+        max_batch=4,
+        max_delay_ms=5.0,
+        slo_p99_ms=60_000.0,
+        trigger_eval_every_s=0.05,
+        incident_dir=str(tmp_path / "inc"),
+        spool=True,
+        spool_sample=1,
+        spool_dir=str(tmp_path / "spool"),
+        drift_ref=str(ref_path),
+        drift_min_count=16,
+    )
+    with ModelServer(
+        served, samples, cfg, flight=FlightRecorder(str(flight_path))
+    ) as server:
+        for s in samples[:20]:
+            server.predict(s, timeout=120)
+        import time
+
+        time.sleep(0.3)
+    events = read_flight_record(str(flight_path))
+    start = next(e for e in events if e["kind"] == "run_start")
+    assert start["manifest"]["spool"]["enabled"]
+    assert start["manifest"]["drift"]["armed"]
+    end = next(e for e in reversed(events) if e["kind"] == "run_end")
+    assert end["spool"]["spooled"] >= 1
+    assert "overhead_frac" in end["spool"]
+    assert end["drift"]["feature_psi_max"] > 0.25
+    drifts = [e for e in events if e["kind"] == "drift"]
+    assert drifts and drifts[0]["rule_kind"] == "feature_drift"
+    bundles = list_incidents(str(tmp_path / "inc"))
+    assert len(bundles) == 1
+    assert validate_incident_bundle(bundles[0]) == []
+    report = json.load(open(os.path.join(bundles[0], "drift_report.json")))
+    assert validate_drift_report(report) == []
+    assert report["trigger"]["kind"] == "feature_drift"
+    assert report["spool_window"]["dir"] == str(tmp_path / "spool")
+    # the spooled shards reload through the container reader
+    assert len(list(read_spool(str(tmp_path / "spool")))) >= 1

@@ -264,7 +264,7 @@ class FakeReplica:
     def queue_depth(self) -> int:
         return self._load
 
-    def submit(self, sample) -> Future:
+    def submit(self, sample, tenant=None) -> Future:
         fut: Future = Future()
         self.submitted.append((sample, fut))
         if self.fail_with is not None:

@@ -319,6 +319,10 @@ def render_faults(events: List[dict]) -> str:
             1 for e in events if e.get("kind") == "reload_failed"
         ),
         "incidents": sum(1 for e in events if e.get("kind") == "incident"),
+        "drift": sum(1 for e in events if e.get("kind") == "drift"),
+        "spool_rotations": sum(
+            1 for e in events if e.get("kind") == "spool_rotate"
+        ),
         "nonfinite_skipped": sum(
             (e.get("nonfinite") or {}).get("skipped", 0)
             for e in events
@@ -371,6 +375,16 @@ def render_faults(events: List[dict]) -> str:
             # SLO trigger fired; the bundle at `path` holds the evidence
             # (render it with tools/incident_report.py)
             detail = f"id={e.get('id')} rule={e.get('rule')} path={e.get('path')}"
+        elif kind == "drift":
+            # served traffic left the training reference; the incident
+            # bundle's drift_report.json + the spool window hold the
+            # evidence (render with tools/drift_report.py)
+            window = e.get("spool_window") or {}
+            detail = (
+                f"rule={e.get('rule')} observed={_fmt(e.get('observed'))} "
+                f"threshold={_fmt(e.get('threshold'))} "
+                f"spool={window.get('dir') or '<off>'}"
+            )
         elif kind == "run_end":
             detail = f"status={e.get('status')}"
         else:
@@ -545,6 +559,27 @@ def main(argv=None) -> int:
                 ecache = _exec_cache_summary(events)
                 if ecache:
                     print(f"  exec_cache: {ecache}")
+                # drift-observability posture: was the spool/drift plane
+                # armed for the serve run(s) this record holds? (a serve
+                # bench artifact with drift off is a monitoring gap, not
+                # a schema error — surfaced, never fatal)
+                serves = [
+                    (e.get("manifest") or {})
+                    for e in events
+                    if e.get("kind") == "run_start"
+                    and (e.get("manifest") or {}).get("mode") == "serve"
+                ]
+                if serves:
+                    armed = sum(
+                        1 for m in serves if (m.get("drift") or {}).get("armed")
+                    )
+                    spooled = sum(
+                        1 for m in serves if (m.get("spool") or {}).get("enabled")
+                    )
+                    print(
+                        f"  drift: armed on {armed}/{len(serves)} serve run(s),"
+                        f" spool on {spooled}/{len(serves)}"
+                    )
             _print_warnings(events)
         else:
             if len(args.records) > 1:
