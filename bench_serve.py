@@ -40,7 +40,11 @@ resolved (result or typed RequestFailed: ZERO lost/hanging futures),
 the quarantine/restart/reload counts match the injection plan in both
 the metrics and the flight record, and post-recovery traffic paid 0
 new compile misses. The headline value is the worst not-ready gap
-(recovery time); exit 1 on any violated invariant.
+(recovery time); exit 1 on any violated invariant. The mid-traffic
+hot reload here is the same canary + atomic-swap path the retrain
+pilot (``hydragnn_tpu/pilot``, docs/RESILIENCE.md "Closed loop")
+drives as the final stage of every retrain cycle, so this number is
+also the serving-impact bound for a pilot-initiated reload.
 
 Fleet mode (``python bench_serve.py --fleet``, or SERVE_FLEET=1): the
 fleet chaos acceptance run (docs/FLEET.md). Measures sustained QPS at

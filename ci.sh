@@ -1331,6 +1331,250 @@ grep -q "breaches: 1" "$DRIFT_DIR/report.out" || {
 python tools/obs_report.py --faults "$DRIFT_DIR/shift_flight.jsonl"
 rm -rf "$DRIFT_DIR"
 
+echo "== closed-loop smoke (retrain pilot: drift incident -> fine-tune from pinned spool -> two-slice canary -> hot reload; injected train crash absorbed, injected regression rejected, torn candidate rolled back) =="
+PILOT_DIR="$(mktemp -d)"
+# --- train once (the same tiny flagship the drift smoke uses); each
+#     scenario then gets its own COPY of the checkpoint tree — the
+#     pilot journal and the candidate run live NEXT TO the serving run,
+#     so sharing one tree would leak pilot state (and candidates) from
+#     one scenario into the next
+JAX_PLATFORMS=cpu python - "$PILOT_DIR/train" <<'EOF'
+import glob
+import sys
+
+from hydragnn_tpu.api import run_training
+from hydragnn_tpu.data.synthetic import deterministic_graph_data
+from hydragnn_tpu.flagship import flagship_config
+from hydragnn_tpu.obs.drift import load_reference
+
+out = sys.argv[1]
+cfg = flagship_config(hidden_dim=8, num_conv_layers=2, batch_size=5, num_epoch=2)
+samples = deterministic_graph_data(
+    number_configurations=24,
+    unit_cell_x_range=(2, 3),
+    unit_cell_y_range=(2, 3),
+    unit_cell_z_range=(2, 3),
+    seed=0,
+)
+run_training(cfg, samples=samples, log_dir=out + "/logs/")
+flight = glob.glob(out + "/logs/*/flight.jsonl")[0]
+ref = load_reference(flight)
+assert ref["num_rows"] > 0, ref.keys()
+print(f"closed-loop smoke (train ref): OK ({ref['num_rows']} reference rows)")
+EOF
+# one driver, three scenarios: serve with HYDRAGNN_INJECT_DRIFT shifted
+# traffic and a REAL attached RetrainPilot (real supervised child
+# fine-tune, real canary, real hot reload), then assert the journal,
+# the flight narration, and the serving weights per scenario.
+# CANARY_TOL=10.0 keeps CI deterministic: the smoke proves the LOOP's
+# mechanics (a 1-epoch fine-tune on 1x-CPU pseudo-label data is not a
+# model-quality statement); the regression scenario still rejects
+# because its injected inflation dwarfs any tolerance.
+cat > "$PILOT_DIR/driver.py" <<'EOF'
+"""Closed-loop smoke driver: serve a drifting model with a retrain
+pilot attached and assert one full cycle per scenario (ok / canary /
+torn)."""
+
+import glob
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+out, ckpt, ref_path, scenario = sys.argv[1:5]
+
+from hydragnn_tpu.api import prepare_loaders_and_config, serve_model
+from hydragnn_tpu.data.synthetic import deterministic_graph_data
+from hydragnn_tpu.flagship import flagship_config
+from hydragnn_tpu.obs import FlightRecorder, read_flight_record
+from hydragnn_tpu.obs.triggers import list_incidents
+from hydragnn_tpu.pilot import RetrainPilot
+from hydragnn_tpu.serve import ServeConfig
+
+
+def cfg():
+    return flagship_config(
+        hidden_dim=8, num_conv_layers=2, batch_size=5, num_epoch=2
+    )
+
+
+def data():
+    return deterministic_graph_data(
+        number_configurations=24,
+        unit_cell_x_range=(2, 3),
+        unit_cell_y_range=(2, 3),
+        unit_cell_z_range=(2, 3),
+        seed=0,
+    )
+
+
+flight_path = f"{out}/{scenario}_flight.jsonl"
+flight = FlightRecorder(flight_path)
+server = serve_model(
+    cfg(),
+    samples=data(),
+    log_dir=ckpt + "/logs/",
+    serve_config=ServeConfig(
+        max_batch=4,
+        max_delay_ms=5.0,
+        incident_dir=f"{out}/{scenario}_incidents",
+        spool=True,
+        spool_sample=2,
+        spool_shard_mb=0.05,
+        spool_dir=f"{out}/{scenario}_spool",
+        drift_ref=ref_path,
+        # node rows, not requests: fire the rule mid-traffic, once the
+        # spool holds a trainable window (~24 requests in)
+        drift_min_count=400,
+        trigger_eval_every_s=0.05,
+    ),
+    flight=flight,
+)
+run_name = os.path.basename(
+    os.path.dirname(glob.glob(ckpt + "/logs/*/flight.jsonl")[0])
+)
+train_loader, _, _, _ = prepare_loaders_and_config(cfg(), data())
+refs = list(train_loader.all_samples)
+pilot = RetrainPilot(server, run_name, reference_samples=refs, flight=flight)
+server.attach_pilot(pilot)
+
+baseline = server.predict(refs[0], timeout=120)
+for s in refs * 2:
+    server.predict(s, timeout=120)
+# the drift verdict fires on the trigger thread; wait for the cycle
+deadline = time.time() + 600
+while time.time() < deadline and pilot.status()["cycle"] == 0:
+    time.sleep(0.2)
+assert pilot.status()["cycle"] == 1, f"no retrain cycle flew: {pilot.status()}"
+pilot.join(timeout=600)
+st = pilot.status()
+assert st["state"] == "cooldown", st
+assert st["pinned_shards"] == [], st  # the cycle released its pins
+after = server.predict(refs[0], timeout=120)  # serving path alive post-cycle
+server.export_prometheus(f"{out}/{scenario}.prom")
+server.stop()
+
+candidate = f"{run_name}-pilot-c1"
+cand_ckpt = os.path.join(ckpt, "logs", candidate, f"{candidate}.mp")
+states = [e["state"] for e in pilot.journal.entries()]
+tail = pilot.journal.last()["detail"]
+ev = read_flight_record(flight_path)
+reloads = [e for e in ev if e.get("kind") == "reload"]
+reload_fails = [e for e in ev if e.get("kind") == "reload_failed"]
+pilot_ev = [e for e in ev if e.get("kind") == "pilot"]
+assert pilot_ev, "pilot cycle left no flight narration"
+
+if scenario == "ok":
+    # full success: the injected train crash was absorbed by the
+    # supervisor's restart (stripped injection), the candidate passed
+    # both canary slices, and the reload swapped weights
+    assert st["last_cycle_ok"] is True and st["failed_cycles"] == 0, st
+    assert states == [
+        "idle", "drift_confirmed", "fine_tuning", "canary",
+        "reloading", "cooldown",
+    ], states
+    assert tail["reason"] == "reloaded", tail
+    assert tail["reference"]["passed"] and tail["window"]["passed"], tail
+    assert os.path.exists(cand_ckpt), cand_ckpt
+    assert os.path.exists(
+        os.path.join(ckpt, "logs", candidate, "config.json")
+    ), "candidate config missing"
+    assert len(reloads) == 1 and not reload_fails, (reloads, reload_fails)
+    # the fine-tune manifest names its lineage (spool window + parent)
+    cand_flight = glob.glob(
+        os.path.join(ckpt, "logs", candidate, "flight.jsonl")
+    )
+    if cand_flight:
+        cev = read_flight_record(cand_flight[0])
+        man = next(e for e in cev if e.get("kind") == "run_start")["manifest"]
+        assert man["fine_tune"]["from_run"] == run_name, man["fine_tune"]
+        assert man["fine_tune"]["shards"], man["fine_tune"]
+    # the drift incident bundle pinned its evidence: per-shard spool
+    # manifests copied INTO the bundle
+    (bundle,) = list_incidents(f"{out}/{scenario}_incidents")
+    copies = glob.glob(os.path.join(bundle, "spool_manifests", "*.json"))
+    assert copies, f"no spool manifest copies in {bundle}"
+    with open(os.path.join(bundle, "drift_report.json")) as f:
+        report = json.load(f)
+    assert report["pinned_shards"], report.get("pinned_shards")
+    print(
+        f"closed-loop smoke (ok): OK (cycle 1 reloaded the candidate "
+        f"despite an injected train crash; canary ref_mae="
+        f"{tail['reference']['candidate_mae']}, "
+        f"{len(copies)} pinned manifests in bundle)"
+    )
+elif scenario == "canary":
+    # the candidate trained fine but the injected regression must be
+    # rejected at the canary gate: no reload, old weights serve on
+    # (the hung-tune wall-clock kill path is unit-tested in
+    # tests/test_pilot.py — a real fine-tune here would need a wall
+    # clock too generous to also prove the kill cheaply)
+    assert st["last_cycle_ok"] is False and st["failed_cycles"] == 1, st
+    assert states[-1] == "cooldown" and "reloading" not in states, states
+    assert tail["reason"] == "canary_regression", tail
+    assert not reloads and not reload_fails, (reloads, reload_fails)
+    for k in baseline:
+        np.testing.assert_array_equal(
+            np.asarray(baseline[k]), np.asarray(after[k])
+        )
+    print(
+        "closed-loop smoke (canary): OK (regressed candidate rejected "
+        "at the canary gate, old weights bit-identical)"
+    )
+elif scenario == "torn":
+    # the pilot canary passed but the checkpoint was torn before the
+    # swap: the RELOAD path's validating loader must reject it and the
+    # old weights keep serving
+    assert st["last_cycle_ok"] is False and st["failed_cycles"] == 1, st
+    assert states[-2:] == ["reloading", "cooldown"], states
+    assert tail["reason"] == "reload_failed", tail
+    assert reload_fails and not reloads, (reloads, reload_fails)
+    for k in baseline:
+        np.testing.assert_array_equal(
+            np.asarray(baseline[k]), np.asarray(after[k])
+        )
+    print(
+        "closed-loop smoke (torn): OK (torn candidate rejected by the "
+        "reload canary, old weights bit-identical)"
+    )
+else:
+    raise SystemExit(f"unknown scenario {scenario!r}")
+EOF
+for SCEN in ok canary torn; do
+    cp -r "$PILOT_DIR/train" "$PILOT_DIR/train_$SCEN"
+done
+PILOT_ENV=(env PYTHONPATH="$PWD" JAX_PLATFORMS=cpu HYDRAGNN_INJECT_DRIFT=5.0
+    HYDRAGNN_PILOT_CANARY_TOL=10.0 HYDRAGNN_PILOT_COOLDOWN_S=120
+    HYDRAGNN_PILOT_TUNE_EPOCHS=1 HYDRAGNN_PILOT_TUNE_BACKOFF_S=0.1)
+"${PILOT_ENV[@]}" HYDRAGNN_INJECT_PILOT_TRAIN_CRASH=1 \
+    python "$PILOT_DIR/driver.py" "$PILOT_DIR" "$PILOT_DIR/train_ok" \
+    "$(ls "$PILOT_DIR"/train_ok/logs/*/flight.jsonl)" ok
+"${PILOT_ENV[@]}" HYDRAGNN_INJECT_PILOT_CANARY_REGRESS=1 \
+    python "$PILOT_DIR/driver.py" "$PILOT_DIR" "$PILOT_DIR/train_canary" \
+    "$(ls "$PILOT_DIR"/train_canary/logs/*/flight.jsonl)" canary
+"${PILOT_ENV[@]}" HYDRAGNN_INJECT_PILOT_TORN_RELOAD=1 \
+    python "$PILOT_DIR/driver.py" "$PILOT_DIR" "$PILOT_DIR/train_torn" \
+    "$(ls "$PILOT_DIR"/train_torn/logs/*/flight.jsonl)" torn
+# the pilot gauges round-trip through the prom textfile to the probe:
+# healthy after the reloaded cycle, degraded (rc 1) after a failed one
+for SCEN in ok canary torn; do
+    rc=0
+    python tools/serve_probe.py --prom "$PILOT_DIR/$SCEN.prom" \
+        --pilot --max-age 3600 --verbose || rc=$?
+    case "$SCEN" in ok) want=0 ;; *) want=1 ;; esac
+    if [ "$rc" -ne "$want" ]; then
+        echo "FAIL: serve_probe --pilot rc=$rc want=$want ($SCEN)"; exit 1
+    fi
+done
+# the fault timeline narrates the cycle (pilot events + the reload)
+python tools/obs_report.py --faults "$PILOT_DIR/ok_flight.jsonl" \
+    | tee "$PILOT_DIR/report.out"
+grep -q "pilot_cycles=1" "$PILOT_DIR/report.out" || {
+    echo "FAIL: obs_report.py did not count the pilot cycle"; exit 1; }
+rm -rf "$PILOT_DIR"
+
 echo "== perf gate (tiny fixed-config bench vs committed baseline) =="
 # fails on a >15% graphs/sec regression (and MFU regression on TPU)
 # against BENCH_CI_BASELINE.json, keyed per backend:device so every CI

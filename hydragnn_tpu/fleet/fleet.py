@@ -262,6 +262,27 @@ class Fleet:
         for r in sorted(targets, key=lambda x: x.name):
             self.router.pause(r.name)
             r.drain(drain_timeout_s)
+            if not r.live:
+                # replica died mid-roll (its queued futures already
+                # failed with the typed dispatch error when it died —
+                # nothing is silently lost): abort the roll with every
+                # remaining replica serving the OLD weights; the
+                # controller's reap path owns the corpse
+                r.undrain()
+                self.router.resume(r.name)
+                self.flight.record(
+                    "fleet_reload",
+                    model=model,
+                    replica=r.name,
+                    ok=False,
+                    error="replica died mid-roll",
+                    aborted_roll=True,
+                )
+                raise ReloadFailed(
+                    f"rolling reload of {model!r} aborted: replica "
+                    f"{r.name} died mid-roll; remaining replicas still "
+                    "serve the previous weights"
+                )
             try:
                 info = r.server.reload(
                     checkpoint, variables=variables, log_dir=log_dir

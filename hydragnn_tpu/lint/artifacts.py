@@ -253,6 +253,7 @@ _INCIDENT_RULE_KINDS = (
     "mfu_drop",
     "loss_spike",
     "nonfinite_burst",
+    "pilot_stuck",
 )
 
 

@@ -499,6 +499,31 @@ class DriftMonitor:
         self._g_pred_rows = g(f"{prefix}.drift.pred_rows")
         self._g_labeled_rows = g(f"{prefix}.drift.labeled_rows")
 
+    def reset(self) -> None:
+        """Drop every live sketch and republish zeroed gauges, keeping
+        the reference window.  Called by the retrain pilot after a
+        successful canary + reload: the sketches accumulated the DRIFTED
+        traffic, and without a reset the same rows would re-breach the
+        threshold forever against the freshly recovered model.  Runs on
+        the pilot's thread while the dispatch thread may be observing —
+        callers quiesce the server (or accept one request's worth of
+        interleaved updates, which the warm-up gate absorbs)."""
+        self._counts = [
+            np.zeros(len(e) + 1, dtype=np.int64) for e in self._edges
+        ]
+        self.moments = RunningMoments(self.num_channels)
+        self._p2 = [
+            {q: P2Quantile(q) for q in self._probes}
+            for _ in range(self.num_channels)
+        ]
+        self._heads = {}
+        self._abs_err = {}
+        self._g_feature_psi.set(0.0)
+        self._g_feature_qshift.set(0.0)
+        self._g_pred_psi.set(0.0)
+        self._g_error_score.set(0.0)
+        self._publish()
+
     # -- ingest (dispatch thread; host-side numpy only) ---------------------
 
     def observe(

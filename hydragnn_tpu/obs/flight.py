@@ -103,6 +103,12 @@ _REQUIRED: Dict[str, tuple] = {
     # pred_drift / error_drift rule kinds): which rule, what the sketch
     # observed vs the threshold, and where the offending spool window is
     "drift": ("rule", "observed", "threshold"),
+    # retrain-pilot transitions (hydragnn_tpu/pilot, docs/RESILIENCE.md
+    # "Closed loop"): every state-machine edge of the continual-learning
+    # loop — which state the pilot entered, in which recovery cycle, and
+    # why — so one flight timeline narrates incident -> fine-tune ->
+    # canary -> reload end to end
+    "pilot": ("state", "cycle"),
 }
 
 # the fault-history subset tools/obs_report.py --faults narrates
@@ -123,6 +129,7 @@ FAULT_KINDS = (
     "drift",
     "fleet_scale",
     "fleet_reload",
+    "pilot",
 )
 
 _MANIFEST_REQUIRED = ("jax_version", "backend", "num_processes")

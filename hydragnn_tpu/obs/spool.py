@@ -141,6 +141,8 @@ class RequestSpool:
         self._overhead_s = 0.0
         # graftsync: guarded-by=spool.RequestSpool._lock
         self._last_window: Dict[str, Any] = {}
+        # graftsync: guarded-by=spool.RequestSpool._lock
+        self._pins: Dict[str, int] = {}
         # crash sweep: an interrupted finalization leaves a dot-dir; no
         # reader consumes those, so reclaim the space up front
         for name in os.listdir(self.root):
@@ -258,8 +260,16 @@ class RequestSpool:
         shards = self._shard_names()
         sizes = {n: self._shard_size(n) for n in shards}
         evicted = []
-        while len(shards) > 1 and sum(sizes.values()) > self.max_bytes:
-            oldest = shards.pop(0)  # LRU == lowest shard number
+        # Eviction candidates: everything but the newest shard, minus
+        # pinned shards (an open drift incident or a running retrain
+        # holds a reference — evicting under it would dangle the
+        # bundle's spool pointer / the fine-tune's input set).
+        evictable = [
+            n for n in shards[:-1] if self._pins.get(n, 0) == 0
+        ]
+        while evictable and sum(sizes.values()) > self.max_bytes:
+            oldest = evictable.pop(0)  # LRU == lowest shard number
+            shards.remove(oldest)
             shutil.rmtree(os.path.join(self.root, oldest), ignore_errors=True)
             sizes.pop(oldest)
             evicted.append(oldest)
@@ -307,8 +317,44 @@ class RequestSpool:
                 "rotations": self._rotations,
                 "evicted": self._evicted,
                 "bytes": total,
+                "pinned": len(self._pins),
                 "overhead_s": round(self._overhead_s, 6),
             }
+
+    # -- pinning -------------------------------------------------------------
+
+    def pin(self, shards: Sequence[str]) -> List[str]:
+        """Ref-count-pin shards against LRU eviction.  Accepts shard
+        basenames or paths; returns the basenames actually pinned
+        (shards that no longer exist are skipped, not errors — the
+        caller learns what survives).  Each ``pin`` must be balanced by
+        one ``unpin`` of the returned names."""
+        with self._lock:
+            existing = set(self._shard_names())
+            pinned = []
+            for s in shards:
+                name = os.path.basename(os.path.normpath(str(s)))
+                if name in existing:
+                    self._pins[name] = self._pins.get(name, 0) + 1
+                    pinned.append(name)
+            return pinned
+
+    def unpin(self, shards: Sequence[str]) -> None:
+        """Release one pin reference per shard; eviction resumes once a
+        shard's count reaches zero.  Over-unpinning is a no-op."""
+        with self._lock:
+            for s in shards:
+                name = os.path.basename(os.path.normpath(str(s)))
+                n = self._pins.get(name, 0)
+                if n <= 1:
+                    self._pins.pop(name, None)
+                else:
+                    self._pins[name] = n - 1
+
+    def pinned(self) -> Dict[str, int]:
+        """Current pin counts by shard basename (copy)."""
+        with self._lock:
+            return dict(self._pins)
 
     # -- introspection -------------------------------------------------------
 

@@ -31,6 +31,11 @@ Rule kinds (:data:`RULE_KINDS`):
   - ``nonfinite_burst`` — registry counter delta between consecutive
                         evaluations reaches the threshold
                         (``train.nonfinite_skipped``)
+  - ``pilot_stuck``   — escalation kind raised directly by the retrain
+                        pilot (:mod:`hydragnn_tpu.pilot`) after K
+                        consecutive failed recovery cycles; never
+                        evaluated by the engine, but its incident
+                        manifests must validate like any other
 
 Firing is **rate-limited** (per-engine cooldown + max incident count)
 and **overhead-budgeted** (a capture is refused once capture time
@@ -74,6 +79,7 @@ RULE_KINDS = (
     "mfu_drop",
     "loss_spike",
     "nonfinite_burst",
+    "pilot_stuck",
 )
 
 #: which rule kinds read a registry metric (vs an observed series)
@@ -259,6 +265,9 @@ class TriggerEngine:
                     rule.name, rule.kind, rule.metric, round(delta, 6),
                     rule.threshold, now, detail={"counter_total": cur},
                 )
+            return None
+        if rule.kind == "pilot_stuck":
+            # raised directly by the retrain pilot, never engine-evaluated
             return None
         # rolling-median series rules: mfu_drop / loss_spike
         dq = self._series.get(rule.metric)
@@ -522,10 +531,15 @@ class IncidentRecorder:
         profile_s: Optional[float] = None,
         overhead_frac: Optional[float] = None,
         clock: Callable[[], float] = time.monotonic,
+        on_close: Optional[Callable[[Incident, str], None]] = None,
     ):
         self.root = root
         self.registry = registry
         self.flight_path = flight_path
+        # called AFTER each incident closes (outside the lock) with
+        # (incident, status) — the server uses it to release spool-shard
+        # pins held for the incident's drift evidence
+        self.on_close = on_close
         if profile_steps is None:
             profile_steps = knobs.get_int("HYDRAGNN_INCIDENT_PROFILE_STEPS", 3)
         if profile_s is None:
@@ -609,6 +623,11 @@ class IncidentRecorder:
             self.closed_ids.append(inc.id)
             if self._open is inc:
                 self._open = None
+        if self.on_close is not None:
+            try:
+                self.on_close(inc, status)
+            except Exception:
+                pass  # a cleanup hook must never fail a close
 
     def finalize(self) -> None:
         """Run teardown (clean or crashed): close any open incident so

@@ -1,0 +1,15 @@
+"""Continual-learning retrain pilot (docs/RESILIENCE.md "Closed loop").
+
+The pilot closes the loop the observability plane opened: a drift
+incident (obs/triggers.py) becomes a supervised fine-tune over the
+pinned request-spool window (obs/spool.py), a canary-gated candidate,
+and a zero-downtime hot reload — or a clean rejection that leaves the
+old weights serving. Every transition is journaled to disk so a
+crashed pilot recovers instead of flapping, and narrated as a
+``pilot`` flight event on the run's one trace timeline.
+"""
+
+from hydragnn_tpu.pilot.journal import PilotJournal
+from hydragnn_tpu.pilot.pilot import PilotConfig, RetrainPilot, PILOT_STATES
+
+__all__ = ["PilotConfig", "PilotJournal", "RetrainPilot", "PILOT_STATES"]

@@ -1,0 +1,126 @@
+"""Crash-safe journal for the retrain pilot's state machine.
+
+One append-only JSONL file (``pilot_journal.jsonl``) records every
+state transition with the cycle number and the consecutive-failure
+counter. The journal is the pilot's durability story:
+
+  - every ``append`` is one line, flushed and fsynced before the
+    in-memory transition is considered committed — a SIGKILL between
+    transitions loses nothing, a SIGKILL mid-write leaves one torn
+    tail line that :meth:`entries` skips;
+  - :meth:`recover` classifies the tail on restart: a RESTING state
+    (``idle`` / ``cooldown`` / ``stuck``) means the previous pilot
+    exited at rest and its counters carry over; a MID-CYCLE state
+    (``drift_confirmed`` / ``fine_tuning`` / ``canary`` /
+    ``reloading``) is the crashed-mid-cycle signature — the new pilot
+    counts that cycle as failed and enters cooldown (or escalates if
+    the failure budget is spent) instead of resuming a half-done
+    retrain against a spool that has moved on.
+
+The journal never decides policy — it reports what it finds and the
+pilot (pilot/pilot.py) applies the recovery rules.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+RESTING_STATES = ("idle", "cooldown", "stuck")
+MID_CYCLE_STATES = ("drift_confirmed", "fine_tuning", "canary", "reloading")
+JOURNAL_NAME = "pilot_journal.jsonl"
+
+
+class PilotJournal:
+    """Append-only transition log; single-writer (the pilot serializes
+    transitions under its own lock), any-reader."""
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+        d = os.path.dirname(self.path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+
+    # -- write --------------------------------------------------------------
+
+    def append(
+        self,
+        state: str,
+        cycle: int,
+        failed_cycles: int,
+        **detail: Any,
+    ) -> Dict[str, Any]:
+        """Durably commit one transition; returns the record written."""
+        record = {
+            "t": time.time(),
+            "state": str(state),
+            "cycle": int(cycle),
+            "failed_cycles": int(failed_cycles),
+        }
+        if detail:
+            record["detail"] = detail
+        line = json.dumps(record)
+        # a kill mid-write leaves a torn tail with NO newline; gluing
+        # the next record onto it would corrupt that record too, so
+        # open in binary append and start on a fresh line when needed
+        with open(self.path, "ab") as f:
+            if f.tell() > 0:
+                with open(self.path, "rb") as r:
+                    r.seek(-1, os.SEEK_END)
+                    torn = r.read(1) != b"\n"
+                if torn:
+                    f.write(b"\n")
+            f.write(line.encode("utf-8") + b"\n")
+            f.flush()
+            os.fsync(f.fileno())
+        return record
+
+    # -- read ---------------------------------------------------------------
+
+    def entries(self) -> List[Dict[str, Any]]:
+        """Every committed record, oldest first. A torn tail line (kill
+        mid-write) parses as nothing and is skipped, not an error."""
+        if not os.path.exists(self.path):
+            return []
+        out: List[Dict[str, Any]] = []
+        with open(self.path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(rec, dict) and "state" in rec:
+                    out.append(rec)
+        return out
+
+    def last(self) -> Optional[Dict[str, Any]]:
+        entries = self.entries()
+        return entries[-1] if entries else None
+
+    # -- restart classification ---------------------------------------------
+
+    def recover(self) -> Dict[str, Any]:
+        """Classify the journal tail for a restarting pilot:
+
+        - ``{"status": "fresh"}`` — no journal, first flight;
+        - ``{"status": "clean", ...}`` — previous pilot exited at rest;
+          the tail's state/cycle/failed_cycles carry over;
+        - ``{"status": "crashed_mid_cycle", ...}`` — the tail is a
+          mid-cycle state: the previous pilot died inside a retrain.
+        """
+        last = self.last()
+        if last is None:
+            return {"status": "fresh"}
+        base = {
+            "state": last["state"],
+            "cycle": int(last.get("cycle", 0)),
+            "failed_cycles": int(last.get("failed_cycles", 0)),
+        }
+        if last["state"] in RESTING_STATES:
+            return {"status": "clean", **base}
+        return {"status": "crashed_mid_cycle", **base}

@@ -1,0 +1,593 @@
+"""The retrain pilot: a fault-tolerant drift -> fine-tune -> canary ->
+hot-reload state machine over one serving stack.
+
+States (one journaled + flight-recorded transition each)::
+
+    idle -> drift_confirmed -> fine_tuning -> canary -> reloading
+         -> cooldown -> idle            (success: drift sketches reset)
+                     -> cooldown        (any failure: old weights serve)
+                     -> stuck           (K consecutive failed cycles)
+
+Fault tolerance is the point, so every stage is allowed to fail and
+none of them can take the serving path down:
+
+  - the fine-tune runs as a CHILD process under the bounded restart
+    supervisor (``resilience/supervisor.py``) with exponential backoff
+    and a hard wall-clock kill (``wall_clock_runner``) for jobs wedged
+    where no in-process watchdog can fire;
+  - the candidate must beat the canary gate on BOTH the held-out
+    reference slice and the drifted spool window before any weight
+    swap is attempted; a regression on either slice rejects it;
+  - the reload itself is the server's canary-gated, rollback-built-in
+    ``reload()`` (or the fleet's ``rolling_reload``) — a torn or
+    non-finite candidate leaves the old weights serving;
+  - a single-retrain lock plus a cooldown window stop retrain storms
+    (drift incidents during cooldown are counted, never acted on);
+  - ``HYDRAGNN_PILOT_STUCK_AFTER`` consecutive failed cycles escalate
+    to a terminal ``stuck`` state and a ``pilot_stuck`` incident —
+    the pilot stops flapping and pages a human;
+  - every transition is committed to the on-disk journal
+    (pilot/journal.py) BEFORE it takes effect, so a pilot killed
+    mid-cycle restarts into a safe state instead of resuming a
+    half-done retrain.
+
+The pilot pins the incident's spool shards for the WHOLE cycle (its
+own pin references, independent of the incident's), so the fine-tune's
+input set cannot be evicted mid-training even after the incident
+bundle closes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from hydragnn_tpu.pilot.journal import JOURNAL_NAME, PilotJournal
+from hydragnn_tpu.resilience import inject
+from hydragnn_tpu.utils import knobs, syncdebug
+
+PILOT_STATES = (
+    "idle",
+    "drift_confirmed",
+    "fine_tuning",
+    "canary",
+    "reloading",
+    "cooldown",
+    "stuck",
+)
+#: Gauge encoding for ``<prefix>.pilot.state`` (serve_probe reads it).
+STATE_CODES = {name: i for i, name in enumerate(PILOT_STATES)}
+
+
+@dataclasses.dataclass
+class PilotConfig:
+    """Pilot policy; every default is the matching HYDRAGNN_PILOT_*
+    knob read at construction (docs/KNOBS.md)."""
+
+    cooldown_s: float = dataclasses.field(
+        default_factory=lambda: knobs.get_float("HYDRAGNN_PILOT_COOLDOWN_S", 60.0)
+    )
+    stuck_after: int = dataclasses.field(
+        default_factory=lambda: knobs.get_int("HYDRAGNN_PILOT_STUCK_AFTER", 3)
+    )
+    tune_attempts: int = dataclasses.field(
+        default_factory=lambda: knobs.get_int("HYDRAGNN_PILOT_TUNE_ATTEMPTS", 2)
+    )
+    tune_backoff_s: float = dataclasses.field(
+        default_factory=lambda: knobs.get_float("HYDRAGNN_PILOT_TUNE_BACKOFF_S", 1.0)
+    )
+    max_wall_s: float = dataclasses.field(
+        default_factory=lambda: knobs.get_float("HYDRAGNN_PILOT_MAX_WALL_S", 600.0)
+    )
+    canary_samples: int = dataclasses.field(
+        default_factory=lambda: knobs.get_int("HYDRAGNN_PILOT_CANARY_SAMPLES", 16)
+    )
+    canary_tol: float = dataclasses.field(
+        default_factory=lambda: knobs.get_float("HYDRAGNN_PILOT_CANARY_TOL", 0.2)
+    )
+    tune_epochs: int = dataclasses.field(
+        default_factory=lambda: knobs.get_int("HYDRAGNN_PILOT_TUNE_EPOCHS", 2)
+    )
+
+
+class RetrainPilot:
+    """One pilot per served model; attach with
+    ``server.attach_pilot(pilot)`` so drift incidents flow in.
+
+    Seams (all injectable for tests): ``tuner(candidate) -> result
+    dict`` replaces the supervised child fine-tune; ``reloader
+    (candidate)`` replaces the hot-reload (defaults to the server's
+    ``reload``, or the fleet's ``rolling_reload`` when ``fleet``/
+    ``fleet_model`` are given); ``clock`` drives cooldown arithmetic.
+    ``async_cycles=False`` runs the whole cycle inline on the notifying
+    thread (tests); the default spawns one worker thread per cycle so
+    the server's dispatch loop never blocks on training.
+    """
+
+    def __init__(
+        self,
+        server,
+        serving_run: str,
+        *,
+        reference_samples: Optional[Sequence] = None,
+        config: Optional[PilotConfig] = None,
+        tuner: Optional[Callable[[str], Dict[str, Any]]] = None,
+        reloader: Optional[Callable[[str], Any]] = None,
+        fleet=None,
+        fleet_model: Optional[str] = None,
+        journal_path: Optional[str] = None,
+        flight=None,
+        clock: Callable[[], float] = time.monotonic,
+        async_cycles: bool = True,
+    ):
+        self.server = server
+        self.serving_run = serving_run
+        self.log_dir = server.log_dir
+        self.reference_samples = list(reference_samples or [])
+        self.config = config or PilotConfig()
+        self.tuner = tuner or self._default_tuner
+        self.reloader = reloader or self._default_reloader
+        self.fleet = fleet
+        self.fleet_model = fleet_model
+        self.flight = flight if flight is not None else server.flight
+        self.clock = clock
+        self.async_cycles = async_cycles
+        # graftsync: thread-safe=appends serialized under _lock; readers skip torn tails
+        self.journal = PilotJournal(
+            journal_path
+            or os.path.join(self.log_dir, serving_run, JOURNAL_NAME)
+        )
+        self._lock = syncdebug.maybe_wrap(
+            threading.RLock(), "pilot.RetrainPilot._lock"
+        )
+        # graftsync: guarded-by=pilot.RetrainPilot._lock
+        self.state = "idle"
+        self.cycle = 0  # graftsync: guarded-by=pilot.RetrainPilot._lock
+        # graftsync: guarded-by=pilot.RetrainPilot._lock
+        self.failed_cycles = 0
+        # graftsync: guarded-by=pilot.RetrainPilot._lock
+        self.suppressed = 0
+        # graftsync: guarded-by=pilot.RetrainPilot._lock
+        self.last_cycle_ok: Optional[bool] = None
+        # graftsync: guarded-by=pilot.RetrainPilot._lock
+        self._cooldown_t0 = 0.0
+        # graftsync: guarded-by=pilot.RetrainPilot._lock
+        self._pins: List[str] = []
+        # graftsync: thread-safe=written by the cycle owner before the worker starts; joined before reuse
+        self._worker: Optional[threading.Thread] = None
+        reg = server.metrics.registry
+        prefix = server.metrics.prefix
+        self._g_state = reg.gauge(f"{prefix}.pilot.state")
+        self._g_last_ok = reg.gauge(f"{prefix}.pilot.last_cycle_ok")
+        self._g_cycles = reg.gauge(f"{prefix}.pilot.cycles")
+        self._g_failed = reg.gauge(f"{prefix}.pilot.failed_cycles")
+        self._g_suppressed = reg.gauge(f"{prefix}.pilot.suppressed")
+        self._g_last_ok.set(-1.0)  # no cycle flown yet
+        self._recover()
+
+    # -- restart recovery ----------------------------------------------------
+
+    def _recover(self) -> None:
+        """Apply the journal's restart classification (journal.py):
+        resting tails carry over; a mid-cycle tail means the previous
+        pilot was killed inside a retrain — count that cycle as failed
+        and land in cooldown (or stuck when the budget is spent). The
+        crashed cycle's pins died with the old process, so there is
+        nothing to release here."""
+        rec = self.journal.recover()
+        with self._lock:
+            if rec["status"] == "fresh":
+                self._transition_locked("idle", reason="fresh")
+                return
+            self.cycle = rec["cycle"]
+            self.failed_cycles = rec["failed_cycles"]
+            if rec["status"] == "clean":
+                if rec["state"] == "stuck":
+                    self._transition_locked("stuck", reason="recovered_stuck")
+                elif rec["state"] == "cooldown":
+                    self._cooldown_t0 = self.clock()
+                    self._transition_locked(
+                        "cooldown", reason="recovered_cooldown"
+                    )
+                else:
+                    self._transition_locked("idle", reason="recovered_idle")
+                return
+            # crashed mid-cycle: the half-done retrain is abandoned, the
+            # interruption counts against the failure budget
+            self.failed_cycles += 1
+            self.last_cycle_ok = False
+            self._g_last_ok.set(0.0)
+            if self.failed_cycles >= self.config.stuck_after:
+                self._escalate_stuck_locked(
+                    f"crashed in {rec['state']} (cycle {rec['cycle']})"
+                )
+            else:
+                self._cooldown_t0 = self.clock()
+                self._transition_locked(
+                    "cooldown",
+                    reason="recovered_after_crash",
+                    crashed_in=rec["state"],
+                )
+
+    # -- transitions ---------------------------------------------------------
+
+    # graftsync: holds=pilot.RetrainPilot._lock
+    def _transition_locked(self, state: str, **detail: Any) -> None:
+        """Commit one transition: journal FIRST (durability), then the
+        in-memory state, the gauges, and the flight narration."""
+        self.journal.append(state, self.cycle, self.failed_cycles, **detail)
+        self.state = state
+        self._g_state.set(float(STATE_CODES[state]))
+        self._g_cycles.set(float(self.cycle))
+        self._g_failed.set(float(self.failed_cycles))
+        if self.flight is not None:
+            self.flight.record(
+                "pilot", state=state, cycle=self.cycle,
+                failed_cycles=self.failed_cycles, **detail,
+            )
+
+    # graftsync: holds=pilot.RetrainPilot._lock
+    def _maybe_leave_cooldown_locked(self) -> None:
+        if (
+            self.state == "cooldown"
+            and self.clock() - self._cooldown_t0 >= self.config.cooldown_s
+        ):
+            self._transition_locked("idle", reason="cooldown_elapsed")
+
+    def poll(self) -> str:
+        """Advance time-driven transitions (cooldown expiry) and return
+        the current state — probes and tests call this."""
+        with self._lock:
+            self._maybe_leave_cooldown_locked()
+            return self.state
+
+    # -- incident intake (server dispatch thread) ----------------------------
+
+    def on_drift_incident(self, incident, verdict) -> bool:
+        """One drift incident arrives (after its evidence bundle is
+        written). Starts a retrain cycle iff the pilot is idle — the
+        single-retrain lock and cooldown hysteresis live here. Returns
+        whether a cycle started."""
+        with self._lock:
+            self._maybe_leave_cooldown_locked()
+            if self.state != "idle":
+                self.suppressed += 1
+                self._g_suppressed.set(float(self.suppressed))
+                if self.flight is not None:
+                    self.flight.record(
+                        "pilot", state=self.state, cycle=self.cycle,
+                        suppressed_incident=getattr(incident, "id", None),
+                        suppressed_total=self.suppressed,
+                    )
+                return False
+            self.cycle += 1
+            cycle = self.cycle
+            # the pilot's OWN pins: the incident's pins release when its
+            # bundle closes, these survive until the cycle ends
+            window = self.server.pin_spool(self._incident_shards(incident))
+            self._pins = window
+            self._transition_locked(
+                "drift_confirmed",
+                rule=verdict.rule,
+                rule_kind=verdict.kind,
+                incident=getattr(incident, "id", None),
+                pinned_shards=window,
+            )
+        if self.async_cycles:
+            self._worker = threading.Thread(
+                target=self._run_cycle, name=f"pilot-cycle-{cycle}",
+                daemon=True,
+            )
+            self._worker.start()
+        else:
+            self._run_cycle()
+        return True
+
+    @staticmethod
+    def _incident_shards(incident) -> List[str]:
+        """The spool shards the incident's drift evidence references
+        (written by the server's ``_attach_drift_evidence``)."""
+        import json
+
+        try:
+            with open(
+                os.path.join(incident.dir, "drift_report.json")
+            ) as f:
+                report = json.load(f)
+            return list(
+                report.get("pinned_shards")
+                or report.get("spool_window", {}).get("shards")
+                or []
+            )
+        except Exception:
+            return []
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        """Wait for an in-flight cycle's worker thread (tests, stop)."""
+        w = self._worker
+        if w is not None and w.is_alive():
+            w.join(timeout)
+
+    # -- one retrain cycle ----------------------------------------------------
+
+    # graftsync: thread-root
+    def _run_cycle(self) -> None:
+        with self._lock:
+            candidate = f"{self.serving_run}-pilot-c{self.cycle}"
+        try:
+            with self._lock:
+                self._transition_locked("fine_tuning", candidate=candidate)
+            try:
+                result = self.tuner(candidate)
+            except Exception as exc:
+                self._fail_cycle(
+                    "fine_tune_error", candidate, error=repr(exc)[-200:]
+                )
+                return
+            if not result or result.get("status") != "completed":
+                self._fail_cycle(
+                    "fine_tune_" + str((result or {}).get("status", "failed")),
+                    candidate,
+                    attempts=(result or {}).get("attempts"),
+                    cause=(result or {}).get("cause"),
+                )
+                return
+            with self._lock:
+                self._transition_locked("canary", candidate=candidate)
+            try:
+                verdict = self._canary(candidate)
+            except Exception as exc:
+                self._fail_cycle(
+                    "canary_error", candidate, error=repr(exc)[-200:]
+                )
+                return
+            if not verdict["ok"]:
+                self._fail_cycle("canary_regression", candidate, **verdict)
+                return
+            if inject.pilot_torn_reload():
+                _tear_checkpoint(self.log_dir, candidate)
+            with self._lock:
+                self._transition_locked(
+                    "reloading", candidate=candidate, **verdict
+                )
+            try:
+                self.reloader(candidate)
+            except Exception as exc:
+                # the reload path's own canary/rollback kept the old
+                # weights serving; the pilot just records the rejection
+                self._fail_cycle(
+                    "reload_failed", candidate, error=repr(exc)[-200:]
+                )
+                return
+            # a fresh model must not re-trip the drift rules on sketch
+            # mass the OLD weights accumulated
+            self.server.reset_drift()
+            with self._lock:
+                self.failed_cycles = 0
+                self.last_cycle_ok = True
+                self._g_last_ok.set(1.0)
+                self._cooldown_t0 = self.clock()
+                self._transition_locked(
+                    "cooldown", reason="reloaded", candidate=candidate,
+                    **verdict,
+                )
+        finally:
+            with self._lock:
+                pins, self._pins = self._pins, []
+            if pins:
+                self.server.unpin_spool(pins)
+
+    def _fail_cycle(self, reason: str, candidate: str, **detail: Any) -> None:
+        with self._lock:
+            self.failed_cycles += 1
+            self.last_cycle_ok = False
+            self._g_last_ok.set(0.0)
+            if self.failed_cycles >= self.config.stuck_after:
+                self._escalate_stuck_locked(reason, candidate=candidate, **detail)
+                return
+            self._cooldown_t0 = self.clock()
+            self._transition_locked(
+                "cooldown", reason=reason, candidate=candidate, **detail
+            )
+
+    # graftsync: holds=pilot.RetrainPilot._lock
+    def _escalate_stuck_locked(self, reason: str, **detail: Any) -> None:
+        """Terminal state: persistent drift the loop cannot fix. The
+        pilot stops retrying (a human must intervene) and raises a
+        ``pilot_stuck`` incident bundle as the page."""
+        self._transition_locked("stuck", reason=reason, **detail)
+        from hydragnn_tpu.obs.triggers import TriggerVerdict
+
+        verdict = TriggerVerdict(
+            rule="pilot",
+            kind="pilot_stuck",
+            metric=f"{self.server.metrics.prefix}.pilot.failed_cycles",
+            observed=float(self.failed_cycles),
+            threshold=float(self.config.stuck_after),
+            fired_t=time.time(),
+            detail={"reason": reason},
+        )
+        try:
+            self.server.open_pilot_incident(verdict)
+        except Exception:
+            pass  # the journal + flight event remain the escalation record
+
+    # -- default fine-tune launcher ------------------------------------------
+
+    def _default_tuner(self, candidate: str) -> Dict[str, Any]:
+        """Supervised child fine-tune: ``python -m hydragnn_tpu.pilot.
+        tune`` under the bounded restart supervisor with the hard
+        wall-clock runner — crash-class exits retry with exponential
+        backoff up to ``tune_attempts``, a wedged child is killed after
+        ``max_wall_s`` and classified hung."""
+        from hydragnn_tpu.resilience.supervisor import (
+            Supervisor,
+            SupervisorPolicy,
+            wall_clock_runner,
+        )
+
+        spool = self.server.spool_dir()
+        argv = [
+            sys.executable, "-m", "hydragnn_tpu.pilot.tune",
+            "--log-dir", self.log_dir,
+            "--serving-run", self.serving_run,
+            "--candidate", candidate,
+            "--epochs", str(self.config.tune_epochs),
+        ]
+        if spool:
+            argv += ["--spool-dir", spool]
+        with self._lock:
+            pins = list(self._pins)
+        if pins:
+            argv += ["--shards", ",".join(pins)]
+        policy = SupervisorPolicy(
+            max_restarts=self.config.tune_attempts,
+            backoff_base_s=self.config.tune_backoff_s,
+        )
+        sup = Supervisor(
+            argv,
+            policy=policy,
+            env=dict(os.environ),
+            runner=wall_clock_runner(self.config.max_wall_s),
+        )
+        return sup.run()
+
+    # -- canary gate ----------------------------------------------------------
+
+    def _default_reloader(self, candidate: str):
+        if self.fleet is not None:
+            return self.fleet.rolling_reload(
+                self.fleet_model, candidate, log_dir=self.log_dir
+            )
+        return self.server.reload(candidate, log_dir=self.log_dir)
+
+    def _canary(self, candidate: str) -> Dict[str, Any]:
+        """Score serving weights vs the candidate on the held-out
+        reference slice AND the pinned drifted window; the candidate
+        must stay within ``canary_tol`` of baseline on BOTH. The
+        absolute ``+ tol`` headroom matters on the drifted window,
+        whose targets are the old weights' own predictions (baseline
+        MAE ~0 by construction)."""
+        from hydragnn_tpu.serve.registry import load_served_variables
+
+        cand_vars = load_served_variables(
+            self.server.served, candidate, self.log_dir
+        )
+        cand_vars = self.server.partitioner.shard_variables(cand_vars)
+        base_vars = self.server.served.variables
+        tol = self.config.canary_tol
+        inflate = 1e6 if inject.pilot_canary_regress() else 0.0
+        slices = {
+            "reference": list(self.reference_samples),
+            "window": self._window_samples(),
+        }
+        out: Dict[str, Any] = {"ok": True}
+        for name, samples in slices.items():
+            if not samples:
+                out[name] = None
+                continue
+            base = self._score(base_vars, samples)
+            cand = self._score(cand_vars, samples) + inflate
+            passed = bool(cand <= base * (1.0 + tol) + tol)
+            out[name] = {
+                "baseline_mae": round(base, 6),
+                "candidate_mae": round(cand, 6),
+                "passed": passed,
+            }
+            if not passed:
+                out["ok"] = False
+        return out
+
+    def _window_samples(self) -> List[Any]:
+        from hydragnn_tpu.data.container import ContainerDataset
+
+        root = self.server.spool_dir()
+        if not root:
+            return []
+        with self._lock:
+            pins = list(self._pins)
+        out: List[Any] = []
+        for name in pins:
+            try:
+                out.extend(ContainerDataset(os.path.join(root, name)).samples())
+            except Exception:
+                continue  # a shard torn below the pilot is a smaller
+                # window, not a failed canary
+        return out
+
+    def _score(self, variables: Dict[str, Any], samples: Sequence) -> float:
+        """Mean per-sample MAE of ``variables`` over ``samples`` —
+        the eager single-graph path the server's oversize fallback
+        uses, bounded by ``canary_samples``."""
+        from hydragnn_tpu.graph.batch import batch_graphs
+        from hydragnn_tpu.serve.server import request_to_dict
+
+        srv = self.server
+        errs: List[float] = []
+        for s in list(samples)[: self.config.canary_samples]:
+            g = request_to_dict(s)
+            n = int(np.asarray(g["x"]).shape[0])
+            batch = batch_graphs(
+                [g],
+                node_multiple=srv.config.node_multiple,
+                edge_multiple=srv.config.edge_multiple,
+            )
+            batch = srv.partitioner.shard_inference_batch(batch)
+            outs = srv.served.forward(variables, batch)
+            result = srv._slice_result(
+                outs, graph_index=0, node_offset=0, num_nodes=n
+            )
+            errs.append(_sample_mae(result, s))
+        return float(np.mean(errs)) if errs else 0.0
+
+    # -- status ---------------------------------------------------------------
+
+    def status(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "state": self.state,
+                "cycle": self.cycle,
+                "failed_cycles": self.failed_cycles,
+                "suppressed": self.suppressed,
+                "last_cycle_ok": self.last_cycle_ok,
+                "pinned_shards": list(self._pins),
+            }
+
+
+def _sample_mae(result: Dict[str, np.ndarray], sample) -> float:
+    """MAE of one predicted result dict against the sample's targets
+    (graph heads + node heads, whichever the sample carries)."""
+    gts = getattr(sample, "graph_targets", None) or {}
+    nts = getattr(sample, "node_targets", None) or {}
+    diffs: List[float] = []
+    for name, pred in result.items():
+        p = np.asarray(pred, dtype=np.float64).reshape(-1)
+        if name in gts:
+            t = np.asarray(gts[name], dtype=np.float64).reshape(-1)
+        elif name in nts:
+            t = np.asarray(nts[name], dtype=np.float64).reshape(-1)
+        else:
+            continue
+        if t.size == p.size and p.size:
+            diffs.append(float(np.mean(np.abs(p - t))))
+    return float(np.mean(diffs)) if diffs else 0.0
+
+
+def _tear_checkpoint(log_dir: str, candidate: str) -> None:
+    """HYDRAGNN_INJECT_PILOT_TORN_RELOAD: truncate the candidate's
+    checkpoint after the pilot canary passed, so the RELOAD path's own
+    validating loader + canary must reject it (proving any reload
+    failure leaves the old weights serving)."""
+    path = os.path.join(log_dir, candidate, f"{candidate}.mp")
+    try:
+        size = os.path.getsize(path)
+        with open(path, "r+b") as f:
+            f.truncate(max(size // 2, 1))
+    except OSError:
+        pass

@@ -66,6 +66,27 @@ poison hunt):
                                          spool path; obs/drift.py)
   =====================================  ======================================
 
+Retrain-pilot faults (hydragnn_tpu/pilot, docs/RESILIENCE.md "Closed
+loop") — one per pilot stage, each proving the loop degrades to "old
+weights keep serving" instead of making serving worse:
+
+  =====================================  ======================================
+  HYDRAGNN_INJECT_PILOT_TRAIN_CRASH=N    the pilot's first N fine-tune attempts
+                                         exit nonzero before training (N=1:
+                                         retry-with-backoff then success; N >=
+                                         the attempt budget: failed cycle)
+  HYDRAGNN_INJECT_PILOT_HUNG_TUNE=S      the fine-tune job wedges S seconds
+                                         before any work (the supervisor
+                                         wall-clock kill classifies hung/79)
+  HYDRAGNN_INJECT_PILOT_CANARY_REGRESS   inflate the candidate's canary scores
+  =1                                     so the gate rejects it (cooldown on
+                                         the old weights, never a reload)
+  HYDRAGNN_INJECT_PILOT_TORN_RELOAD=1    corrupt the candidate's weights
+                                         between the pilot canary and the
+                                         reload (the server's own reload
+                                         canary must reject them)
+  =====================================  ======================================
+
 Step numbers are process-local dispatch counts (0-based, counted by
 ``TrainHooks``), so injections are deterministic regardless of resume
 state.
@@ -246,6 +267,38 @@ def serve_torn_reload() -> bool:
     """Whether ModelServer.reload should corrupt the candidate weights
     before the canary (torn-reload injection)."""
     return _spec("HYDRAGNN_INJECT_SERVE_TORN_RELOAD") is not None
+
+
+def pilot_train_crashes() -> int:
+    """How many of the pilot's fine-tune attempts must crash before one
+    is allowed to run (0 = none injected). Consumed per ATTEMPT by the
+    pilot's tune launcher, which counts attempts itself — the child
+    process may never even start, so a module latch cannot work here."""
+    spec = _spec("HYDRAGNN_INJECT_PILOT_TRAIN_CRASH")
+    return int(spec) if spec is not None else 0
+
+
+def maybe_pilot_hang() -> None:
+    """Wedge the fine-tune job for the injected number of seconds
+    before it does any work — the supervisor-level wall clock (not the
+    in-process watchdog, which never sees a pre-work hang) must kill
+    and classify it."""
+    spec = _spec("HYDRAGNN_INJECT_PILOT_HUNG_TUNE")
+    if spec is not None:
+        time.sleep(float(spec))
+
+
+def pilot_canary_regress() -> bool:
+    """Whether the pilot's canary scorer should inflate the CANDIDATE's
+    scores so the gate rejects it."""
+    return _spec("HYDRAGNN_INJECT_PILOT_CANARY_REGRESS") is not None
+
+
+def pilot_torn_reload() -> bool:
+    """Whether the pilot should corrupt the candidate weights between
+    its canary gate and the hot reload (the server's own reload canary
+    is then the last line of defense, and must hold)."""
+    return _spec("HYDRAGNN_INJECT_PILOT_TORN_RELOAD") is not None
 
 
 def strip_injection_env(env: dict) -> dict:

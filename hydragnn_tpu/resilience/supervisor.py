@@ -51,6 +51,39 @@ from hydragnn_tpu.resilience.preempt import (
 FAIL_FAST_CAUSES = frozenset({"config_error", "rollback_exhausted"})
 
 
+def wall_clock_runner(
+    max_wall_s: float, *, grace_s: float = 5.0, popen=subprocess.Popen
+) -> Callable[[Sequence[str], Dict[str, str]], int]:
+    """A ``runner`` that enforces a supervisor-level hard wall clock.
+
+    The in-process watchdog (``resilience/watchdog.py``) only fires when
+    the child's Python interpreter is still scheduling threads; a child
+    wedged inside a C extension, a stuck collective, or a full device
+    queue never reaches it.  This runner is the outer belt: ``Popen`` +
+    ``wait(max_wall_s)``, then SIGTERM, ``grace_s`` to die, SIGKILL —
+    and the timeout is REPORTED as :data:`EXIT_HUNG` (79) so
+    :func:`classify_exit` sees ``hung`` and the policy retries with
+    backoff instead of treating the kill signal as a fresh crash class.
+    ``popen`` is a seam for tests."""
+    if max_wall_s <= 0:
+        raise ValueError(f"max_wall_s must be > 0, got {max_wall_s}")
+
+    def _run(argv: Sequence[str], env: Dict[str, str]) -> int:
+        proc = popen(list(argv), env=env)
+        try:
+            return int(proc.wait(timeout=max_wall_s))
+        except subprocess.TimeoutExpired:
+            proc.terminate()
+            try:
+                proc.wait(timeout=grace_s)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+            return EXIT_HUNG
+
+    return _run
+
+
 def classify_exit(returncode: int) -> str:
     """Exit cause from a child's return code (negative = signal death,
     which subprocess reports for SIGKILL etc.)."""

@@ -369,6 +369,17 @@ class ModelServer:
         self.obs_arming = {"spool": {"enabled": False}, "drift": {"armed": False}}
         # graftsync: thread-safe=written once in start() before the dispatch thread spawns
         self._t_started = 0.0
+        # retrain pilot (pilot/pilot.py), attached via attach_pilot()
+        # graftsync: thread-safe=written once by attach_pilot() before traffic flows; the dispatch thread only reads the reference
+        self._pilot = None
+        self._pin_lock = syncdebug.maybe_wrap(
+            threading.Lock(), "server.ModelServer._pin_lock"
+        )
+        # spool shards pinned per open incident id (released by the
+        # recorder's on_close hook) — no incident bundle may point at
+        # traffic the spool has already evicted
+        # graftsync: guarded-by=server.ModelServer._pin_lock
+        self._incident_pins: Dict[str, List[str]] = {}
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -517,6 +528,7 @@ class ModelServer:
                 or os.path.join(self.log_dir, "serve", "incidents"),
                 registry=self.metrics.registry,
                 flight_path=self.flight.path,
+                on_close=self._on_incident_close,
             )
         self._supervisor = DispatchSupervisor(
             self._run,
@@ -1195,6 +1207,14 @@ class ModelServer:
                         "feature_drift", "pred_drift", "error_drift"
                     ):
                         self._attach_drift_evidence(opened, verdict)
+                        if self._pilot is not None:
+                            # the pilot must never take the dispatch
+                            # thread down — its own state machine owns
+                            # failure handling past this handoff
+                            try:
+                                self._pilot.on_drift_incident(opened, verdict)
+                            except Exception as exc:
+                                self.flight.error(exc, where="pilot_notify")
                     opened.tick()  # start the capture on this batch
         except Exception as exc:
             self.flight.error(exc, where="trigger_engine")
@@ -1203,12 +1223,46 @@ class ModelServer:
         """A drift breach must be self-diagnosing: write the full drift
         report + the offending spool window into the incident bundle as
         ``drift_report.json`` and narrate the breach as a ``drift``
-        flight event."""
+        flight event. The window's shards are PINNED against spool
+        eviction until the incident closes (released in
+        ``_on_incident_close``) and each pinned shard's
+        ``spool_manifest.json`` is copied into the bundle under
+        ``spool_manifests/`` — the evidence stands on its own even after
+        the spool eventually reclaims the data."""
         from hydragnn_tpu.obs.triggers import _atomic_json
 
         report = self._drift.report() if self._drift is not None else {}
-        window = self._spool.window() if self._spool is not None else {}
+        window: Dict[str, Any] = {}
+        if self._spool is not None:
+            # the traffic that tripped the rule is mostly still in the
+            # OPEN pending shard; cut it now so the window (and the pins
+            # below) cover the offending samples, not just older shards
+            self._spool.flush_pending()
+            window = self._spool.window()
+        pinned: List[str] = []
+        if self._spool is not None and window.get("shards"):
+            pinned = self._spool.pin(window["shards"])
+            with self._pin_lock:
+                self._incident_pins[opened.id] = list(pinned)
+            if pinned:
+                from hydragnn_tpu.obs.spool import read_shard_manifest
+
+                mdir = os.path.join(opened.dir, "spool_manifests")
+                os.makedirs(mdir, exist_ok=True)
+                for name in pinned:
+                    try:
+                        man = read_shard_manifest(
+                            os.path.join(window["dir"], name)
+                        )
+                    except Exception:
+                        continue  # unreadable manifest; the pin still
+                        # holds the shard itself for the capture window
+                    _atomic_json(os.path.join(mdir, f"{name}.json"), man)
+                    opened.files[f"spool_manifest/{name}"] = os.path.join(
+                        "spool_manifests", f"{name}.json"
+                    )
         report["spool_window"] = window
+        report["pinned_shards"] = pinned
         report["trigger"] = verdict.to_dict()
         _atomic_json(os.path.join(opened.dir, "drift_report.json"), report)
         opened.files["drift_report"] = "drift_report.json"
@@ -1220,7 +1274,60 @@ class ModelServer:
             observed=verdict.observed,
             threshold=verdict.threshold,
             spool_window=window,
+            pinned_shards=pinned,
         )
+
+    def _on_incident_close(self, inc, status: str) -> None:
+        """IncidentRecorder close hook: release the spool pins taken for
+        the incident's drift evidence. A retrain pilot holds its OWN
+        pins across a fine-tune cycle, so an incident closing mid-tune
+        cannot evict the training window out from under it."""
+        with self._pin_lock:
+            pinned = self._incident_pins.pop(inc.id, None)
+        if pinned and self._spool is not None:
+            self._spool.unpin(pinned)
+
+    # -- retrain pilot seam ------------------------------------------------
+
+    def attach_pilot(self, pilot) -> None:
+        """Attach a retrain pilot (``pilot/pilot.py``): every drift
+        incident the trigger engine opens is forwarded to
+        ``pilot.on_drift_incident(incident, verdict)`` right after its
+        evidence bundle (drift report + pinned spool window) lands."""
+        self._pilot = pilot
+
+    def pin_spool(self, shards) -> List[str]:
+        """Ref-count-pin spool shards against eviction; returns the
+        names actually pinned (``[]`` when no spool is armed)."""
+        if self._spool is None:
+            return []
+        return self._spool.pin(shards)
+
+    def unpin_spool(self, shards) -> None:
+        if self._spool is not None:
+            self._spool.unpin(shards)
+
+    def spool_dir(self) -> Optional[str]:
+        return self._spool.root if self._spool is not None else None
+
+    def reset_drift(self) -> None:
+        """Drop the drift monitor's accumulated sketches (reference
+        intact). The pilot calls this after a successful hot reload so
+        the drift rules re-arm against the NEW weights' behaviour
+        instead of re-firing on pre-reload sketch mass."""
+        if self._drift is not None:
+            self._drift.reset()
+
+    def open_pilot_incident(self, verdict):
+        """Best-effort escalation bundle for a terminal pilot state.
+        The recorder keeps one incident open at a time — when a capture
+        is already running this returns None and the pilot's flight
+        event is the escalation record."""
+        if self._incidents is None:
+            return None
+        # the dispatch loop's inc.tick() drives the bundle's bounded
+        # capture and close exactly like any rule-fired incident
+        return self._incidents.open_incident(verdict, flight=self.flight)
 
     def export_trace(self, path: str) -> Optional[str]:
         """Dump the tracer's recent-request ring as Chrome/Perfetto

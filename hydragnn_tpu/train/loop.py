@@ -521,6 +521,7 @@ def train_validate_test(
     flight=None,
     run_config=None,
     partitioner=None,
+    manifest_extra=None,
 ) -> Tuple[TrainState, Dict[str, Any]]:
     """Train for ``Training.num_epoch`` epochs with validation-driven LR
     plateau + early stopping; returns (final_state, history dict). ``config``
@@ -536,7 +537,10 @@ def train_validate_test(
     data-wait / dispatch / device step-time decomposition and compile
     counts, and a final summary. Callers may pass their own ``flight``
     recorder (bench harnesses) and ``run_config`` (the full resolved
-    config for the manifest; defaults to the NeuralNetwork section).
+    config for the manifest; defaults to the NeuralNetwork section);
+    ``manifest_extra`` merges extra caller keys into the run_start
+    manifest (the retrain pilot's fine-tune child stamps its
+    provenance there — pilot/tune.py).
 
     ``partitioner`` (hydragnn_tpu/parallel/partitioner.py) is the run's
     sharding authority: the scan-epoch auto-dispatch trusts its
@@ -1100,6 +1104,10 @@ def train_validate_test(
             # traffic against (obs/drift.py load_reference reads it
             # straight out of this flight record)
             "stats": stats_block,
+            # caller-stamped provenance (e.g. the retrain pilot's
+            # fine-tune child marks which serving run + spool window it
+            # trained from — pilot/tune.py)
+            **(manifest_extra or {}),
         }
     )
     if resumed_from is not None:

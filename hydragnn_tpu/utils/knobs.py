@@ -149,6 +149,23 @@ KNOBS: Dict[str, Knob] = {
         _K("HYDRAGNN_INJECT_NAN_STEP", "spec", None, "resilience/inject.py",
            "N[:M]: replace node features with NaN for train steps "
            "N..N+M-1 (drives the non-finite sentry)."),
+        _K("HYDRAGNN_INJECT_PILOT_CANARY_REGRESS", "flag", None,
+           "resilience/inject.py",
+           "Inflate the retrain candidate's canary scores so the gate "
+           "rejects it (the pilot must cool down on the old weights)."),
+        _K("HYDRAGNN_INJECT_PILOT_HUNG_TUNE", "spec", None,
+           "resilience/inject.py",
+           "S: the pilot's fine-tune job wedges for S seconds before "
+           "doing any work (drives the supervisor wall-clock kill)."),
+        _K("HYDRAGNN_INJECT_PILOT_TORN_RELOAD", "flag", None,
+           "resilience/inject.py",
+           "Corrupt the retrain candidate's weights between canary and "
+           "reload (the server's own reload canary must reject them)."),
+        _K("HYDRAGNN_INJECT_PILOT_TRAIN_CRASH", "spec", None,
+           "resilience/inject.py",
+           "N: the pilot's first N fine-tune attempts exit nonzero "
+           "before training (N=1 proves retry-with-backoff; N >= the "
+           "attempt budget proves the failed-cycle path)."),
         _K("HYDRAGNN_INJECT_SERVE_KILL_DISPATCH", "spec", None,
            "resilience/inject.py",
            "K: the K-th dispatched serve batch raises outside request "
@@ -197,6 +214,32 @@ KNOBS: Dict[str, Knob] = {
            "Kernel dispatch: auto = Pallas on TPU for sorted 128-lane "
            "data; 1 = force on TPU; interpret = interpret mode anywhere "
            "(CPU tests); 0 = force XLA."),
+        _K("HYDRAGNN_PILOT_CANARY_SAMPLES", "int", "16", "pilot/pilot.py",
+           "Per-slice sample bound for the canary eval (reference slice "
+           "and drifted window each score at most this many samples)."),
+        _K("HYDRAGNN_PILOT_CANARY_TOL", "float", "0.2", "pilot/pilot.py",
+           "Allowed fractional MAE regression of the retrain candidate "
+           "vs the serving weights on EACH canary slice; worse than "
+           "baseline*(1+tol) on either slice rejects the candidate."),
+        _K("HYDRAGNN_PILOT_COOLDOWN_S", "float", "60", "pilot/pilot.py",
+           "Hysteresis window after any retrain cycle (success or "
+           "failure) during which new drift incidents are counted but "
+           "never start another cycle — the anti-storm belt."),
+        _K("HYDRAGNN_PILOT_MAX_WALL_S", "float", "600", "pilot/pilot.py",
+           "Hard wall clock per fine-tune attempt; a hung job is killed "
+           "and classified hung/79 by the supervisor wall-clock runner."),
+        _K("HYDRAGNN_PILOT_STUCK_AFTER", "int", "3", "pilot/pilot.py",
+           "Consecutive failed recovery cycles before the pilot stops "
+           "flapping and escalates a terminal pilot_stuck incident."),
+        _K("HYDRAGNN_PILOT_TUNE_ATTEMPTS", "int", "2", "pilot/pilot.py",
+           "Crash-class restart budget for one cycle's fine-tune job "
+           "(the supervisor's max_restarts)."),
+        _K("HYDRAGNN_PILOT_TUNE_BACKOFF_S", "float", "1.0", "pilot/pilot.py",
+           "Base of the exponential backoff between fine-tune restart "
+           "attempts within one cycle."),
+        _K("HYDRAGNN_PILOT_TUNE_EPOCHS", "int", "2", "pilot/tune.py",
+           "Epochs the incremental fine-tune runs over the pinned spool "
+           "window (starting from the serving checkpoint)."),
         _K("HYDRAGNN_RESIDENCY_VMEM_MB", "float", "12", "ops/fused_conv.py",
            "VMEM budget the cross-layer resident conv-stack kernel may "
            "claim (a TPU core has ~16 MB; the pipeline needs headroom)."),

@@ -447,6 +447,61 @@ def test_kill_then_controller_restores_capacity(flagship, exec_cache):
         assert isinstance(fleet.predict(samples[1], timeout=60), dict)
 
 
+def test_rolling_reload_aborts_when_replica_dies_mid_roll(
+    flagship, exec_cache, tmp_path
+):
+    """A replica that dies mid-roll aborts the roll: the fleet ends
+    READY on the OLD weights with zero lost futures (the corpse's
+    queued work failed typed when it died), and the abort is narrated
+    as a ``fleet_reload`` flight event with ``aborted_roll``."""
+    from hydragnn_tpu.obs.flight import FlightRecorder, read_flight_record
+    from hydragnn_tpu.serve.server import ReloadFailed
+
+    served, variables, samples = flagship
+    flight_path = str(tmp_path / "flight.jsonl")
+    with Fleet(
+        exec_cache_dir=exec_cache, flight=FlightRecorder(flight_path)
+    ) as fleet:
+        fleet.add_model("m", served, samples, _serve_cfg(), replicas=2)
+        # the roll visits replicas in name order: kill the first so the
+        # abort fires before ANY replica swapped weights
+        victim = sorted(fleet.replicas(), key=lambda r: r.name)[0]
+        before = fleet.predict(samples[0], timeout=60)
+        victim.kill()
+        futures = [fleet.submit(s) for s in samples[:6]]
+        with pytest.raises(ReloadFailed, match="died mid-roll"):
+            fleet.rolling_reload("m", variables=dict(variables), drain_timeout_s=5.0)
+        # zero lost futures: everything submitted resolves (result or
+        # typed failure), nothing hangs
+        resolved = 0
+        for f in futures:
+            try:
+                f.result(timeout=60)
+                resolved += 1
+            except RequestFailed:
+                resolved += 1
+        assert resolved == len(futures)
+        # the survivor still serves the previous weights
+        h = fleet.health()
+        assert h["ready_count"] >= 1
+        after = fleet.predict(samples[0], timeout=60)
+        for key in before:
+            np.testing.assert_array_equal(
+                np.asarray(before[key]), np.asarray(after[key])
+            )
+        events = read_flight_record(flight_path)
+        aborts = [
+            e
+            for e in events
+            if e.get("kind") == "fleet_reload" and e.get("aborted_roll")
+        ]
+        assert [e["replica"] for e in aborts] == [victim.name]
+        assert not any(
+            e.get("kind") == "fleet_reload" and e.get("ok")
+            for e in events
+        ), "no replica may swap weights on an aborted roll"
+
+
 def test_rolling_reload_is_bit_identical_for_same_weights(flagship, exec_cache):
     served, variables, samples = flagship
     with Fleet(exec_cache_dir=exec_cache) as fleet:

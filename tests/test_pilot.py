@@ -1,0 +1,754 @@
+"""Retrain-pilot tests (hydragnn_tpu/pilot): the crash-safe journal
+(torn tails, SIGKILL resume classification), the drift -> fine-tune ->
+canary -> reload state machine over injected tuner/reloader seams,
+storm hysteresis (cooldown + single-retrain lock), escalation to the
+terminal ``stuck`` state after K failed cycles, spool pinning across a
+cycle, the probe/gauge contract serve_probe reads, and the fine-tune
+child's split/scoring units.
+
+Everything here runs against a fake server + fake clock so the state
+machine is exercised exhaustively without training anything; the real
+closed loop is driven end-to-end by ci.sh's pilot smoke stage."""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from hydragnn_tpu.obs.flight import FlightRecorder, read_flight_record
+from hydragnn_tpu.obs.registry import MetricsRegistry
+from hydragnn_tpu.obs.spool import RequestSpool, list_shards
+from hydragnn_tpu.obs.triggers import TriggerVerdict
+from hydragnn_tpu.pilot import (
+    PILOT_STATES,
+    PilotConfig,
+    PilotJournal,
+    RetrainPilot,
+)
+from hydragnn_tpu.pilot.journal import (
+    JOURNAL_NAME,
+    MID_CYCLE_STATES,
+    RESTING_STATES,
+)
+from hydragnn_tpu.pilot.pilot import STATE_CODES, _sample_mae
+from hydragnn_tpu.pilot.tune import _split
+
+
+class FakeClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class FakeMetrics:
+    def __init__(self):
+        self.registry = MetricsRegistry(enabled=True)
+        self.prefix = "serve"
+
+
+class FakeServer:
+    """The slice of ModelServer the pilot talks to, with bookkeeping."""
+
+    def __init__(self, log_dir, flight=None, spool_root=None):
+        self.log_dir = str(log_dir)
+        self.flight = flight
+        self.metrics = FakeMetrics()
+        self._spool_root = spool_root
+        self.pins = []  # currently held pin references
+        self.unpin_calls = []
+        self.drift_resets = 0
+        self.pilot_incidents = []
+
+    def pin_spool(self, shards):
+        names = [os.path.basename(str(s)) for s in shards]
+        self.pins.extend(names)
+        return names
+
+    def unpin_spool(self, shards):
+        self.unpin_calls.append(list(shards))
+        for s in shards:
+            if s in self.pins:
+                self.pins.remove(s)
+
+    def spool_dir(self):
+        return self._spool_root
+
+    def reset_drift(self):
+        self.drift_resets += 1
+
+    def open_pilot_incident(self, verdict):
+        self.pilot_incidents.append(verdict)
+        return None
+
+
+class FakeIncident:
+    def __init__(self, root, report, inc_id="inc-1"):
+        self.id = inc_id
+        self.dir = str(root / inc_id)
+        os.makedirs(self.dir, exist_ok=True)
+        if report is not None:
+            with open(os.path.join(self.dir, "drift_report.json"), "w") as f:
+                json.dump(report, f)
+
+
+def _verdict(kind="feature_drift"):
+    return TriggerVerdict(
+        "serve_feature_drift", kind, "serve.drift.feature_psi", 0.9, 0.25, 1.0
+    )
+
+
+def _incident(tmp_path, shards=("shard-000001",), inc_id="inc-1"):
+    return FakeIncident(
+        tmp_path, {"pinned_shards": list(shards)}, inc_id=inc_id
+    )
+
+
+def _pilot(
+    tmp_path,
+    *,
+    server=None,
+    tuner=None,
+    reloader=None,
+    clock=None,
+    flight=None,
+    canary=None,
+    async_cycles=False,
+    **cfg_kw,
+):
+    server = server or FakeServer(tmp_path / "logs", flight=flight)
+    cfg_kw.setdefault("cooldown_s", 30.0)
+    cfg_kw.setdefault("stuck_after", 3)
+    p = RetrainPilot(
+        server,
+        "run",
+        config=PilotConfig(**cfg_kw),
+        tuner=tuner or (lambda c: {"status": "completed"}),
+        reloader=reloader or (lambda c: {"ok": True}),
+        clock=clock or FakeClock(),
+        async_cycles=async_cycles,
+    )
+    # the real canary needs a served model; the state machine does not
+    p._canary = canary or (lambda c: {"ok": True})
+    return p, server
+
+
+# ---------------------------------------------------------------------------
+# journal: durability + restart classification
+# ---------------------------------------------------------------------------
+
+
+def test_journal_append_entries_roundtrip(tmp_path):
+    j = PilotJournal(str(tmp_path / "j.jsonl"))
+    j.append("idle", 0, 0, reason="fresh")
+    j.append("drift_confirmed", 1, 0, rule="r")
+    j.append("cooldown", 1, 1, reason="canary_regression")
+    entries = j.entries()
+    assert [e["state"] for e in entries] == [
+        "idle", "drift_confirmed", "cooldown",
+    ]
+    assert entries[-1]["cycle"] == 1
+    assert entries[-1]["failed_cycles"] == 1
+    assert entries[-1]["detail"]["reason"] == "canary_regression"
+    assert all("t" in e for e in entries)
+    assert j.last() == entries[-1]
+
+
+def test_journal_skips_torn_tail(tmp_path):
+    """A SIGKILL mid-write leaves one torn line; readers skip it."""
+    path = tmp_path / "j.jsonl"
+    j = PilotJournal(str(path))
+    j.append("idle", 0, 0)
+    j.append("fine_tuning", 1, 0)
+    with open(path, "a") as f:
+        f.write('{"t": 1.0, "state": "can')  # torn mid-record
+    assert [e["state"] for e in j.entries()] == ["idle", "fine_tuning"]
+    assert j.last()["state"] == "fine_tuning"
+
+
+def test_journal_recover_classification(tmp_path):
+    j = PilotJournal(str(tmp_path / "j.jsonl"))
+    assert j.recover() == {"status": "fresh"}
+    for state in RESTING_STATES:
+        j.append(state, 3, 1)
+        rec = j.recover()
+        assert rec["status"] == "clean"
+        assert rec["state"] == state
+        assert (rec["cycle"], rec["failed_cycles"]) == (3, 1)
+    for state in MID_CYCLE_STATES:
+        j.append(state, 4, 1)
+        assert j.recover()["status"] == "crashed_mid_cycle"
+
+
+# ---------------------------------------------------------------------------
+# restart recovery: the SIGKILL-resume contract
+# ---------------------------------------------------------------------------
+
+
+def test_fresh_pilot_starts_idle(tmp_path):
+    p, _ = _pilot(tmp_path)
+    assert p.state == "idle"
+    assert (p.cycle, p.failed_cycles) == (0, 0)
+    # the idle transition was journaled (the NEXT restart is "clean")
+    assert p.journal.last()["state"] == "idle"
+
+
+def test_sigkill_mid_cycle_resumes_into_cooldown(tmp_path):
+    """The crashed-pilot signature: a mid-cycle tail (plus the torn
+    partial line the kill left) recovers into cooldown with the crashed
+    cycle counted against the failure budget — never into resuming the
+    half-done retrain."""
+    jpath = tmp_path / "logs" / "run" / JOURNAL_NAME
+    j = PilotJournal(str(jpath))
+    j.append("drift_confirmed", 2, 0)
+    j.append("fine_tuning", 2, 0, candidate="run-pilot-c2")
+    with open(jpath, "a") as f:
+        f.write('{"t": 9.9, "state": "fi')  # killed mid-append
+    p, _ = _pilot(tmp_path)
+    assert p.state == "cooldown"
+    assert p.cycle == 2
+    assert p.failed_cycles == 1
+    assert p.last_cycle_ok is False
+    tail = p.journal.last()
+    assert tail["detail"]["reason"] == "recovered_after_crash"
+    assert tail["detail"]["crashed_in"] == "fine_tuning"
+
+
+def test_crash_recovery_escalates_when_budget_spent(tmp_path):
+    j = PilotJournal(str(tmp_path / "logs" / "run" / JOURNAL_NAME))
+    j.append("canary", 5, 2)  # two failures already burned
+    p, server = _pilot(tmp_path, stuck_after=3)
+    assert p.state == "stuck"
+    assert p.failed_cycles == 3
+    assert [v.kind for v in server.pilot_incidents] == ["pilot_stuck"]
+    assert server.pilot_incidents[0].observed == 3.0
+
+
+def test_recovered_stuck_stays_stuck(tmp_path):
+    j = PilotJournal(str(tmp_path / "logs" / "run" / JOURNAL_NAME))
+    j.append("stuck", 7, 3)
+    p, _ = _pilot(tmp_path)
+    assert p.state == "stuck"
+    # stuck is terminal: a new incident is suppressed, not flown
+    assert not p.on_drift_incident(_incident(tmp_path), _verdict())
+    assert p.suppressed == 1
+
+
+def test_recovered_cooldown_restamps_clock_then_expires(tmp_path):
+    j = PilotJournal(str(tmp_path / "logs" / "run" / JOURNAL_NAME))
+    j.append("cooldown", 1, 1, reason="canary_regression")
+    clk = FakeClock()
+    p, _ = _pilot(tmp_path, clock=clk, cooldown_s=30.0)
+    assert p.poll() == "cooldown"  # wall time restarts at recovery
+    clk.advance(29.0)
+    assert p.poll() == "cooldown"
+    clk.advance(1.1)
+    assert p.poll() == "idle"
+    assert p.failed_cycles == 1  # the counter survives the rest
+
+
+# ---------------------------------------------------------------------------
+# one cycle through the seams
+# ---------------------------------------------------------------------------
+
+
+def test_successful_cycle_end_to_end(tmp_path):
+    tuned, reloaded = [], []
+    flight = FlightRecorder(str(tmp_path / "flight.jsonl"))
+    server = FakeServer(tmp_path / "logs", flight=flight)
+    p, server = _pilot(
+        tmp_path,
+        server=server,
+        tuner=lambda c: tuned.append(c) or {"status": "completed"},
+        reloader=lambda c: reloaded.append(c),
+    )
+    started = p.on_drift_incident(_incident(tmp_path), _verdict())
+    assert started
+    assert tuned == ["run-pilot-c1"]  # distinct candidate run name
+    assert reloaded == ["run-pilot-c1"]
+    assert server.drift_resets == 1  # fresh weights, fresh sketches
+    assert p.state == "cooldown"
+    assert p.last_cycle_ok is True
+    assert p.failed_cycles == 0
+    # the journal narrates every stage, in order
+    assert [e["state"] for e in p.journal.entries()] == [
+        "idle", "drift_confirmed", "fine_tuning", "canary",
+        "reloading", "cooldown",
+    ]
+    assert p.journal.last()["detail"]["reason"] == "reloaded"
+    # ...and so does the flight record
+    states = [
+        e["state"] for e in read_flight_record(str(tmp_path / "flight.jsonl"))
+        if e["kind"] == "pilot"
+    ]
+    assert states[-1] == "cooldown" and "drift_confirmed" in states
+
+
+def test_storm_hysteresis_suppresses_incidents_in_cooldown(tmp_path):
+    clk = FakeClock()
+    p, server = _pilot(tmp_path, clock=clk, cooldown_s=30.0)
+    assert p.on_drift_incident(_incident(tmp_path), _verdict())
+    assert p.state == "cooldown"
+    # a storm of repeat incidents inside the cooldown window: counted,
+    # never acted on
+    for i in range(3):
+        assert not p.on_drift_incident(
+            _incident(tmp_path, inc_id=f"storm-{i}"), _verdict()
+        )
+    assert p.suppressed == 3
+    assert p.cycle == 1
+    reg = server.metrics.registry
+    assert reg.gauge("serve.pilot.suppressed").value == 3.0
+    # cooldown elapses -> the next incident flies a new cycle
+    clk.advance(31.0)
+    assert p.on_drift_incident(_incident(tmp_path, inc_id="later"), _verdict())
+    assert p.cycle == 2
+
+
+def test_incident_during_running_cycle_is_suppressed(tmp_path):
+    """The single-retrain lock: an incident arriving while the tuner is
+    mid-flight must not start a second cycle."""
+    cell, inner = {}, []
+
+    def tuner(candidate):
+        inner.append(
+            cell["p"].on_drift_incident(
+                _incident(tmp_path, inc_id="inner"), _verdict()
+            )
+        )
+        return {"status": "completed"}
+
+    p, _ = _pilot(tmp_path, tuner=tuner)
+    cell["p"] = p
+    assert p.on_drift_incident(_incident(tmp_path), _verdict())
+    assert inner == [False]
+    assert p.suppressed == 1
+    assert p.cycle == 1
+
+
+def test_tuner_gave_up_lands_cooldown(tmp_path):
+    p, server = _pilot(
+        tmp_path,
+        tuner=lambda c: {"status": "gave_up", "attempts": 3, "cause": "crash"},
+    )
+    p.on_drift_incident(_incident(tmp_path), _verdict())
+    assert p.state == "cooldown"
+    assert p.failed_cycles == 1
+    assert p.last_cycle_ok is False
+    tail = p.journal.last()["detail"]
+    assert tail["reason"] == "fine_tune_gave_up"
+    assert tail["cause"] == "crash"
+    assert server.drift_resets == 0  # old weights, old sketches
+
+
+def test_tuner_exception_lands_cooldown(tmp_path):
+    def tuner(c):
+        raise RuntimeError("supervisor exploded")
+
+    p, _ = _pilot(tmp_path, tuner=tuner)
+    p.on_drift_incident(_incident(tmp_path), _verdict())
+    assert p.state == "cooldown"
+    assert p.journal.last()["detail"]["reason"] == "fine_tune_error"
+
+
+def test_canary_regression_rejects_without_reload(tmp_path):
+    reloaded = []
+    regress = {
+        "ok": False,
+        "reference": {
+            "baseline_mae": 0.1, "candidate_mae": 9.0, "passed": False,
+        },
+        "window": None,
+    }
+    p, server = _pilot(
+        tmp_path, reloader=lambda c: reloaded.append(c),
+        canary=lambda c: dict(regress),
+    )
+    p.on_drift_incident(_incident(tmp_path), _verdict())
+    assert reloaded == []  # never got near the weights
+    assert server.drift_resets == 0
+    assert p.state == "cooldown"
+    tail = p.journal.last()["detail"]
+    assert tail["reason"] == "canary_regression"
+    assert tail["reference"]["passed"] is False
+
+
+def test_reload_failure_keeps_old_weights(tmp_path):
+    from hydragnn_tpu.serve.server import ReloadFailed
+
+    def reloader(c):
+        raise ReloadFailed("canary rejected torn checkpoint")
+
+    p, server = _pilot(tmp_path, reloader=reloader)
+    p.on_drift_incident(_incident(tmp_path), _verdict())
+    assert p.state == "cooldown"
+    assert p.journal.last()["detail"]["reason"] == "reload_failed"
+    assert server.drift_resets == 0  # the old model is still the model
+
+
+def test_escalates_stuck_after_k_failed_cycles(tmp_path):
+    clk = FakeClock()
+    p, server = _pilot(
+        tmp_path,
+        clock=clk,
+        stuck_after=2,
+        cooldown_s=10.0,
+        tuner=lambda c: {"status": "gave_up", "cause": "crash"},
+    )
+    assert p.on_drift_incident(_incident(tmp_path, inc_id="a"), _verdict())
+    assert p.state == "cooldown"
+    clk.advance(11.0)
+    assert p.on_drift_incident(_incident(tmp_path, inc_id="b"), _verdict())
+    assert p.state == "stuck"
+    assert p.failed_cycles == 2
+    # the escalation pages: one pilot_stuck incident verdict
+    assert [v.kind for v in server.pilot_incidents] == ["pilot_stuck"]
+    v = server.pilot_incidents[0]
+    assert v.observed == 2.0 and v.threshold == 2.0
+    # terminal: no amount of waiting re-arms it
+    clk.advance(1000.0)
+    assert not p.on_drift_incident(_incident(tmp_path, inc_id="c"), _verdict())
+    assert p.poll() == "stuck"
+
+
+def test_async_cycle_runs_on_worker_thread(tmp_path):
+    import threading
+
+    seen = []
+    p, _ = _pilot(
+        tmp_path,
+        async_cycles=True,
+        tuner=lambda c: seen.append(threading.current_thread().name)
+        or {"status": "completed"},
+    )
+    assert p.on_drift_incident(_incident(tmp_path), _verdict())
+    p.join(timeout=30.0)
+    assert p.state == "cooldown"
+    assert seen == ["pilot-cycle-1"]  # never the notifying thread
+
+
+# ---------------------------------------------------------------------------
+# spool pinning across a cycle
+# ---------------------------------------------------------------------------
+
+
+def test_pins_held_through_cycle_released_after(tmp_path):
+    held_during_tune = []
+
+    def tuner(c):
+        held_during_tune.append(list(cell["server"].pins))
+        return {"status": "completed"}
+
+    cell = {}
+    p, server = _pilot(tmp_path, tuner=tuner)
+    cell["server"] = server
+    p.on_drift_incident(
+        _incident(tmp_path, shards=("shard-000003", "shard-000004")),
+        _verdict(),
+    )
+    # the fine-tune ran with its input set pinned against eviction...
+    assert held_during_tune == [["shard-000003", "shard-000004"]]
+    # ...and the pins are released once the cycle lands (success path)
+    assert server.pins == []
+    assert server.unpin_calls == [["shard-000003", "shard-000004"]]
+
+
+def test_pins_released_on_failed_cycle_too(tmp_path):
+    def tuner(c):
+        raise RuntimeError("boom")
+
+    p, server = _pilot(tmp_path, tuner=tuner)
+    p.on_drift_incident(_incident(tmp_path, shards=("shard-000009",)), _verdict())
+    assert p.state == "cooldown"
+    assert server.pins == []
+
+
+def test_incident_shards_reads_drift_report(tmp_path):
+    inc = FakeIncident(
+        tmp_path, {"pinned_shards": ["shard-000002"]}, inc_id="pinned"
+    )
+    assert RetrainPilot._incident_shards(inc) == ["shard-000002"]
+    inc = FakeIncident(
+        tmp_path,
+        {"spool_window": {"shards": ["shard-000005", "shard-000006"]}},
+        inc_id="window",
+    )
+    assert RetrainPilot._incident_shards(inc) == [
+        "shard-000005", "shard-000006",
+    ]
+    inc = FakeIncident(tmp_path, None, inc_id="bare")  # no report at all
+    assert RetrainPilot._incident_shards(inc) == []
+
+
+def _toy_samples(n, nodes=64, seed=0):
+    from hydragnn_tpu.data.dataset import GraphSample
+
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        ei = np.stack(
+            [np.arange(nodes), (np.arange(nodes) + 1) % nodes]
+        ).astype(np.int32)
+        out.append(
+            GraphSample(
+                x=rng.normal(size=(nodes, 2)).astype(np.float32),
+                pos=rng.normal(size=(nodes, 3)).astype(np.float32),
+                edge_index=ei,
+                graph_targets={"energy": np.float32(rng.normal())},
+                node_targets={
+                    "forces": rng.normal(size=(nodes, 1)).astype(np.float32)
+                },
+            )
+        )
+    return out
+
+
+def _offer_all(spool, samples, start=0):
+    for i, s in enumerate(samples, start=start):
+        ei = np.asarray(s.edge_index)
+        g = {
+            "x": np.asarray(s.x),
+            "pos": np.asarray(s.pos),
+            "senders": ei[0],
+            "receivers": ei[1],
+        }
+        result = {
+            "energy": np.asarray([0.5], np.float32),
+            "forces": np.zeros((ei.shape[1], 1), np.float32),
+        }
+        spool.offer(g, result, seq=i)
+
+
+def test_spool_pin_blocks_eviction_until_unpin(tmp_path):
+    head_kinds = {"energy": "graph", "forces": "node"}
+    samples = _toy_samples(48)
+    spool = RequestSpool(
+        str(tmp_path / "spool"),
+        sample_every=1,
+        max_mb=0.02,  # ~2 shards' worth: every rotation evicts
+        shard_mb=0.01,
+        head_kinds=head_kinds,
+    )
+    _offer_all(spool, samples[:8])
+    first = spool.flush_pending()
+    assert first is not None
+    assert spool.pin([first]) == [first]
+    _offer_all(spool, samples[8:40], start=8)
+    spool.flush_pending()
+    names = [os.path.basename(s) for s in list_shards(str(tmp_path / "spool"))]
+    assert first in names, "pinned shard was evicted under the pin"
+    assert spool.pinned() == {first: 1}
+    # release the pin: the next eviction pass reclaims it (oldest = LRU)
+    spool.unpin([first])
+    _offer_all(spool, samples[40:], start=40)
+    spool.flush_pending()
+    names = [os.path.basename(s) for s in list_shards(str(tmp_path / "spool"))]
+    assert first not in names
+
+
+def test_spool_pin_refcounts_and_skips_missing(tmp_path):
+    root = tmp_path / "spool"
+    os.makedirs(root / "shard-000001")
+    (root / "shard-000001" / "blob").write_text("x")
+    spool = RequestSpool(str(root), sample_every=1)
+    # a vanished shard is skipped, not an error — the caller learns
+    # what survives from the return value
+    assert spool.pin(["shard-000001", "shard-999999"]) == ["shard-000001"]
+    assert spool.pin([str(root / "shard-000001")]) == ["shard-000001"]  # path ok
+    assert spool.pinned() == {"shard-000001": 2}
+    spool.unpin(["shard-000001"])
+    assert spool.pinned() == {"shard-000001": 1}
+    spool.unpin(["shard-000001"])
+    spool.unpin(["shard-000001"])  # over-unpin is a no-op
+    assert spool.pinned() == {}
+
+
+# ---------------------------------------------------------------------------
+# gauges / probe contract
+# ---------------------------------------------------------------------------
+
+
+def test_gauges_and_status_track_the_machine(tmp_path):
+    p, server = _pilot(
+        tmp_path, tuner=lambda c: {"status": "gave_up", "cause": "hung"}
+    )
+    reg = server.metrics.registry
+    assert reg.gauge("serve.pilot.state").value == STATE_CODES["idle"]
+    assert reg.gauge("serve.pilot.last_cycle_ok").value == -1.0  # no cycle yet
+    p.on_drift_incident(_incident(tmp_path), _verdict())
+    assert reg.gauge("serve.pilot.state").value == STATE_CODES["cooldown"]
+    assert reg.gauge("serve.pilot.last_cycle_ok").value == 0.0
+    assert reg.gauge("serve.pilot.cycles").value == 1.0
+    assert reg.gauge("serve.pilot.failed_cycles").value == 1.0
+    st = p.status()
+    assert st == {
+        "state": "cooldown",
+        "cycle": 1,
+        "failed_cycles": 1,
+        "suppressed": 0,
+        "last_cycle_ok": False,
+        "pinned_shards": [],
+    }
+
+
+def _probe_pilot():
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+    try:
+        import serve_probe
+
+        return serve_probe
+    finally:
+        sys.path.pop(0)
+
+
+def test_serve_probe_state_table_matches_pilot():
+    sp = _probe_pilot()
+    assert tuple(sp._PILOT_STATES) == PILOT_STATES
+    assert sp._PILOT_STUCK == STATE_CODES["stuck"]
+
+
+def test_serve_probe_pilot_exit_codes(tmp_path):
+    sp = _probe_pilot()
+    prom = tmp_path / "serve.prom"
+
+    def write(state, last_ok):
+        prom.write_text(
+            f"hydragnn_serve_pilot_state {state}\n"
+            f"hydragnn_serve_pilot_last_cycle_ok {last_ok}\n"
+        )
+
+    write(STATE_CODES["idle"], -1)
+    rc, msg = sp.probe_pilot(str(prom))
+    assert rc == 0 and "idle" in msg
+    write(STATE_CODES["cooldown"], 1)
+    assert sp.probe_pilot(str(prom))[0] == 0
+    write(STATE_CODES["cooldown"], 0)  # last cycle failed: look at it
+    rc, msg = sp.probe_pilot(str(prom))
+    assert rc == 1 and "failed" in msg
+    write(STATE_CODES["stuck"], 0)
+    rc, msg = sp.probe_pilot(str(prom))
+    assert rc == 1 and "STUCK" in msg
+    prom.write_text("hydragnn_serve_ready 1\n")  # server yes, pilot no
+    assert sp.probe_pilot(str(prom))[0] == 2
+    assert sp.probe_pilot(str(tmp_path / "missing.prom"))[0] == 2
+    write(STATE_CODES["idle"], -1)
+    old = os.stat(prom).st_mtime - 3600
+    os.utime(prom, (old, old))
+    assert sp.probe_pilot(str(prom), max_age_s=60.0)[0] == 2  # stale
+
+
+def test_serve_probe_pilot_cli_requires_prom(tmp_path, capsys):
+    sp = _probe_pilot()
+    prom = tmp_path / "serve.prom"
+    prom.write_text(
+        f"hydragnn_serve_pilot_state {STATE_CODES['idle']}\n"
+        "hydragnn_serve_pilot_last_cycle_ok -1\n"
+    )
+    assert sp.main(["--prom", str(prom), "--pilot"]) == 0
+    assert sp.main(["--fleet", str(tmp_path), "--pilot"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# the hard wall-clock belt around the fine-tune child
+# ---------------------------------------------------------------------------
+
+
+def test_wall_clock_runner_kills_wedged_child():
+    import time
+
+    from hydragnn_tpu.resilience.supervisor import EXIT_HUNG, wall_clock_runner
+
+    runner = wall_clock_runner(0.3, grace_s=5.0)
+    t0 = time.monotonic()
+    rc = runner(
+        [sys.executable, "-c", "import time; time.sleep(60)"],
+        dict(os.environ),
+    )
+    assert rc == EXIT_HUNG
+    assert time.monotonic() - t0 < 30.0  # killed, not waited out
+    # a child that exits on its own reports its OWN code
+    assert (
+        runner([sys.executable, "-c", "raise SystemExit(7)"], dict(os.environ))
+        == 7
+    )
+
+
+def test_supervisor_classifies_wall_clock_kill_as_hung():
+    from hydragnn_tpu.resilience.supervisor import (
+        Supervisor,
+        SupervisorPolicy,
+        wall_clock_runner,
+    )
+
+    sup = Supervisor(
+        [sys.executable, "-c", "import time; time.sleep(60)"],
+        policy=SupervisorPolicy(max_restarts=1, backoff_base_s=0.01),
+        env=dict(os.environ),
+        runner=wall_clock_runner(0.3, grace_s=5.0),
+    )
+    out = sup.run()
+    assert out["status"] == "gave_up"
+    assert out["cause"] == "hung"
+    assert out["attempts"] == 2  # retried once with backoff, then gave up
+
+
+# ---------------------------------------------------------------------------
+# fine-tune child units
+# ---------------------------------------------------------------------------
+
+
+def test_split_deterministic_and_never_empty():
+    train, val, test = _split(list(range(24)))
+    assert len(train) == 20 and len(val) == 2 and len(test) == 2
+    assert set(train) | set(val) | set(test) == set(range(24))
+    # tiny windows backfill from train rather than starving a loader
+    train, val, test = _split([0, 1, 2])
+    assert len(train) == 1 and len(val) == 1 and len(test) == 1
+    with pytest.raises(ValueError):
+        _split([0, 1])
+
+
+def test_sample_mae_matches_numpy():
+    from hydragnn_tpu.data.dataset import GraphSample
+
+    n = 5
+    sample = GraphSample(
+        x=np.zeros((n, 2), np.float32),
+        pos=np.zeros((n, 3), np.float32),
+        edge_index=np.zeros((2, n), np.int32),
+        graph_targets={"energy": np.asarray([1.0], np.float32)},
+        node_targets={"forces": np.zeros((n, 1), np.float32)},
+    )
+    result = {
+        "energy": np.asarray([1.5]),
+        "forces": np.full((n, 1), 0.25),
+        "mystery": np.asarray([9.9]),  # no matching target: skipped
+    }
+    want = np.mean([0.5, 0.25])
+    assert _sample_mae(result, sample) == pytest.approx(want)
+    # no overlapping heads -> 0.0, not a crash
+    assert _sample_mae({"mystery": np.asarray([1.0])}, sample) == 0.0
+
+
+def test_pilot_knobs_are_consumed_and_documented():
+    """Every HYDRAGNN_PILOT_* / HYDRAGNN_INJECT_PILOT_* knob is declared
+    with a consumer (the graftlint HG006 contract) and survives a
+    config round-trip through PilotConfig."""
+    from hydragnn_tpu.utils.knobs import KNOBS
+
+    names = set(KNOBS)
+    for suffix in (
+        "CANARY_SAMPLES", "CANARY_TOL", "COOLDOWN_S", "MAX_WALL_S",
+        "STUCK_AFTER", "TUNE_ATTEMPTS", "TUNE_BACKOFF_S", "TUNE_EPOCHS",
+    ):
+        assert f"HYDRAGNN_PILOT_{suffix}" in names
+    for suffix in ("TRAIN_CRASH", "CANARY_REGRESS", "TORN_RELOAD", "HUNG_TUNE"):
+        assert f"HYDRAGNN_INJECT_PILOT_{suffix}" in names
+    cfg = PilotConfig()
+    assert cfg.cooldown_s == 60.0 and cfg.stuck_after == 3

@@ -320,6 +320,16 @@ def render_faults(events: List[dict]) -> str:
         ),
         "incidents": sum(1 for e in events if e.get("kind") == "incident"),
         "drift": sum(1 for e in events if e.get("kind") == "drift"),
+        "pilot_cycles": sum(
+            1
+            for e in events
+            if e.get("kind") == "pilot" and e.get("state") == "drift_confirmed"
+        ),
+        "pilot_stuck": sum(
+            1
+            for e in events
+            if e.get("kind") == "pilot" and e.get("state") == "stuck"
+        ),
         "spool_rotations": sum(
             1 for e in events if e.get("kind") == "spool_rotate"
         ),
@@ -384,6 +394,20 @@ def render_faults(events: List[dict]) -> str:
                 f"rule={e.get('rule')} observed={_fmt(e.get('observed'))} "
                 f"threshold={_fmt(e.get('threshold'))} "
                 f"spool={window.get('dir') or '<off>'}"
+            )
+        elif kind == "pilot":
+            # the retrain pilot's state machine (hydragnn_tpu/pilot):
+            # drift_confirmed -> fine_tuning -> canary -> reloading ->
+            # cooldown, or stuck when the recovery budget is spent
+            extras = [
+                f"{k}={e[k]}"
+                for k in ("reason", "candidate", "rule")
+                if e.get(k) is not None
+            ]
+            detail = (
+                f"state={e.get('state')} cycle={e.get('cycle')} "
+                f"failed_cycles={e.get('failed_cycles')}"
+                + ("".join(" " + x for x in extras))
             )
         elif kind == "run_end":
             detail = f"status={e.get('status')}"

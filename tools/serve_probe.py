@@ -23,6 +23,17 @@ Exit codes:
 ``--verbose`` prints what was decided and why (probes are run by
 machines, so the default is silent).
 
+Pilot mode (``hydragnn_tpu/pilot``, docs/RESILIENCE.md "Closed
+loop"): ``--pilot`` probes the retrain pilot's gauges in the same
+textfile (``hydragnn_serve_pilot_state`` — the integer state code —
+and ``hydragnn_serve_pilot_last_cycle_ok``):
+
+    python tools/serve_probe.py --prom /run/serve.prom --pilot
+
+    0  pilot attached and not stuck, last cycle (if any) succeeded
+    1  pilot STUCK (terminal; human intervention) or last cycle failed
+    2  no pilot gauges in the textfile (none attached, or stale)
+
 Fleet mode (``hydragnn_tpu/fleet``, docs/FLEET.md): ``--fleet DIR``
 probes every replica textfile plus the router's in the directory
 ``Fleet.export_probes`` writes (``r*.prom`` + ``router.prom``), prints
@@ -79,6 +90,53 @@ def probe(path: str, mode: str = "ready", max_age_s: float = 60.0):
     if value >= 1.0:
         return 0, f"{gauge}=1 (age {age:.1f}s)"
     return 1, f"{gauge}={value:g} — server reports not {mode}"
+
+
+#: pilot/pilot.py STATE_CODES, inverted for narration (the gauge is the
+#: integer code so probes stay numeric)
+_PILOT_STATES = (
+    "idle",
+    "drift_confirmed",
+    "fine_tuning",
+    "canary",
+    "reloading",
+    "cooldown",
+    "stuck",
+)
+_PILOT_STUCK = _PILOT_STATES.index("stuck")
+
+
+def probe_pilot(path: str, max_age_s: float = 60.0):
+    """Probe the retrain pilot's gauges in the same textfile: exit 0
+    while the pilot is in any non-terminal state, 1 when it is STUCK
+    (or its last cycle failed — a human should look), 2 when no pilot
+    gauges are exported (no pilot attached, stale or missing file)."""
+    try:
+        age = time.time() - os.stat(path).st_mtime
+    except OSError as exc:
+        return 2, f"no textfile at {path!r} ({exc.__class__.__name__})"
+    if max_age_s > 0 and age > max_age_s:
+        return 2, f"textfile is stale ({age:.1f}s old > --max-age {max_age_s:g}s)"
+    try:
+        with open(path) as f:
+            text = f.read()
+    except OSError as exc:
+        return 2, f"unreadable textfile {path!r} ({exc.__class__.__name__})"
+    state = parse_prometheus_gauge(text, "hydragnn_serve_pilot_state")
+    if state is None:
+        return 2, f"gauge hydragnn_serve_pilot_state not found in {path!r}"
+    code = int(state)
+    name = (
+        _PILOT_STATES[code] if 0 <= code < len(_PILOT_STATES) else f"?{code}"
+    )
+    last_ok = parse_prometheus_gauge(text, "hydragnn_serve_pilot_last_cycle_ok")
+    outcome = {1.0: "ok", 0.0: "failed", -1.0: "none"}.get(last_ok, "absent")
+    msg = f"pilot state={name} last_cycle={outcome} (age {age:.1f}s)"
+    if code == _PILOT_STUCK:
+        return 1, msg + " — pilot is STUCK, human intervention required"
+    if last_ok == 0.0:
+        return 1, msg + " — last retrain cycle failed"
+    return 0, msg
 
 
 ROUTER_FILE = "router.prom"
@@ -145,6 +203,13 @@ def main(argv=None) -> int:
         action="store_true",
         help="probe liveness only (dispatch thread beating)",
     )
+    g.add_argument(
+        "--pilot",
+        action="store_true",
+        help="probe the retrain pilot: exit 0 healthy, 1 stuck or "
+        "last cycle failed, 2 no pilot gauges exported "
+        "(--prom mode only)",
+    )
     p.add_argument(
         "--max-age",
         type=float,
@@ -155,6 +220,14 @@ def main(argv=None) -> int:
     p.add_argument("--verbose", action="store_true", help="print the verdict")
     args = p.parse_args(argv)
     mode = "live" if args.live else "ready"
+    if args.pilot:
+        if not args.prom:
+            print("serve_probe: --pilot needs --prom", file=sys.stderr)
+            return 2
+        rc, msg = probe_pilot(args.prom, max_age_s=args.max_age)
+        if args.verbose or rc != 0:
+            print(f"serve_probe[pilot]: {msg}", file=sys.stderr)
+        return rc
     if args.fleet:
         rc, rows = probe_fleet(args.fleet, mode=mode, max_age_s=args.max_age)
         width = max(len(name) for name, _, _ in rows)

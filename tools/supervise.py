@@ -39,6 +39,7 @@ from hydragnn_tpu.obs.flight import FlightRecorder  # noqa: E402
 from hydragnn_tpu.resilience.supervisor import (  # noqa: E402
     Supervisor,
     SupervisorPolicy,
+    wall_clock_runner,
 )
 
 
@@ -73,6 +74,15 @@ def main(argv=None) -> int:
         "stripped so an injected fault fires exactly once)",
     )
     p.add_argument(
+        "--max-wall-s",
+        type=float,
+        default=None,
+        help="supervisor-level hard wall clock per attempt: kill the "
+        "child (SIGTERM, then SIGKILL) after this many seconds and "
+        "classify the attempt as hung/79 — the outer belt for children "
+        "wedged where the in-process watchdog cannot fire",
+    )
+    p.add_argument(
         "--flight",
         default=None,
         help="write the supervisor's flight record (restart events + "
@@ -103,7 +113,15 @@ def main(argv=None) -> int:
             "graftcheck": contract_block(None),
         }
     )
-    sup = Supervisor(child, policy=policy, env=dict(os.environ), flight=flight)
+    runner = (
+        wall_clock_runner(args.max_wall_s)
+        if args.max_wall_s is not None
+        else None
+    )
+    sup = Supervisor(
+        child, policy=policy, env=dict(os.environ), flight=flight,
+        runner=runner,
+    )
     result = sup.run()
     flight.close()
     print(
