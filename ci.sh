@@ -1027,6 +1027,146 @@ grep -q "== incident" "$INC_DIR/report.out" || {
 python tools/obs_report.py --faults "$(ls "$INC_DIR"/nan/logs/*/flight.jsonl)"
 rm -rf "$INC_DIR"
 
+echo "== podview smoke (simulated 2-host pod: per-host shards merge into one timeline with per-host Chrome tracks; injected straggler -> one step_skew bundle naming host 1) =="
+POD_DIR="$(mktemp -d)"
+cat > "$POD_DIR/host_run.py" <<'EOF'
+"""One simulated host's tiny training run into a shared run dir. The
+podview smoke runs this once per host — host 1 first, then host 0,
+whose rank-0 SkewMonitor reads the completed peer shard; the
+host_epoch summaries carry durations, so wall-clock overlap between
+the simulated hosts is not required (docs/OBSERVABILITY.md "Pod
+visibility")."""
+import sys
+
+from hydragnn_tpu.api import run_training
+from hydragnn_tpu.data.synthetic import deterministic_graph_data
+from hydragnn_tpu.flagship import flagship_config
+
+out, triggers = sys.argv[1], sys.argv[2] == "1"
+cfg = flagship_config(hidden_dim=8, num_conv_layers=2, batch_size=5, num_epoch=2)
+cfg["NeuralNetwork"]["Training"]["slo_triggers"] = triggers
+# per-step path: the straggler injection lives in StepSpans.step
+cfg["NeuralNetwork"]["Training"]["scan_epoch"] = False
+samples = deterministic_graph_data(
+    number_configurations=20,
+    unit_cell_x_range=(2, 3),
+    unit_cell_y_range=(2, 3),
+    unit_cell_z_range=(2, 3),
+    seed=0,
+)
+run_training(cfg, samples=samples, log_dir=out + "/logs/")
+EOF
+# --- clean pass: the same tiny config once per simulated host into ONE
+#     run dir; triggers stay off here (two sequential CPU runs carry
+#     real compile-time noise — the straggler pass below proves the
+#     trigger loop with an unambiguous signal)
+JAX_PLATFORMS=cpu HYDRAGNN_PODVIEW_HOSTS=2 HYDRAGNN_PODVIEW_RUN_ID=podsmoke \
+    HYDRAGNN_PODVIEW_HOST=1 PYTHONPATH="$PWD" python "$POD_DIR/host_run.py" "$POD_DIR/clean" 0
+JAX_PLATFORMS=cpu HYDRAGNN_PODVIEW_HOSTS=2 HYDRAGNN_PODVIEW_RUN_ID=podsmoke \
+    HYDRAGNN_PODVIEW_HOST=0 PYTHONPATH="$PWD" python "$POD_DIR/host_run.py" "$POD_DIR/clean" 0
+JAX_PLATFORMS=cpu python - "$POD_DIR/clean" <<'EOF'
+import glob
+import os
+import sys
+
+from hydragnn_tpu.obs import (
+    export_flight_chrome,
+    flight_to_chrome,
+    host_epoch_table,
+    merge_host_flights,
+    read_flight_record,
+)
+
+out = sys.argv[1]
+flight = glob.glob(out + "/logs/*/flight.jsonl")[0]
+run_dir = os.path.dirname(flight)
+assert os.path.exists(os.path.join(run_dir, "flight.host1.jsonl")), \
+    "host 1 wrote no shard"
+merged = merge_host_flights(run_dir)
+assert merged.hosts == [0, 1], merged.hosts
+assert merged.problems == [], merged.problems
+table = host_epoch_table(merged.events, run_id="podsmoke")
+assert sorted(table) == [0, 1] and all(
+    sorted(v) == [0, 1] for v in table.values()
+), table
+# rank 0's monitor saw the peer shard: skew verdicts in the record
+assert any(e.get("kind") == "podview" for e in merged.events), \
+    "no podview skew verdicts in the canonical shard"
+# the plane's cost is stamped into run_end and <1% on the clean path
+end = [e for e in read_flight_record(flight) if e.get("kind") == "run_end"][-1]
+pv = end.get("podview")
+assert pv and pv["enabled"] and pv["hosts"] == 2, pv
+assert pv["overhead_frac"] < 0.01, f"podview overhead over 1%: {pv}"
+# one Chrome track per host
+chrome = flight_to_chrome(merged.events)["traceEvents"]
+tids = {
+    e["tid"] for e in chrome
+    if e.get("ph") == "X" and str(e.get("name", "")).startswith("host")
+}
+assert tids == {0, 1}, tids
+export_flight_chrome(run_dir, out + "/pod_trace.json")
+print(
+    "podview smoke (clean pod): OK (2 shards merged, "
+    f"overhead_frac={pv['overhead_frac']})"
+)
+EOF
+# the shard directory passes the reporter's validate gate (torn or
+# missing hosts would be warnings, not failures), the --hosts view
+# renders, and each shard passes the lint artifact gate
+POD_RUN_DIR="$(dirname "$(ls "$POD_DIR"/clean/logs/*/flight.jsonl)")"
+python tools/obs_report.py --validate "$POD_RUN_DIR"
+python tools/obs_report.py --hosts "$POD_RUN_DIR" | tee "$POD_DIR/hosts.out"
+grep -q "slowest" "$POD_DIR/hosts.out" || {
+    echo "FAIL: obs_report --hosts rendered no per-host table"; exit 1; }
+python tools/graftlint.py --artifacts \
+    "$POD_RUN_DIR/flight.jsonl" "$POD_RUN_DIR/flight.host1.jsonl"
+# --- straggler pass: host 1 sleeps 200 ms per step; host 0's monitor
+#     must turn the cross-host skew into exactly ONE step_skew incident
+#     whose podview_report.json names the injected host
+JAX_PLATFORMS=cpu HYDRAGNN_PODVIEW_HOSTS=2 HYDRAGNN_PODVIEW_RUN_ID=podstrag \
+    HYDRAGNN_PODVIEW_HOST=1 HYDRAGNN_INJECT_STRAGGLER=1:200 \
+    PYTHONPATH="$PWD" python "$POD_DIR/host_run.py" "$POD_DIR/strag" 0
+JAX_PLATFORMS=cpu HYDRAGNN_PODVIEW_HOSTS=2 HYDRAGNN_PODVIEW_RUN_ID=podstrag \
+    HYDRAGNN_PODVIEW_HOST=0 HYDRAGNN_INCIDENT_PROFILE_STEPS=2 \
+    PYTHONPATH="$PWD" python "$POD_DIR/host_run.py" "$POD_DIR/strag" 1
+JAX_PLATFORMS=cpu python - "$POD_DIR/strag" <<'EOF'
+import glob
+import json
+import os
+import sys
+
+from hydragnn_tpu.obs import validate_podview_report
+from hydragnn_tpu.obs.triggers import list_incidents, validate_incident_bundle
+
+out = sys.argv[1]
+flight = glob.glob(out + "/logs/*/flight.jsonl")[0]
+bundles = list_incidents(os.path.join(os.path.dirname(flight), "incidents"))
+assert len(bundles) == 1, \
+    f"expected exactly one step_skew incident, got {bundles}"
+problems = validate_incident_bundle(bundles[0])
+assert not problems, problems
+with open(os.path.join(bundles[0], "incident_manifest.json")) as f:
+    man = json.load(f)
+assert man["rule"] == "podview_step_skew" and man["kind"] == "step_skew", man
+assert man["trigger"]["detail"]["slowest_host"] == 1, man["trigger"]
+with open(os.path.join(bundles[0], "podview_report.json")) as f:
+    report = json.load(f)
+assert validate_podview_report(report) == [], report
+assert report["slowest_host"] == 1, report  # names the injected host
+assert report["history"], "podview report carries no skew history"
+# per-host evidence: the straggler's own shard tail rides in the bundle
+assert os.path.exists(os.path.join(bundles[0], "flight_tail.host1.jsonl")), \
+    "bundle missing the peer shard's tail"
+print(
+    "podview smoke (straggler): OK (one step_skew bundle naming host 1 "
+    f"at {bundles[0]})"
+)
+EOF
+# the new sidecar passes the lint artifact gate by name
+python tools/graftlint.py --artifacts \
+    "$POD_DIR"/strag/logs/*/incidents/*/podview_report.json
+rm -rf "$POD_DIR"
+
 echo "== exec-cache smoke (train once; two server starts vs one cache dir; corrupt entry -> loud eviction) =="
 EXEC_DIR="$(mktemp -d)"
 cat > "$EXEC_DIR/serve_once.py" <<'EOF'

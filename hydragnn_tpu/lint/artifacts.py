@@ -254,6 +254,8 @@ _INCIDENT_RULE_KINDS = (
     "loss_spike",
     "nonfinite_burst",
     "pilot_stuck",
+    "step_skew",
+    "host_stall",
 )
 
 
@@ -335,6 +337,27 @@ def _check_drift_report(data: Any) -> List[str]:
     return problems
 
 
+def _check_podview_report(data: Any) -> List[str]:
+    """Podview skew report sidecar a ``step_skew`` / ``host_stall``
+    incident bundle carries (obs/podview.py:SkewMonitor.report()); the
+    runtime validator there is ``validate_podview_report`` — this
+    mirrors the fields downstream tools read so the linter stays
+    package-free."""
+    problems = _require(
+        data,
+        {"schema": (int,), "host": (int,), "hosts": (int,),
+         "threshold": _NUM, "history": (list,), "attribution": (dict,)},
+    )
+    if problems:
+        return problems
+    if data["schema"] != 1:
+        problems.append(f"unsupported podview report schema {data['schema']!r}")
+    sh = data.get("slowest_host")
+    if sh is not None and not isinstance(sh, int):
+        problems.append("field 'slowest_host' must be an int or null")
+    return problems
+
+
 def _check_spool_manifest(data: Any) -> List[str]:
     """Per-shard manifest the request spool writes next to each HGC
     shard (obs/spool.py); pins the fields drift_report / retraining
@@ -368,6 +391,9 @@ RUNTIME_SCHEMAS: Dict[str, Tuple[str, Callable[[Any], List[str]]]] = {
     ),
     "spool_manifest.json": (
         "request spool shard manifest", _check_spool_manifest,
+    ),
+    "podview_report.json": (
+        "podview skew report", _check_podview_report,
     ),
 }
 

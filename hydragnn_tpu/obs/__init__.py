@@ -18,6 +18,9 @@ Pieces:
   - :mod:`~hydragnn_tpu.obs.triggers` — declarative SLO rules over the
     live registry; firing captures a bounded profiler trace into a
     self-contained incident bundle.
+  - :mod:`~hydragnn_tpu.obs.podview` — pod-visibility plane: per-host
+    flight shards, cross-host merge/stitching, and the rank-0
+    SkewMonitor behind the ``step_skew`` / ``host_stall`` triggers.
 
 Global gate: ``HYDRAGNN_TELEMETRY=0`` disables the process-global
 registry and everything the train loop wires up; each piece is also
@@ -52,6 +55,22 @@ from hydragnn_tpu.obs.introspect import (
     make_diagnostics_step,
     peak_flops,
     per_head_error_metrics,
+)
+from hydragnn_tpu.obs.podview import (
+    MergedFlights,
+    SkewMonitor,
+    collective_attribution,
+    host_artifact_path,
+    host_epoch_table,
+    host_flight_path,
+    host_identity,
+    list_host_shards,
+    load_skew_tolerance,
+    merge_host_flights,
+    podview_enabled,
+    resolve_run_id,
+    straggler_spec,
+    validate_podview_report,
 )
 from hydragnn_tpu.obs.spans import StepSpans
 from hydragnn_tpu.obs.trace import (
@@ -123,6 +142,20 @@ __all__ = [
     "make_diagnostics_step",
     "peak_flops",
     "per_head_error_metrics",
+    "MergedFlights",
+    "SkewMonitor",
+    "collective_attribution",
+    "host_artifact_path",
+    "host_epoch_table",
+    "host_flight_path",
+    "host_identity",
+    "list_host_shards",
+    "load_skew_tolerance",
+    "merge_host_flights",
+    "podview_enabled",
+    "resolve_run_id",
+    "straggler_spec",
+    "validate_podview_report",
     "StepSpans",
     "RequestTrace",
     "Tracer",

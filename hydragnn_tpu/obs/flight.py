@@ -109,6 +109,13 @@ _REQUIRED: Dict[str, tuple] = {
     # why — so one flight timeline narrates incident -> fine-tune ->
     # canary -> reload end to end
     "pilot": ("state", "cycle"),
+    # pod-visibility plane (obs/podview.py, docs/OBSERVABILITY.md "Pod
+    # visibility"): a per-host epoch summary written into that host's
+    # flight shard (the join unit merge_host_flights stitches on
+    # ``(run_id, epoch)``), and the rank-0 SkewMonitor's per-epoch skew
+    # verdict over all hosts' summaries
+    "host_epoch": ("epoch", "host", "run_id", "epoch_s"),
+    "podview": ("epoch", "skew_frac", "slowest_host"),
 }
 
 # the fault-history subset tools/obs_report.py --faults narrates
@@ -167,12 +174,23 @@ class FlightRecorder:
     method is a no-op, so call sites never need their own gate.
     """
 
-    def __init__(self, path: Optional[str], enabled: bool = True):
+    def __init__(
+        self,
+        path: Optional[str],
+        enabled: bool = True,
+        host: Optional[int] = None,
+    ):
         import threading
 
         from hydragnn_tpu.utils import syncdebug
 
         self.path = path
+        # pod-visibility host identity: when set, every event's ``rank``
+        # envelope field is stamped with this value instead of
+        # jax.process_index() — how simulated hosts (HYDRAGNN_PODVIEW_HOST)
+        # and real multihost shards both get distinguishable tracks in
+        # the merged timeline (obs/podview.py)
+        self.host = host
         # graftsync: thread-safe=GIL-atomic bool gate; a record() racing close() re-checks _f under the lock, worst case one event is dropped
         self.enabled = bool(enabled and path)
         self._f = None  # graftsync: guarded-by=flight.FlightRecorder._lock
@@ -196,7 +214,7 @@ class FlightRecorder:
             "v": SCHEMA_VERSION,
             "kind": kind,
             "t": round(time.time(), 3),
-            "rank": _rank(),
+            "rank": self.host if self.host is not None else _rank(),
         }
         event.update({k: _jsonable(v) for k, v in payload.items()})
         try:

@@ -1,0 +1,616 @@
+"""Pod-visibility plane: per-host flight shards, cross-host stitching,
+and straggler/skew detection for multihost runs.
+
+Every observability surface before this module assumed one process:
+``obs/flight.py`` wrote one ``flight.jsonl``, ``obs/spans.py`` timed
+one host, ``obs/registry.py`` resolved a single rank. This module makes
+an N-host run produce ONE coherent timeline:
+
+  - **Per-host flight sharding.** Rank 0 keeps the canonical
+    ``flight.jsonl``; every other host writes its own crash-safe
+    ``flight.host<k>.jsonl`` in the same run directory
+    (:func:`host_flight_path`). :func:`merge_host_flights` joins the
+    shards on ``(run_id, epoch)``, tolerates torn tails and missing
+    hosts, and feeds ``tools/obs_report.py --hosts`` and the
+    Chrome/Perfetto exporter (one track per host — shard events carry
+    the host index in the ``rank`` envelope field).
+  - **Skew detection.** Each host appends a lightweight ``host_epoch``
+    summary (epoch wall time, data-wait, steps, MFU) to its shard; the
+    rank-0 :class:`SkewMonitor` re-reads the peer shards at epoch
+    boundaries (filesystem exchange — ``data/diststore.py``'s TCP store
+    is the live alternative at pod scale), computes per-epoch duration
+    skew and slowest-host attribution, and publishes
+    ``podview.skew_frac`` / ``podview.slowest_host`` /
+    ``podview.stall_age_s`` and per-host MFU gauges into the registry —
+    what the ``step_skew`` / ``host_stall`` trigger rules
+    (``obs/triggers.py``) evaluate.
+  - **Collective-aware attribution.** :func:`collective_attribution`
+    splits modeled step time into compute vs collective wire time using
+    the committed ``tools/scaling_estimate.py`` traffic model against
+    the run's Partitioner layout, so a skew verdict distinguishes
+    "host 3 is slow" from "the interconnect is saturated".
+
+Host identity comes from ``jax.process_index()``/``process_count()``,
+overridable with ``HYDRAGNN_PODVIEW_HOST`` / ``HYDRAGNN_PODVIEW_HOSTS``
+so single-machine CI can simulate a pod by running the same tiny config
+once per host into one run directory (the shards join on the shared
+``HYDRAGNN_PODVIEW_RUN_ID``). docs/OBSERVABILITY.md "Pod visibility"
+has the full anatomy; everything here is stdlib + knobs only and must
+never take a run down — failures degrade to "no podview data".
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import time
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple, Union
+
+from hydragnn_tpu.utils import knobs
+
+from .flight import read_flight_record
+
+#: filename of the canonical (host 0) shard
+CANONICAL_SHARD = "flight.jsonl"
+_SHARD_RE = re.compile(r"^flight\.host([0-9]+)\.jsonl$")
+
+PODVIEW_REPORT = "podview_report.json"
+PODVIEW_REPORT_SCHEMA = 1
+
+#: step_skew threshold fallback when no committed scaling estimate
+#: carries a skew_tolerance block
+DEFAULT_SKEW_THRESHOLD = 0.25
+
+#: bound on retained per-epoch skew history (monitor memory + report size)
+_HISTORY_MAX = 64
+
+
+# -- host identity ----------------------------------------------------------
+
+
+def host_identity() -> Tuple[int, int]:
+    """``(host_index, host_count)`` for this process. The
+    ``HYDRAGNN_PODVIEW_HOST`` / ``HYDRAGNN_PODVIEW_HOSTS`` overrides win
+    (simulated hosts on one machine); otherwise jax's process index and
+    count; ``(0, 1)`` when jax is unavailable."""
+    host = knobs.get_int("HYDRAGNN_PODVIEW_HOST", -1)
+    hosts = knobs.get_int("HYDRAGNN_PODVIEW_HOSTS", 0)
+    if host < 0 or hosts <= 0:
+        try:
+            import jax
+
+            if host < 0:
+                host = jax.process_index()
+            if hosts <= 0:
+                hosts = jax.process_count()
+        except Exception:
+            pass
+    host = max(host, 0)
+    return host, max(hosts, host + 1, 1)
+
+
+def podview_enabled() -> bool:
+    """The plane is on when forced (``HYDRAGNN_PODVIEW``) or when the
+    run actually spans more than one host (real or simulated)."""
+    if knobs.get_bool("HYDRAGNN_PODVIEW", False):
+        return True
+    return host_identity()[1] > 1
+
+
+def resolve_run_id(default: Optional[str] = None) -> Optional[str]:
+    """The merge join key all of a run's host shards share:
+    ``HYDRAGNN_PODVIEW_RUN_ID`` when set (how simulated hosts agree),
+    else the caller's default (the run's log name)."""
+    return knobs.get_str("HYDRAGNN_PODVIEW_RUN_ID") or default
+
+
+# -- shard naming -----------------------------------------------------------
+
+
+def host_flight_path(base_dir: str, host: Optional[int] = None) -> str:
+    """Path of host ``host``'s flight shard under ``base_dir``. Host 0
+    keeps the legacy canonical name ``flight.jsonl``; host ``k`` writes
+    ``flight.host<k>.jsonl``."""
+    if host is None:
+        host = host_identity()[0]
+    name = CANONICAL_SHARD if host == 0 else f"flight.host{host}.jsonl"
+    return os.path.join(base_dir, name)
+
+
+def host_artifact_path(path: str, host: Optional[int] = None) -> str:
+    """Suffix a fixed-name artifact path with this process's host index
+    so a second host never clobbers the first: ``x/train.prom`` stays
+    ``x/train.prom`` on host 0 and becomes ``x/train.host2.prom`` on
+    host 2. Applies to Prometheus textfiles and serve probe files."""
+    if host is None:
+        host = host_identity()[0]
+    if host <= 0:
+        return path
+    root, ext = os.path.splitext(path)
+    return f"{root}.host{host}{ext}"
+
+
+def list_host_shards(base_dir: str) -> Dict[int, str]:
+    """``{host_index: shard_path}`` for every flight shard present in
+    ``base_dir`` (the canonical ``flight.jsonl`` is host 0)."""
+    shards: Dict[int, str] = {}
+    try:
+        names = os.listdir(base_dir)
+    except OSError:
+        return shards
+    for name in names:
+        if name == CANONICAL_SHARD:
+            shards[0] = os.path.join(base_dir, name)
+            continue
+        m = _SHARD_RE.match(name)
+        if m:
+            shards[int(m.group(1))] = os.path.join(base_dir, name)
+    return shards
+
+
+# -- merge reader -----------------------------------------------------------
+
+
+class MergedFlights(NamedTuple):
+    """Result of :func:`merge_host_flights`: the stitched event list
+    (each event stamped with its ``host``), the host indices present,
+    and advisory problems (torn tails, missing hosts, duplicates) that
+    must NOT fail the merge."""
+
+    events: List[dict]
+    hosts: List[int]
+    problems: List[str]
+
+
+def _torn_tail(path: str) -> bool:
+    """True when the shard's final non-empty line is not valid JSON —
+    the crashed-writer case ``read_flight_record`` silently skips."""
+    try:
+        with open(path) as f:
+            lines = [ln for ln in f.read().split("\n") if ln.strip()]
+    except OSError:
+        return False
+    if not lines:
+        return False
+    try:
+        json.loads(lines[-1])
+        return False
+    except json.JSONDecodeError:
+        return True
+
+
+def merge_host_flights(
+    source: Union[str, List[str]],
+    expected_hosts: Optional[int] = None,
+) -> MergedFlights:
+    """Stitch per-host flight shards into one timeline.
+
+    ``source`` is a run directory (every shard in it), a single shard
+    path, or an explicit list of shard paths. Events are stamped with a
+    ``host`` field (from the shard filename, falling back to the event's
+    ``rank``) and sorted by timestamp; ``host_epoch`` events from
+    different hosts join on ``(run_id, epoch)``.
+
+    Degradation is advisory, never fatal: a torn tail, a missing host
+    (fewer shards than the manifests/overrides promise), an unparseable
+    interior line, or a duplicate ``(run_id, host, epoch)`` summary each
+    append to ``problems`` while the merge of everything readable still
+    returns."""
+    if isinstance(source, str) and os.path.isdir(source):
+        shards = list_host_shards(source)
+        paths = [shards[h] for h in sorted(shards)]
+    elif isinstance(source, str):
+        paths = [source]
+    else:
+        paths = list(source)
+
+    problems: List[str] = []
+    events: List[dict] = []
+    hosts_seen: List[int] = []
+    promised = 0
+    seen_summaries: Dict[Tuple[Any, int, int], int] = {}
+
+    for path in paths:
+        name = os.path.basename(path)
+        m = _SHARD_RE.match(name)
+        file_host = int(m.group(1)) if m else (0 if name == CANONICAL_SHARD else None)
+        try:
+            shard_events = read_flight_record(path)
+        except (OSError, FileNotFoundError):
+            problems.append(f"{name}: unreadable shard")
+            continue
+        if _torn_tail(path):
+            problems.append(f"{name}: torn tail (final line truncated, skipped)")
+        shard_hosts = set()
+        for ev in shard_events:
+            if ev.get("kind") == "_unparseable":
+                problems.append(f"{name}: unparseable interior line")
+                continue
+            host = file_host if file_host is not None else int(ev.get("rank", 0) or 0)
+            ev = dict(ev, host=host)
+            shard_hosts.add(host)
+            if ev.get("kind") == "host_epoch":
+                promised = max(promised, int(ev.get("hosts", 0) or 0))
+                key = (ev.get("run_id"), host, int(ev.get("epoch", -1)))
+                seen_summaries[key] = seen_summaries.get(key, 0) + 1
+            elif ev.get("kind") == "run_start":
+                man = ev.get("manifest")
+                if isinstance(man, dict):
+                    try:
+                        promised = max(promised, int(man.get("num_processes", 0) or 0))
+                    except (TypeError, ValueError):
+                        pass
+            events.append(ev)
+        for h in sorted(shard_hosts):
+            if h not in hosts_seen:
+                hosts_seen.append(h)
+
+    for key, count in sorted(seen_summaries.items(), key=lambda kv: str(kv[0])):
+        if count > 1:
+            run_id, host, epoch = key
+            problems.append(
+                f"duplicate host_epoch for run_id={run_id!r} host={host} "
+                f"epoch={epoch} ({count} copies)"
+            )
+
+    if expected_hosts is None:
+        expected_hosts = max(knobs.get_int("HYDRAGNN_PODVIEW_HOSTS", 0), promised)
+    if expected_hosts:
+        missing = sorted(set(range(expected_hosts)) - set(hosts_seen))
+        if missing:
+            problems.append(
+                f"missing host shard(s): {missing} "
+                f"(expected {expected_hosts} hosts, saw {sorted(hosts_seen)})"
+            )
+
+    events.sort(key=lambda ev: (ev.get("t") or 0.0))
+    return MergedFlights(events=events, hosts=sorted(hosts_seen), problems=problems)
+
+
+def host_epoch_table(
+    events: List[dict], run_id: Optional[str] = None
+) -> Dict[int, Dict[int, dict]]:
+    """The merge join materialized: ``{epoch: {host: host_epoch event}}``
+    (optionally filtered to one ``run_id``) — what ``--hosts`` renders
+    and the SkewMonitor math runs on."""
+    table: Dict[int, Dict[int, dict]] = {}
+    for ev in events:
+        if ev.get("kind") != "host_epoch":
+            continue
+        if run_id is not None and ev.get("run_id") not in (None, run_id):
+            continue
+        epoch = int(ev.get("epoch", -1))
+        host = int(ev.get("host", ev.get("rank", 0)) or 0)
+        table.setdefault(epoch, {})[host] = ev
+    return table
+
+
+# -- straggler injection ----------------------------------------------------
+
+
+def straggler_spec() -> Optional[Tuple[int, float]]:
+    """Parse ``HYDRAGNN_INJECT_STRAGGLER="HOST:MS"`` into
+    ``(host_index, sleep_seconds)``; None when unset or malformed (a
+    bad spec must degrade to no injection, not crash)."""
+    v = knobs.get_str("HYDRAGNN_INJECT_STRAGGLER")
+    if not v:
+        return None
+    try:
+        host, ms = v.split(":", 1)
+        return int(host), float(ms) / 1e3
+    except (ValueError, TypeError):
+        return None
+
+
+# -- scaling-model coupling -------------------------------------------------
+
+
+def _scaling_record(path: Optional[str] = None) -> Optional[dict]:
+    """The committed scaling estimate (``SCALING_est_*.json`` at the
+    repo root, newest by name), or None."""
+    if path is None:
+        root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        cands = sorted(glob.glob(os.path.join(root, "SCALING_est_*.json")))
+        path = cands[-1] if cands else None
+    if not path or not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+        return rec if isinstance(rec, dict) else None
+    except (OSError, ValueError):
+        return None
+
+
+def load_skew_tolerance(path: Optional[str] = None) -> float:
+    """The model-derived default ``step_skew`` threshold: the committed
+    scaling estimate's ``skew_tolerance.default_step_skew_threshold``
+    (tools/scaling_estimate.py emits it from each layout's no-overlap
+    efficiency), or :data:`DEFAULT_SKEW_THRESHOLD` when absent."""
+    rec = _scaling_record(path)
+    if rec:
+        try:
+            thr = rec.get("skew_tolerance", {}).get("default_step_skew_threshold")
+            if thr is not None:
+                return float(thr)
+        except (AttributeError, TypeError, ValueError):
+            pass
+    return DEFAULT_SKEW_THRESHOLD
+
+
+def default_skew_threshold() -> float:
+    """Effective ``step_skew`` threshold: the ``HYDRAGNN_PODVIEW_SKEW``
+    knob when positive, else the scaling-model derivation."""
+    knob = knobs.get_float("HYDRAGNN_PODVIEW_SKEW", 0.0)
+    return knob if knob > 0 else load_skew_tolerance()
+
+
+def collective_attribution(
+    parallel: Optional[dict], scaling: Optional[dict] = None
+) -> dict:
+    """Split modeled step time into compute vs collective wire time for
+    the run's committed layout, using the same ring all-reduce / FSDP
+    traffic formulas as ``tools/scaling_estimate.py``: data-parallel
+    gradient all-reduce moves ``2(n-1)/n`` of the gradient bytes, FSDP
+    adds an all-gather + reduce-scatter pair at ``(f-1)/f`` each. A high
+    observed skew with a low modeled ``wire_frac`` points at a slow
+    host; skew within the modeled wire share points at the
+    interconnect."""
+    out: Dict[str, Any] = {
+        "modeled": False,
+        "compute_ms": None,
+        "wire_ms": None,
+        "wire_frac": None,
+        "note": "",
+    }
+    if not isinstance(parallel, dict) or not parallel.get("available", False):
+        out["note"] = "no parallel layout committed (single-device run)"
+        return out
+    if scaling is None:
+        scaling = _scaling_record()
+    if not scaling:
+        out["note"] = "no committed scaling estimate (SCALING_est_*.json)"
+        return out
+    try:
+        step_ms = float(scaling["step_ms_device_single_chip"])
+        ici_bps = float(scaling.get("ici_gbps_assumed", 45.0)) * 1e9
+        params = parallel.get("params") or {}
+        grad_bytes = float(
+            params.get("bytes_global")
+            or scaling.get("param_bytes_f32")
+            or 0.0
+        )
+        n_data = int(parallel.get("data") or 1)
+        n_fsdp = int(parallel.get("fsdp") or 1)
+        wire_bytes = 0.0
+        if n_data > 1:
+            wire_bytes += 2.0 * (n_data - 1) / n_data * grad_bytes
+        if n_fsdp > 1:
+            wire_bytes += (n_fsdp - 1) / n_fsdp * 2.0 * grad_bytes
+        wire_ms = wire_bytes / ici_bps * 1e3
+        total = step_ms + wire_ms
+        out.update(
+            modeled=True,
+            compute_ms=round(step_ms, 4),
+            wire_ms=round(wire_ms, 4),
+            wire_frac=round(wire_ms / total, 6) if total > 0 else 0.0,
+            data=n_data,
+            fsdp=n_fsdp,
+            note=(
+                "ring all-reduce + FSDP ag/rs traffic model vs the "
+                "committed layout (tools/scaling_estimate.py)"
+            ),
+        )
+    except (KeyError, TypeError, ValueError, ZeroDivisionError) as e:
+        out["note"] = f"attribution unavailable: {e}"
+    return out
+
+
+# -- skew monitor -----------------------------------------------------------
+
+
+class SkewMonitor:
+    """Rank-0 cross-host skew detector fed by filesystem shard exchange.
+
+    Single-threaded by design: the train loop calls
+    :meth:`observe_epoch` once per epoch boundary (never from the hot
+    step path), so no lock is needed. Every public method is wrapped so
+    a failure degrades to "no skew data this epoch" — podview must never
+    take the run down. The monitor self-times its shard reads;
+    :attr:`overhead_s` is what the run_end ``podview.overhead_frac``
+    stamp is computed from."""
+
+    def __init__(
+        self,
+        base_dir: str,
+        host: int = 0,
+        hosts: int = 1,
+        run_id: Optional[str] = None,
+        registry=None,
+        parallel: Optional[dict] = None,
+        threshold: Optional[float] = None,
+        scaling: Optional[dict] = None,
+    ):
+        self.base_dir = base_dir
+        self.host = host
+        self.hosts = hosts
+        self.run_id = run_id
+        self.registry = registry
+        self.parallel = parallel
+        self.threshold = (
+            threshold if threshold and threshold > 0 else default_skew_threshold()
+        )
+        self.history: List[dict] = []
+        self.overhead_s = 0.0
+        self._scaling = scaling
+        # a host that never writes a shard counts as stalled from the
+        # monitor's birth, not from the unix epoch
+        self._t0 = time.time()
+
+    def set_parallel(self, parallel: Optional[dict]) -> None:
+        """Attach the Partitioner manifest once it exists (it is built
+        after the monitor, when the train state is sharded)."""
+        self.parallel = parallel
+
+    # -- observation -------------------------------------------------------
+
+    def observe_epoch(self, epoch: int, summary: Optional[dict] = None):
+        """Read every host's ``host_epoch`` summary for ``epoch`` from
+        the shards, compute skew, publish gauges. ``summary`` is this
+        host's own record (used directly, saving a re-read race).
+        Returns the skew dict (recorded as a ``podview`` flight event)
+        or None when fewer than two hosts have reported."""
+        t0 = time.perf_counter()
+        try:
+            return self._observe(int(epoch), summary)
+        except Exception:
+            return None  # degrade: no skew data this epoch
+        finally:
+            self.overhead_s += time.perf_counter() - t0
+
+    def _observe(self, epoch: int, summary: Optional[dict]):
+        per_host: Dict[int, dict] = {}
+        latest_t: Dict[int, float] = {}
+        for h, path in list_host_shards(self.base_dir).items():
+            try:
+                shard_events = read_flight_record(path)
+            except OSError:
+                continue
+            for ev in shard_events:
+                t = ev.get("t")
+                if isinstance(t, (int, float)):
+                    latest_t[h] = max(latest_t.get(h, 0.0), float(t))
+                if ev.get("kind") != "host_epoch":
+                    continue
+                if int(ev.get("epoch", -1)) != epoch:
+                    continue
+                if self.run_id is not None and ev.get("run_id") not in (
+                    None,
+                    self.run_id,
+                ):
+                    continue
+                per_host[int(ev.get("host", h) or h)] = ev
+        if summary is not None:
+            per_host.setdefault(self.host, dict(summary, host=self.host))
+
+        now = time.time()
+        stall_age = 0.0
+        for h in range(self.hosts):
+            if h == self.host:
+                continue
+            stall_age = max(stall_age, now - latest_t.get(h, self._t0))
+
+        skew = None
+        if len(per_host) >= 2:
+            durs = {
+                h: float(ev.get("epoch_s") or 0.0) for h, ev in per_host.items()
+            }
+            t_max = max(durs.values())
+            slowest = max(sorted(durs), key=lambda h: durs[h])
+            skew_frac = (t_max - min(durs.values())) / t_max if t_max > 0 else 0.0
+            waits = {
+                h: float(ev.get("data_wait_s") or 0.0)
+                for h, ev in per_host.items()
+            }
+            attribution = collective_attribution(self.parallel, self._scaling)
+            # name the likely cause: the slowest host starving on data
+            # beats everything; skew inside the modeled wire share is
+            # the interconnect; otherwise the host itself is slow
+            slow_excess = t_max - min(durs.values())
+            if waits.get(slowest, 0.0) >= 0.5 * slow_excess > 0:
+                cause = "data_wait"
+            elif (
+                attribution.get("modeled")
+                and skew_frac <= (attribution.get("wire_frac") or 0.0)
+            ):
+                cause = "interconnect"
+            else:
+                cause = "host_slow"
+            skew = {
+                "epoch": epoch,
+                "skew_frac": round(skew_frac, 6),
+                "slowest_host": slowest,
+                "cause": cause,
+                "threshold": self.threshold,
+                "hosts_reporting": sorted(per_host),
+                "epoch_s": {str(h): round(durs[h], 4) for h in sorted(durs)},
+                "data_wait_s": {
+                    str(h): round(waits[h], 4) for h in sorted(waits)
+                },
+            }
+            self.history.append(skew)
+            del self.history[:-_HISTORY_MAX]
+
+        if self.registry is not None:
+            self.registry.gauge("podview.skew_frac").set(
+                skew["skew_frac"] if skew else 0.0
+            )
+            self.registry.gauge("podview.slowest_host").set(
+                float(skew["slowest_host"]) if skew else -1.0
+            )
+            self.registry.gauge("podview.stall_age_s").set(round(stall_age, 3))
+            for h, ev in per_host.items():
+                mfu = ev.get("mfu")
+                if isinstance(mfu, (int, float)):
+                    self.registry.gauge(f"podview.host{h}.mfu").set(float(mfu))
+        return skew
+
+    # -- evidence ----------------------------------------------------------
+
+    def report(self) -> dict:
+        """The ``podview_report.json`` sidecar body: last verdict, skew
+        history, cost attribution, and the monitor's own overhead."""
+        last = self.history[-1] if self.history else None
+        return {
+            "schema": PODVIEW_REPORT_SCHEMA,
+            "host": self.host,
+            "hosts": self.hosts,
+            "run_id": self.run_id,
+            "threshold": self.threshold,
+            "skew_frac": last["skew_frac"] if last else None,
+            "slowest_host": last["slowest_host"] if last else None,
+            "cause": last["cause"] if last else None,
+            "history": self.history[-32:],
+            "attribution": collective_attribution(self.parallel, self._scaling),
+            "overhead_s": round(self.overhead_s, 6),
+        }
+
+    def shard_tails(self, tail_lines: int = 50) -> Dict[int, List[str]]:
+        """The last ``tail_lines`` raw lines of every host shard — the
+        per-host evidence an incident bundle captures."""
+        tails: Dict[int, List[str]] = {}
+        for h, path in list_host_shards(self.base_dir).items():
+            try:
+                with open(path) as f:
+                    tails[h] = f.read().splitlines()[-tail_lines:]
+            except OSError:
+                continue
+        return tails
+
+
+def validate_podview_report(data) -> List[str]:
+    """Schema check for a ``podview_report.json`` body; returns problems
+    (empty = valid). Mirrored package-free in ``lint/artifacts.py`` so
+    ``graftlint --artifacts`` holds committed sidecars to the same bar."""
+    problems: List[str] = []
+    if not isinstance(data, dict):
+        return ["podview report is not a dict"]
+    if not isinstance(data.get("schema"), int):
+        problems.append("missing/invalid field 'schema' (int)")
+    for field in ("host", "hosts"):
+        if not isinstance(data.get(field), int):
+            problems.append(f"missing/invalid field {field!r} (int)")
+    if not isinstance(data.get("threshold"), (int, float)):
+        problems.append("missing/invalid field 'threshold' (number)")
+    if not isinstance(data.get("history"), list):
+        problems.append("missing/invalid field 'history' (list)")
+    if not isinstance(data.get("attribution"), dict):
+        problems.append("missing/invalid field 'attribution' (dict)")
+    sh = data.get("slowest_host")
+    if sh is not None and not isinstance(sh, int):
+        problems.append("field 'slowest_host' must be an int or null")
+    return problems

@@ -237,13 +237,17 @@ class MetricsRegistry:
         if self._rank is None:
             # resolve OUTSIDE the lock — process_index() can block on
             # backend init for seconds; racing resolvers compute the
-            # same value and the first write under the lock wins
-            try:
-                import jax
+            # same value and the first write under the lock wins. The
+            # podview simulated-host override wins over jax so per-host
+            # Prometheus exports stay distinguishable on one machine.
+            r = knobs.get_int("HYDRAGNN_PODVIEW_HOST", -1)
+            if r < 0:
+                try:
+                    import jax
 
-                r = jax.process_index()
-            except Exception:
-                r = 0
+                    r = jax.process_index()
+                except Exception:
+                    r = 0
             with self._lock:
                 if self._rank is None:
                     self._rank = r

@@ -57,6 +57,19 @@ class StepSpans:
         # emitted as a one-span trace keyed (epoch, step), joining the
         # train timeline with serve request traces
         self.tracer = tracer
+        # deterministic straggler injection (HYDRAGNN_INJECT_STRAGGLER=
+        # "HOST:MS"): when this process IS the named podview host, every
+        # step sleeps MS — inflating its host_epoch summary so the
+        # rank-0 SkewMonitor's step_skew rule has a real signal
+        self._straggle_s = 0.0
+        from hydragnn_tpu.obs import podview
+
+        spec = podview.straggler_spec()
+        if spec is not None and spec[0] == podview.host_identity()[0]:
+            self._straggle_s = spec[1]
+        # (process_index, process_count) stamped into epoch snapshots;
+        # resolved lazily so construction never forces backend init
+        self._host_identity: Optional[tuple] = None
         self._reset()
 
     @staticmethod
@@ -95,6 +108,8 @@ class StepSpans:
         """Run one train step, recording dispatch time; inside the
         sampling window, fence the outputs and record device wait."""
         t0 = time.perf_counter()
+        if self._straggle_s:
+            time.sleep(self._straggle_s)
         sampling = (
             self.skip_first <= self.steps < self.skip_first + self.sample_steps
         )
@@ -149,8 +164,14 @@ class StepSpans:
         """One epoch's breakdown, flight-record-ready. Millisecond
         per-step means; seconds for the epoch totals."""
         sampled = max(self.sampled, 1) if self.sampled else 0
+        if self._host_identity is None:
+            from hydragnn_tpu.obs import podview
+
+            self._host_identity = podview.host_identity()
         return {
             "steps": self.steps,
+            "process_index": self._host_identity[0],
+            "process_count": self._host_identity[1],
             "data_wait_s": round(self.data_wait_s, 6),
             "dispatch_s": round(self.dispatch_s, 6),
             "first_step_s": round(self.first_step_s, 6),

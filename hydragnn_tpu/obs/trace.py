@@ -233,6 +233,7 @@ def flight_to_chrome(record: Union[str, List[dict]]) -> dict:
                 run = str(man.get("log_name") or man.get("run") or run)
             break
     out: List[dict] = []
+    hosts_seen: set = set()
     for i, ev in enumerate(events):
         kind = ev.get("kind")
         if kind == "trace_capture":
@@ -263,6 +264,8 @@ def flight_to_chrome(record: Union[str, List[dict]]) -> dict:
             for key in ("train_loss", "val_loss", "steps"):
                 if key in ev:
                     args[key] = ev[key]
+            tid = int(ev.get("host", ev.get("rank", 0)) or 0)
+            hosts_seen.add(tid)
             out.append(
                 {
                     "name": f"epoch {ev.get('epoch')}",
@@ -270,16 +273,63 @@ def flight_to_chrome(record: Union[str, List[dict]]) -> dict:
                     "ts": round((t1 - dur_s) * 1e6, 1),
                     "dur": round(dur_s * 1e6, 1),
                     "pid": 0,
-                    "tid": int(ev.get("rank", 0) or 0),
+                    "tid": tid,
                     "args": args,
+                }
+            )
+        elif kind == "host_epoch":
+            # per-host epoch summary (obs/podview.py): one interval per
+            # host per epoch — the merged multihost timeline's per-host
+            # tracks (tid = host index)
+            t1 = float(ev.get("t", 0.0))
+            try:
+                dur_s = max(float(ev.get("epoch_s") or 0.0), 0.0)
+            except (TypeError, ValueError):
+                dur_s = 0.0
+            host = int(ev.get("host", ev.get("rank", 0)) or 0)
+            hosts_seen.add(host)
+            args = {"run": run, "epoch": ev.get("epoch"), "host": host}
+            for key in ("data_wait_s", "steps", "mfu", "run_id"):
+                if ev.get(key) is not None:
+                    args[key] = ev[key]
+            out.append(
+                {
+                    "name": f"host{host} epoch {ev.get('epoch')}",
+                    "ph": "X",
+                    "ts": round((t1 - dur_s) * 1e6, 1),
+                    "dur": round(dur_s * 1e6, 1),
+                    "pid": 0,
+                    "tid": host,
+                    "args": args,
+                }
+            )
+    # name the per-host tracks so Perfetto shows "host k" instead of a
+    # bare thread id (only worth the metadata rows when >1 host)
+    if len(hosts_seen) > 1:
+        for h in sorted(hosts_seen):
+            out.append(
+                {
+                    "name": "thread_name",
+                    "ph": "M",
+                    "pid": 0,
+                    "tid": h,
+                    "args": {"name": f"host {h}"},
                 }
             )
     return {"traceEvents": out, "displayTimeUnit": "ms"}
 
 
 def export_flight_chrome(record_path: str, out_path: str) -> str:
-    """``flight_to_chrome`` to a file (atomic write); returns out_path."""
-    data = flight_to_chrome(record_path)
+    """``flight_to_chrome`` to a file (atomic write); returns out_path.
+    ``record_path`` may be a run DIRECTORY holding per-host flight
+    shards — they are merged first (obs/podview.py), yielding one
+    timeline with one track per host."""
+    if os.path.isdir(record_path):
+        from hydragnn_tpu.obs.podview import merge_host_flights
+
+        data = flight_to_chrome(merge_host_flights(record_path).events)
+    else:
+        data = flight_to_chrome(record_path)
     d = os.path.dirname(os.path.abspath(out_path))
     os.makedirs(d, exist_ok=True)
     tmp = f"{out_path}.{os.getpid()}.{threading.get_ident()}.tmp"

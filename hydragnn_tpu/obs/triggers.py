@@ -36,6 +36,14 @@ Rule kinds (:data:`RULE_KINDS`):
                         consecutive failed recovery cycles; never
                         evaluated by the engine, but its incident
                         manifests must validate like any other
+  - ``step_skew``     — podview gauge (``podview.skew_frac``: the
+                        cross-host epoch-duration skew the rank-0
+                        ``obs/podview.SkewMonitor`` publishes) over a
+                        threshold derived from the scaling model's
+                        ``skew_tolerance`` block
+  - ``host_stall``    — podview gauge (``podview.stall_age_s``: seconds
+                        since the least-recently-heard-from host's last
+                        flight event) over a threshold
 
 Firing is **rate-limited** (per-engine cooldown + max incident count)
 and **overhead-budgeted** (a capture is refused once capture time
@@ -80,6 +88,8 @@ RULE_KINDS = (
     "loss_spike",
     "nonfinite_burst",
     "pilot_stuck",
+    "step_skew",
+    "host_stall",
 )
 
 #: which rule kinds read a registry metric (vs an observed series)
@@ -91,6 +101,8 @@ _REGISTRY_KINDS = (
     "pred_drift",
     "error_drift",
     "nonfinite_burst",
+    "step_skew",
+    "host_stall",
 )
 
 #: drift kinds read a DriftMonitor-published gauge (obs/drift.py); the
@@ -221,15 +233,21 @@ class TriggerEngine:
                     rule.threshold, now, detail={"count": snap.get("count")},
                 )
             return None
-        if rule.kind in ("queue_depth", "queue_age"):
+        if rule.kind in ("queue_depth", "queue_age", "step_skew", "host_stall"):
             g = self.registry.get(rule.metric)
             if g is None or not hasattr(g, "value"):
                 return None
             v = float(g.value)
             if v > rule.threshold:
+                detail: Dict[str, Any] = {}
+                if rule.kind in ("step_skew", "host_stall"):
+                    # evidence: which host the podview monitor blamed
+                    sg = self.registry.get("podview.slowest_host")
+                    if sg is not None and hasattr(sg, "value"):
+                        detail["slowest_host"] = int(sg.value)
                 return TriggerVerdict(
                     rule.name, rule.kind, rule.metric, round(v, 6),
-                    rule.threshold, now,
+                    rule.threshold, now, detail=detail,
                 )
             return None
         if rule.kind in _DRIFT_KINDS:
@@ -410,7 +428,7 @@ class Incident:
     # -- sidecars ----------------------------------------------------------
 
     def write_sidecars(self, registry=None, flight_path: Optional[str] = None,
-                       tail_lines: int = 100) -> None:
+                       tail_lines: int = 100, podview=None) -> None:
         _atomic_json(os.path.join(self.dir, "trigger.json"), self.verdict.to_dict())
         self.files["trigger"] = "trigger.json"
         if registry is not None:
@@ -431,6 +449,25 @@ class Incident:
                 self.files["flight_tail"] = "flight_tail.jsonl"
             except OSError:
                 pass
+        if podview is not None:
+            # pod-visibility evidence (obs/podview.py SkewMonitor): the
+            # skew report naming the offending host, plus every OTHER
+            # host shard's tail (rank 0's tail is flight_tail.jsonl)
+            try:
+                _atomic_json(
+                    os.path.join(self.dir, "podview_report.json"),
+                    podview.report(),
+                )
+                self.files["podview_report"] = "podview_report.json"
+                for h, lines in sorted(podview.shard_tails(tail_lines).items()):
+                    if h == 0:
+                        continue
+                    name = f"flight_tail.host{h}.jsonl"
+                    with open(os.path.join(self.dir, name), "w") as f:
+                        f.write("\n".join(lines) + ("\n" if lines else ""))
+                    self.files[f"flight_tail_host{h}"] = name
+            except Exception:
+                pass  # evidence capture must never fail the incident
         _atomic_json(
             os.path.join(self.dir, "chip_hygiene.json"), _chip_hygiene_report()
         )
@@ -532,10 +569,14 @@ class IncidentRecorder:
         overhead_frac: Optional[float] = None,
         clock: Callable[[], float] = time.monotonic,
         on_close: Optional[Callable[[Incident, str], None]] = None,
+        podview=None,
     ):
         self.root = root
         self.registry = registry
         self.flight_path = flight_path
+        # optional obs/podview.SkewMonitor: every bundle then carries
+        # podview_report.json + all host shards' tails as evidence
+        self.podview = podview
         # called AFTER each incident closes (outside the lock) with
         # (incident, status) — the server uses it to release spool-shard
         # pins held for the incident's drift evidence
@@ -597,7 +638,11 @@ class IncidentRecorder:
                 clock=self._clock,
             )
             self._open = inc
-        inc.write_sidecars(registry=self.registry, flight_path=self.flight_path)
+        inc.write_sidecars(
+            registry=self.registry,
+            flight_path=self.flight_path,
+            podview=self.podview,
+        )
         if flight is not None:
             flight.record("incident", id=iid, rule=verdict.rule, path=bundle)
         return inc

@@ -618,6 +618,15 @@ class Partitioner:
             "multihost": self.multihost,
             "device_stack": self.device_stack,
         }
+        # pod-visibility identity (obs/podview.py): which host committed
+        # this layout and how many peers it expects — the inputs the
+        # SkewMonitor's collective-aware cost attribution joins on
+        try:
+            from hydragnn_tpu.obs.podview import host_identity
+
+            info["process_index"], info["process_count"] = host_identity()
+        except Exception:
+            pass
         if state is not None:
             sh, replicated = self._state_sharding_with_report(state)
             info["params"] = self._section_summary(

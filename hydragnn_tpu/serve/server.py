@@ -789,11 +789,15 @@ class ModelServer:
         """Write this server's metrics as a Prometheus textfile snapshot
         (atomic rename; point a node-exporter textfile collector at it
         and scrape — no HTTP server in-process). Refreshes the health
-        gauges first so the probe signals are current."""
+        gauges first so the probe signals are current. On a non-zero
+        host (real process or podview simulated host) the path is
+        suffixed ``<name>.host<k><ext>`` so a second host's probe file
+        never clobbers the first's (obs/podview.py)."""
         from hydragnn_tpu.obs.export import registry_to_prometheus
+        from hydragnn_tpu.obs.podview import host_artifact_path
 
         self.health()
-        registry_to_prometheus(self.metrics.registry, path)
+        registry_to_prometheus(self.metrics.registry, host_artifact_path(path))
 
     def _export_tick(self) -> None:
         """Periodic textfile export from the supervisor's monitor thread

@@ -741,14 +741,42 @@ def train_validate_test(
     )
 
     telemetry_on = telemetry_enabled()
+    # Pod-visibility plane (obs/podview.py, docs/OBSERVABILITY.md "Pod
+    # visibility"): when the run spans >1 host (real or simulated via
+    # HYDRAGNN_PODVIEW*), every host writes its own flight shard —
+    # rank 0 keeps the canonical flight.jsonl, host k writes
+    # flight.host<k>.jsonl — instead of non-zero ranks staying silent.
+    from hydragnn_tpu.obs import podview as _podview
+
+    pv_host, pv_hosts = _podview.host_identity()
+    pv_on = telemetry_on and _podview.podview_enabled()
+    pv_run_id = _podview.resolve_run_id(log_name)
+    pv_monitor = None
+    pv_overhead_s = 0.0
+    pv_t_run0 = time.perf_counter()
     own_flight = flight is None
     if flight is None:
-        flight_path = (
-            os.path.join(log_dir, log_name, "flight.jsonl")
-            if telemetry_on and jax.process_index() == 0
-            else None
+        if telemetry_on and (pv_host == 0 or pv_on):
+            flight_path = _podview.host_flight_path(
+                os.path.join(log_dir, log_name), pv_host
+            )
+        else:
+            flight_path = None
+        flight = FlightRecorder(
+            flight_path,
+            enabled=telemetry_on,
+            host=pv_host if pv_on else None,
         )
-        flight = FlightRecorder(flight_path, enabled=telemetry_on)
+    if pv_on and pv_host == 0:
+        from hydragnn_tpu.obs import get_registry as _get_registry
+
+        pv_monitor = _podview.SkewMonitor(
+            os.path.join(log_dir, log_name),
+            host=pv_host,
+            hosts=pv_hosts,
+            run_id=pv_run_id,
+            registry=_get_registry(),
+        )
     spans = StepSpans() if telemetry_on else StepSpans.disabled()
     cmon = CompileMonitor().start() if telemetry_on else None
     if profiler is not None and getattr(profiler, "on_trace", None) is None:
@@ -780,34 +808,56 @@ def train_validate_test(
             TriggerRule,
         )
 
-        trig_engine = TriggerEngine(
-            [
+        trig_rules = [
+            TriggerRule(
+                "train_nonfinite_burst",
+                "nonfinite_burst",
+                "train.nonfinite_skipped",
+                float(training.get("slo_nonfinite_burst", 1)),
+            ),
+            TriggerRule(
+                "train_loss_spike",
+                "loss_spike",
+                "train_loss",
+                float(training.get("slo_loss_spike_factor", 3.0)),
+            ),
+            TriggerRule(
+                "train_mfu_drop",
+                "mfu_drop",
+                "mfu",
+                float(training.get("slo_mfu_drop_factor", 0.5)),
+            ),
+        ]
+        if pv_monitor is not None:
+            # cross-host skew rules over the gauges the SkewMonitor
+            # publishes; the step_skew threshold defaults to the
+            # scaling model's skew_tolerance derivation
+            trig_rules.append(
                 TriggerRule(
-                    "train_nonfinite_burst",
-                    "nonfinite_burst",
-                    "train.nonfinite_skipped",
-                    float(training.get("slo_nonfinite_burst", 1)),
-                ),
+                    "podview_step_skew",
+                    "step_skew",
+                    "podview.skew_frac",
+                    float(
+                        training.get("podview_skew_threshold")
+                        or pv_monitor.threshold
+                    ),
+                )
+            )
+            trig_rules.append(
                 TriggerRule(
-                    "train_loss_spike",
-                    "loss_spike",
-                    "train_loss",
-                    float(training.get("slo_loss_spike_factor", 3.0)),
-                ),
-                TriggerRule(
-                    "train_mfu_drop",
-                    "mfu_drop",
-                    "mfu",
-                    float(training.get("slo_mfu_drop_factor", 0.5)),
-                ),
-            ],
-            registry=get_registry(),
-        )
+                    "podview_host_stall",
+                    "host_stall",
+                    "podview.stall_age_s",
+                    knobs.get_float("HYDRAGNN_PODVIEW_STALL_S", 120.0),
+                )
+            )
+        trig_engine = TriggerEngine(trig_rules, registry=get_registry())
         if jax.process_index() == 0:
             incidents = IncidentRecorder(
                 os.path.join(log_dir, log_name, "incidents"),
                 registry=get_registry(),
                 flight_path=flight.path,
+                podview=pv_monitor,
             )
 
     # Model-level introspection (hydragnn_tpu/obs/introspect.py,
@@ -997,6 +1047,10 @@ def train_validate_test(
             "available": False,
             "reason": "caller passed no partitioner",
         }
+    if pv_monitor is not None:
+        # the committed layout feeds the SkewMonitor's collective-aware
+        # cost attribution (compute vs wire split in podview_report.json)
+        pv_monitor.set_parallel(parallel_block)
     # graftcheck contract block (lint/ir.py, docs/LINT.md CC rules): the
     # run's OWN train step, lowered and audited for the static contracts
     # the full checker (tools/graftcheck.py) gates in CI — so every
@@ -1065,6 +1119,14 @@ def train_validate_test(
             "mesh": {
                 "device_stack": getattr(train_loader, "device_stack", 1),
                 "process_count": jax.process_count(),
+            },
+            # pod-visibility identity (obs/podview.py): which host shard
+            # this is and the shared run id the merge reader joins on
+            "podview": {
+                "enabled": pv_on,
+                "host": pv_host,
+                "hosts": pv_hosts,
+                "run_id": pv_run_id,
             },
             "parallel": parallel_block,
             "pad_plans": {
@@ -1578,6 +1640,37 @@ def train_validate_test(
             **extra,
         )
 
+        # pod-visibility (obs/podview.py): append this host's epoch
+        # summary to its shard — the lightweight cross-host exchange
+        # unit — and, on rank 0, fold every host's summaries into the
+        # podview.* skew gauges. Runs BEFORE trigger evaluation so the
+        # step_skew / host_stall rules see THIS epoch's skew.
+        if pv_on:
+            _t_pv0 = time.perf_counter()
+            pv_summary = {
+                "hosts": pv_hosts,
+                "epoch_s": round(train_wall_s, 6),
+                "data_wait_s": (span_snap or {}).get("data_wait_s"),
+                "dispatch_s": (span_snap or {}).get("dispatch_s"),
+                "steps": (span_snap or {}).get("steps", len(train_loader)),
+                "nonfinite_skipped": (nonfinite or {}).get("skipped", 0),
+                "mfu": hw.get("mfu") if hw is not None else None,
+            }
+            flight.record(
+                "host_epoch",
+                epoch=epoch,
+                host=pv_host,
+                run_id=pv_run_id,
+                **pv_summary,
+            )
+            if pv_monitor is not None:
+                pv_skew = pv_monitor.observe_epoch(
+                    epoch, dict(pv_summary, epoch=epoch)
+                )
+                if pv_skew is not None:
+                    flight.record("podview", **pv_skew)
+            pv_overhead_s += time.perf_counter() - _t_pv0
+
         # SLO trigger evaluation at the epoch boundary: feed the rolling
         # series the rules watch, then let at most one verdict open an
         # incident whose profiler capture runs during the NEXT epoch's
@@ -1622,8 +1715,11 @@ def train_validate_test(
         # Prometheus textfile export for training (serve already has
         # one): one atomic train.prom snapshot per epoch, gated by
         # Training.prometheus_dir (docs/OBSERVABILITY.md)
+        # rank 0 keeps the legacy train.prom name; any other host (real
+        # process or simulated podview host) writes train.host<k>.prom
+        # so a second host never clobbers the first
         prom_dir = training.get("prometheus_dir")
-        if prom_dir and telemetry_on and jax.process_index() == 0:
+        if prom_dir and telemetry_on and (jax.process_index() == 0 or pv_on):
             from hydragnn_tpu.obs import get_registry
             from hydragnn_tpu.obs.export import registry_to_prometheus
 
@@ -1639,7 +1735,12 @@ def train_validate_test(
                     reg.gauge(f"train.head.{name}.grad_norm").set(v)
             if hw is not None and hw.get("mfu") is not None:
                 reg.gauge("train.mfu").set(hw["mfu"])
-            registry_to_prometheus(reg, os.path.join(prom_dir, "train.prom"))
+            registry_to_prometheus(
+                reg,
+                _podview.host_artifact_path(
+                    os.path.join(prom_dir, "train.prom"), pv_host
+                ),
+            )
 
         stop = stopper is not None and stopper(val_loss)
         epochs_done = epoch + 1
@@ -1750,6 +1851,25 @@ def train_validate_test(
         triggers=(
             trig_engine.summary(incidents.capture_s if incidents else 0.0)
             if trig_engine is not None
+            else None
+        ),
+        # measured cost of the pod-visibility plane: shard writes +
+        # rank-0 skew folds as a fraction of run wall time (the <1%
+        # clean-path acceptance gate ci.sh asserts)
+        podview=(
+            {
+                "enabled": True,
+                "host": pv_host,
+                "hosts": pv_hosts,
+                "run_id": pv_run_id,
+                "overhead_s": round(pv_overhead_s, 6),
+                "overhead_frac": round(
+                    pv_overhead_s
+                    / max(time.perf_counter() - pv_t_run0, 1e-9),
+                    8,
+                ),
+            }
+            if pv_on
             else None
         ),
     )

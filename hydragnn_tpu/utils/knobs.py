@@ -194,6 +194,11 @@ KNOBS: Dict[str, Knob] = {
            "resilience/inject.py",
            "B:S: the loader's producer sleeps S seconds before building "
            "batch B of an epoch (drives the hang watchdog)."),
+        _K("HYDRAGNN_INJECT_STRAGGLER", "spec", None, "obs/spans.py",
+           "HOST:MS: when this process's podview host index equals HOST, "
+           "sleep MS milliseconds inside every train step's span path — a "
+           "deterministic straggler that drives the step_skew trigger "
+           "(being an INJECT knob it also forces per-step dispatch)."),
         _K("HYDRAGNN_INJECT_TRIGGER", "spec", None, "resilience/inject.py",
            "RULE: force-fire the named SLO trigger rule once at the next "
            "TriggerEngine.evaluate (drives incident capture on demand)."),
@@ -240,6 +245,27 @@ KNOBS: Dict[str, Knob] = {
         _K("HYDRAGNN_PILOT_TUNE_EPOCHS", "int", "2", "pilot/tune.py",
            "Epochs the incremental fine-tune runs over the pinned spool "
            "window (starting from the serving checkpoint)."),
+        _K("HYDRAGNN_PODVIEW", "bool", "0", "obs/podview.py",
+           "Force-enable the pod-visibility plane (per-host flight "
+           "shards + SkewMonitor) even in a single-process run — the "
+           "simulated-host mode ci.sh and the tests use. Real multihost "
+           "runs (jax.process_count() > 1) enable it automatically."),
+        _K("HYDRAGNN_PODVIEW_HOST", "int", "-1", "obs/podview.py",
+           "Override this process's podview host index (simulated hosts "
+           "on one machine); -1/unset = use jax.process_index()."),
+        _K("HYDRAGNN_PODVIEW_HOSTS", "int", "0", "obs/podview.py",
+           "Override the expected host count the SkewMonitor and the "
+           "merge reader wait for; 0/unset = jax.process_count()."),
+        _K("HYDRAGNN_PODVIEW_RUN_ID", "str", None, "obs/podview.py",
+           "Shared run id stamped into host_epoch events — the merge "
+           "join key across host shards; unset = the run's log name."),
+        _K("HYDRAGNN_PODVIEW_SKEW", "float", "0", "train/loop.py",
+           "step_skew trigger threshold on podview.skew_frac; 0/unset = "
+           "derive from the committed scaling model's skew_tolerance "
+           "block (fallback 0.25)."),
+        _K("HYDRAGNN_PODVIEW_STALL_S", "float", "120", "train/loop.py",
+           "host_stall trigger threshold: seconds since the least-recent "
+           "host's last flight event before the stall incident fires."),
         _K("HYDRAGNN_RESIDENCY_VMEM_MB", "float", "12", "ops/fused_conv.py",
            "VMEM budget the cross-layer resident conv-stack kernel may "
            "claim (a TPU core has ~16 MB; the pipeline needs headroom)."),

@@ -1,0 +1,433 @@
+"""Pod-visibility plane tests (hydragnn_tpu/obs/podview.py): per-host
+flight shard naming and artifact-collision pinning, the merge reader's
+torn-tail / missing-host / duplicate tolerance, SkewMonitor math +
+gauges + report schema (runtime AND lint mirrors), the step_skew /
+host_stall trigger rules, straggler injection parsing, the
+scaling-model skew-tolerance coupling, and per-host Chrome tracks."""
+
+import json
+import os
+
+import pytest
+
+from hydragnn_tpu.obs import podview
+from hydragnn_tpu.obs.flight import (
+    FlightRecorder,
+    flight_record_warnings,
+    validate_flight_record,
+)
+from hydragnn_tpu.obs.podview import (
+    SkewMonitor,
+    collective_attribution,
+    host_artifact_path,
+    host_epoch_table,
+    host_flight_path,
+    host_identity,
+    list_host_shards,
+    load_skew_tolerance,
+    merge_host_flights,
+    straggler_spec,
+    validate_podview_report,
+)
+from hydragnn_tpu.obs.registry import MetricsRegistry
+
+_MANIFEST = {
+    "run": "podtest",
+    "mode": "train",
+    "jax_version": "0",
+    "backend": "cpu",
+    "device_kind": "cpu",
+    "num_processes": 2,
+    "config": {},
+}
+
+
+def _write_shard(base_dir, host, epochs, run_id="rid", slow_epochs=(),
+                 slow_s=0.5, data_wait_s=0.01, torn=False):
+    """One simulated host's shard: run_start + host_epoch rows (+ a
+    torn final line when asked)."""
+    path = host_flight_path(str(base_dir), host)
+    fr = FlightRecorder(path, enabled=True, host=host)
+    fr.start_run(dict(_MANIFEST))
+    for ep in range(epochs):
+        fr.record(
+            "host_epoch",
+            epoch=ep,
+            host=host,
+            run_id=run_id,
+            hosts=2,
+            epoch_s=1.0 + (slow_s if ep in slow_epochs else 0.0),
+            data_wait_s=data_wait_s,
+            steps=4,
+            nonfinite_skipped=0,
+            mfu=0.11 + host / 100.0,
+        )
+    fr.end_run(status="completed")
+    if torn:
+        with open(path, "a") as f:
+            f.write('{"v": 2, "kind": "host_ep')  # crashed mid-append
+    return path
+
+
+# -- shard naming + artifact collisions --------------------------------------
+
+
+def test_host_flight_path_naming(tmp_path):
+    assert host_flight_path(str(tmp_path), 0).endswith("/flight.jsonl")
+    assert host_flight_path(str(tmp_path), 3).endswith("/flight.host3.jsonl")
+    _write_shard(tmp_path, 0, 1)
+    _write_shard(tmp_path, 2, 1)
+    shards = list_host_shards(str(tmp_path))
+    assert sorted(shards) == [0, 2]
+    assert shards[0].endswith("flight.jsonl")
+    assert shards[2].endswith("flight.host2.jsonl")
+
+
+def test_host_artifact_path_pins_prom_collision():
+    # satellite: two hosts sharing a prometheus textfile dir must not
+    # clobber each other; rank 0 keeps the legacy name
+    assert host_artifact_path("/x/train.prom", 0) == "/x/train.prom"
+    assert host_artifact_path("/x/train.prom", 2) == "/x/train.host2.prom"
+    assert host_artifact_path("/x/serve_probe.prom", 1) == (
+        "/x/serve_probe.host1.prom"
+    )
+
+
+def test_host_identity_knob_overrides(monkeypatch):
+    monkeypatch.setenv("HYDRAGNN_PODVIEW_HOST", "3")
+    monkeypatch.setenv("HYDRAGNN_PODVIEW_HOSTS", "8")
+    assert host_identity() == (3, 8)
+    # hosts never reported below host+1 even when the knobs disagree
+    monkeypatch.setenv("HYDRAGNN_PODVIEW_HOSTS", "2")
+    assert host_identity() == (3, 4)
+    assert podview.podview_enabled()
+
+
+def test_resolve_run_id(monkeypatch):
+    assert podview.resolve_run_id("fallback") == "fallback"
+    monkeypatch.setenv("HYDRAGNN_PODVIEW_RUN_ID", "shared-id")
+    assert podview.resolve_run_id("fallback") == "shared-id"
+
+
+# -- merge reader ------------------------------------------------------------
+
+
+def test_merge_stamps_hosts_and_validates(tmp_path):
+    _write_shard(tmp_path, 0, 2)
+    _write_shard(tmp_path, 1, 2)
+    merged = merge_host_flights(str(tmp_path))
+    assert merged.hosts == [0, 1]
+    assert merged.problems == []
+    # every merged event carries its host; host_epoch joins on epoch
+    assert all("host" in ev for ev in merged.events)
+    table = host_epoch_table(merged.events, run_id="rid")
+    assert sorted(table) == [0, 1]
+    assert sorted(table[0]) == [0, 1]
+    # the merged timeline is schema-valid, and the new host field is an
+    # ordinary extra field: no forward-compat warnings
+    assert validate_flight_record(merged.events) == []
+    assert flight_record_warnings(merged.events) == []
+
+
+def test_merge_tolerates_torn_tail(tmp_path):
+    _write_shard(tmp_path, 0, 2)
+    _write_shard(tmp_path, 1, 2, torn=True)
+    merged = merge_host_flights(str(tmp_path))
+    assert merged.hosts == [0, 1]
+    assert any("torn tail" in p for p in merged.problems)
+    # the readable prefix of the torn shard still merged
+    assert len(host_epoch_table(merged.events)[1]) == 2
+    assert validate_flight_record(merged.events) == []
+
+
+def test_merge_reports_missing_host(tmp_path):
+    # host_epoch events promise hosts=2 but only host 0 wrote a shard
+    _write_shard(tmp_path, 0, 2)
+    merged = merge_host_flights(str(tmp_path))
+    assert merged.hosts == [0]
+    assert any("missing host shard(s): [1]" in p for p in merged.problems)
+    # advisory, never fatal: the single-host timeline still validates
+    assert validate_flight_record(merged.events) == []
+
+
+def test_merge_flags_duplicate_run_id_epoch(tmp_path):
+    path = _write_shard(tmp_path, 1, 1)
+    fr = FlightRecorder(path, enabled=True, host=1)
+    fr.record("host_epoch", epoch=0, host=1, run_id="rid", hosts=1,
+              epoch_s=2.0)
+    merged = merge_host_flights(str(tmp_path))
+    assert any("duplicate host_epoch" in p for p in merged.problems)
+
+
+def test_merge_accepts_explicit_paths_and_single_file(tmp_path):
+    p0 = _write_shard(tmp_path, 0, 1)
+    p1 = _write_shard(tmp_path, 1, 1)
+    merged = merge_host_flights([p0, p1])
+    assert merged.hosts == [0, 1]
+    single = merge_host_flights(p1)
+    assert single.hosts == [1]
+
+
+# -- skew monitor ------------------------------------------------------------
+
+
+def test_skew_monitor_math_gauges_and_report(tmp_path):
+    # host 1's epoch 1 runs 0.5s long: skew = 0.5 / 1.5
+    _write_shard(tmp_path, 1, 2, slow_epochs=(1,), slow_s=0.5)
+    reg = MetricsRegistry(enabled=True, rank=0)
+    mon = SkewMonitor(str(tmp_path), host=0, hosts=2, run_id="rid",
+                      registry=reg, threshold=0.2)
+    own = {"epoch_s": 1.0, "data_wait_s": 0.01, "mfu": 0.11}
+    skew0 = mon.observe_epoch(0, dict(own, epoch=0))
+    assert skew0 is not None and skew0["skew_frac"] == 0.0
+    skew1 = mon.observe_epoch(1, dict(own, epoch=1))
+    assert skew1["slowest_host"] == 1
+    assert skew1["skew_frac"] == pytest.approx(0.5 / 1.5, abs=1e-6)
+    assert skew1["cause"] == "host_slow"
+    assert reg.gauge("podview.skew_frac").value == skew1["skew_frac"]
+    assert reg.gauge("podview.slowest_host").value == 1.0
+    assert reg.gauge("podview.host1.mfu").value == pytest.approx(0.12)
+    # the sidecar body passes the runtime validator AND the package-free
+    # lint mirror
+    report = mon.report()
+    assert validate_podview_report(report) == []
+    from hydragnn_tpu.lint.artifacts import _check_podview_report
+
+    assert _check_podview_report(json.loads(json.dumps(report))) == []
+    assert report["slowest_host"] == 1
+    assert len(report["history"]) == 2
+    assert mon.overhead_s > 0.0
+
+
+def test_skew_monitor_data_wait_attribution(tmp_path):
+    # the slowest host spent the excess waiting on data, not computing
+    _write_shard(tmp_path, 1, 1, slow_epochs=(0,), slow_s=0.5,
+                 data_wait_s=0.4)
+    mon = SkewMonitor(str(tmp_path), host=0, hosts=2, run_id="rid",
+                      threshold=0.2)
+    skew = mon.observe_epoch(
+        0, {"epoch": 0, "epoch_s": 1.0, "data_wait_s": 0.0}
+    )
+    assert skew["cause"] == "data_wait"
+
+
+def test_skew_monitor_single_host_returns_none(tmp_path):
+    reg = MetricsRegistry(enabled=True, rank=0)
+    mon = SkewMonitor(str(tmp_path), host=0, hosts=1, run_id="rid",
+                      registry=reg)
+    assert mon.observe_epoch(0, {"epoch_s": 1.0}) is None
+    assert reg.gauge("podview.skew_frac").value == 0.0
+    assert reg.gauge("podview.slowest_host").value == -1.0
+
+
+def test_skew_monitor_stall_age_for_silent_peer(tmp_path):
+    # a peer that never writes counts as stalled from monitor birth
+    reg = MetricsRegistry(enabled=True, rank=0)
+    mon = SkewMonitor(str(tmp_path), host=0, hosts=2, run_id="rid",
+                      registry=reg)
+    mon._t0 -= 100.0
+    mon.observe_epoch(0, {"epoch_s": 1.0})
+    assert reg.gauge("podview.stall_age_s").value >= 100.0
+
+
+def test_skew_monitor_never_raises(tmp_path, monkeypatch):
+    mon = SkewMonitor(str(tmp_path), host=0, hosts=2)
+    monkeypatch.setattr(
+        podview, "list_host_shards",
+        lambda *_: (_ for _ in ()).throw(RuntimeError("fs exploded")),
+    )
+    assert mon.observe_epoch(0, {"epoch_s": 1.0}) is None  # degraded, alive
+
+
+# -- trigger rules -----------------------------------------------------------
+
+
+def test_step_skew_and_host_stall_trigger_rules():
+    from hydragnn_tpu.obs.triggers import (
+        RULE_KINDS,
+        TriggerEngine,
+        TriggerRule,
+    )
+
+    assert "step_skew" in RULE_KINDS and "host_stall" in RULE_KINDS
+    reg = MetricsRegistry(enabled=True, rank=0)
+    reg.gauge("podview.skew_frac").set(0.6)
+    reg.gauge("podview.stall_age_s").set(10.0)
+    reg.gauge("podview.slowest_host").set(3.0)
+    eng = TriggerEngine(
+        [
+            TriggerRule("skew", "step_skew", "podview.skew_frac", 0.25),
+            TriggerRule("stall", "host_stall", "podview.stall_age_s", 120.0),
+        ],
+        registry=reg,
+        cooldown_s=0.0,
+    )
+    fired = eng.evaluate()
+    assert [v.kind for v in fired] == ["step_skew"]
+    assert fired[0].detail["slowest_host"] == 3  # names the blamed host
+    # below threshold: quiet
+    reg.gauge("podview.skew_frac").set(0.1)
+    assert eng.evaluate() == []
+
+
+def test_incident_bundle_carries_podview_evidence(tmp_path, monkeypatch):
+    from hydragnn_tpu.utils import profile
+
+    monkeypatch.setattr(profile, "try_start_capture", lambda prefix: False)
+    from hydragnn_tpu.obs.triggers import (
+        IncidentRecorder,
+        TriggerVerdict,
+        validate_incident_manifest,
+    )
+
+    run_dir = tmp_path / "run"
+    run_dir.mkdir()
+    _write_shard(run_dir, 0, 1)
+    _write_shard(run_dir, 1, 1, slow_epochs=(0,), slow_s=1.0)
+    mon = SkewMonitor(str(run_dir), host=0, hosts=2, run_id="rid",
+                      threshold=0.2)
+    mon.observe_epoch(0, {"epoch": 0, "epoch_s": 1.0, "data_wait_s": 0.0})
+    rec = IncidentRecorder(str(tmp_path / "incidents"), podview=mon)
+    verdict = TriggerVerdict(
+        "skew", "step_skew", "podview.skew_frac", 0.5, 0.2, 1.0,
+        detail={"slowest_host": 1},
+    )
+    inc = rec.open_incident(verdict)
+    rec.tick()
+    rec.tick()
+    rec.tick()
+    assert rec.open is None  # closed
+    sidecar = os.path.join(inc.dir, "podview_report.json")
+    with open(sidecar) as f:
+        report = json.load(f)
+    assert validate_podview_report(report) == []
+    assert report["slowest_host"] == 1  # names the offending host
+    # per-host evidence: the peer shard's tail rides along
+    assert os.path.exists(os.path.join(inc.dir, "flight_tail.host1.jsonl"))
+    with open(os.path.join(inc.dir, "incident_manifest.json")) as f:
+        manifest = json.load(f)
+    assert validate_incident_manifest(manifest) == []
+    assert manifest["kind"] == "step_skew"
+    assert manifest["files"]["podview_report"] == "podview_report.json"
+
+
+# -- straggler injection -----------------------------------------------------
+
+
+def test_straggler_spec_parsing(monkeypatch):
+    assert straggler_spec() is None
+    monkeypatch.setenv("HYDRAGNN_INJECT_STRAGGLER", "1:250")
+    assert straggler_spec() == (1, 0.25)
+    monkeypatch.setenv("HYDRAGNN_INJECT_STRAGGLER", "garbage")
+    assert straggler_spec() is None  # malformed degrades to no injection
+
+
+def test_step_spans_inject_straggler_on_matching_host(monkeypatch):
+    monkeypatch.setenv("HYDRAGNN_PODVIEW_HOST", "1")
+    monkeypatch.setenv("HYDRAGNN_PODVIEW_HOSTS", "2")
+    monkeypatch.setenv("HYDRAGNN_INJECT_STRAGGLER", "1:50")
+    from hydragnn_tpu.obs.spans import StepSpans
+
+    spans = StepSpans()
+    assert spans._straggle_s == pytest.approx(0.05)
+    snap = spans.epoch_snapshot()
+    assert snap["process_index"] == 1
+    assert snap["process_count"] == 2
+    # the other host does not sleep
+    monkeypatch.setenv("HYDRAGNN_PODVIEW_HOST", "0")
+    assert StepSpans()._straggle_s == 0.0
+
+
+# -- scaling-model coupling --------------------------------------------------
+
+
+def test_load_skew_tolerance_committed_and_fallback(tmp_path, monkeypatch):
+    # the committed estimate at the repo root carries the block
+    assert load_skew_tolerance() == pytest.approx(0.2)
+    # absent block -> conservative fallback
+    bare = tmp_path / "SCALING_est_r99.json"
+    bare.write_text(json.dumps({"mesh": [1]}))
+    assert load_skew_tolerance(str(bare)) == podview.DEFAULT_SKEW_THRESHOLD
+    # knob override wins over the model derivation
+    monkeypatch.setenv("HYDRAGNN_PODVIEW_SKEW", "0.4")
+    assert podview.default_skew_threshold() == pytest.approx(0.4)
+
+
+def test_scaling_estimate_skew_tolerance_block():
+    import ast
+
+    src = open(
+        os.path.join(os.path.dirname(__file__), "..", "tools",
+                     "scaling_estimate.py")
+    ).read()
+    tree = ast.parse(src)
+    fn = next(
+        n for n in tree.body
+        if isinstance(n, ast.FunctionDef) and n.name == "skew_tolerance_block"
+    )
+    ns = {}
+    exec(compile(ast.Module(body=[fn], type_ignores=[]), "se", "exec"), ns)
+    block = ns["skew_tolerance_block"](
+        {"8": {"dp_efficiency_no_overlap": 0.9}, "x": {}}
+    )
+    assert block["per_width"]["8"]["skew_frac_threshold"] == pytest.approx(0.4)
+    assert "x" not in block["per_width"]
+    assert 0.2 <= block["default_step_skew_threshold"] <= 0.5
+    # the COMMITTED estimate carries the same block the monitor reads
+    root = os.path.join(os.path.dirname(__file__), "..")
+    with open(os.path.join(root, "SCALING_est_r06.json")) as f:
+        rec = json.load(f)
+    assert rec["skew_tolerance"] == ns["skew_tolerance_block"](rec["widths"])
+
+
+def test_collective_attribution_models_wire_share():
+    scaling = {
+        "step_ms_device_single_chip": 80.0,
+        "ici_gbps_assumed": 45.0,
+        "param_bytes_f32": 4.0e6,
+    }
+    out = collective_attribution(
+        {"available": True, "data": 4, "fsdp": 1,
+         "params": {"bytes_global": 4.0e6}},
+        scaling,
+    )
+    assert out["modeled"]
+    # ring all-reduce: 2(n-1)/n * 4MB at 45 GB/s
+    expect_ms = 2 * 3 / 4 * 4.0e6 / 45e9 * 1e3
+    assert out["wire_ms"] == pytest.approx(expect_ms, rel=1e-3)
+    assert 0.0 < out["wire_frac"] < 0.01
+    fsdp = collective_attribution(
+        {"available": True, "data": 4, "fsdp": 2,
+         "params": {"bytes_global": 4.0e6}},
+        scaling,
+    )
+    assert fsdp["wire_ms"] > out["wire_ms"]  # ag/rs traffic adds wire
+    off = collective_attribution(None, scaling)
+    assert not off["modeled"]
+
+
+# -- chrome export -----------------------------------------------------------
+
+
+def test_chrome_export_one_track_per_host(tmp_path):
+    from hydragnn_tpu.obs.trace import export_flight_chrome, flight_to_chrome
+
+    _write_shard(tmp_path, 0, 2)
+    _write_shard(tmp_path, 1, 2)
+    merged = merge_host_flights(str(tmp_path))
+    events = flight_to_chrome(merged.events)["traceEvents"]
+    host_spans = [
+        e for e in events
+        if e.get("ph") == "X" and str(e.get("name", "")).startswith("host")
+    ]
+    assert {e["tid"] for e in host_spans} == {0, 1}
+    names = [
+        e for e in events
+        if e.get("ph") == "M" and e.get("name") == "thread_name"
+    ]
+    assert {e["args"]["name"] for e in names} == {"host 0", "host 1"}
+    # the exporter accepts a run DIRECTORY and stitches it itself
+    out = tmp_path / "trace.json"
+    export_flight_chrome(str(tmp_path), str(out))
+    data = json.loads(out.read_text())["traceEvents"]
+    assert any(str(e.get("name", "")).startswith("host1") for e in data)

@@ -34,6 +34,10 @@ from hydragnn_tpu.obs.introspect import (  # noqa: E402
     collect_head_series,
     flag_anomalies,
 )
+from hydragnn_tpu.obs.podview import (  # noqa: E402
+    host_epoch_table,
+    merge_host_flights,
+)
 
 
 def _fmt(v, nd: int = 6) -> str:
@@ -417,6 +421,85 @@ def render_faults(events: List[dict]) -> str:
     return "\n".join(lines)
 
 
+_SPARK = "▁▂▃▄▅▆▇█"
+
+
+def _sparkline(values: List[object], lo: float = 0.0, hi: Optional[float] = None) -> str:
+    """Unicode block sparkline; non-numeric entries render as spaces."""
+    nums = [v for v in values if isinstance(v, (int, float))]
+    if not nums:
+        return ""
+    if hi is None:
+        hi = max(nums)
+    span = max(hi - lo, 1e-9)
+    out = []
+    for v in values:
+        if not isinstance(v, (int, float)):
+            out.append(" ")
+            continue
+        idx = int((v - lo) / span * (len(_SPARK) - 1) + 0.5)
+        out.append(_SPARK[min(len(_SPARK) - 1, max(0, idx))])
+    return "".join(out)
+
+
+def render_hosts(merged) -> str:
+    """The pod view (``--hosts``, over a run directory of per-host
+    flight shards): a per-epoch table with one row per host (epoch wall
+    time, data-wait, nonfinite skips, MFU), the merge reader's advisory
+    problems, and the rank-0 SkewMonitor's verdicts as a skew-fraction
+    sparkline across epochs (docs/OBSERVABILITY.md 'Pod visibility')."""
+    lines: List[str] = []
+    lines.append(
+        f"== hosts ({len(merged.hosts)}): "
+        f"{', '.join(str(h) for h in merged.hosts) or '(none)'} =="
+    )
+    for prob in merged.problems:
+        lines.append(f"  note: {prob}")
+    table = host_epoch_table(merged.events)
+    if not table:
+        lines.append(
+            "  (no host_epoch events — single-host record or podview off)"
+        )
+    else:
+        lines.append(
+            "    ep  host     epoch_s  data_wait_s  nonfinite          mfu"
+        )
+        for ep in sorted(table):
+            rows = sorted(table[ep].items())
+            slowest = (
+                max(rows, key=lambda kv: kv[1].get("epoch_s") or 0.0)[0]
+                if len(rows) > 1
+                else None
+            )
+            for h, ev in rows:
+                mark = "  <- slowest" if h == slowest else ""
+                lines.append(
+                    f"  {ep!s:>4} {h!s:>5} "
+                    f"{_fmt(ev.get('epoch_s', '-'), 5):>11} "
+                    f"{_fmt(ev.get('data_wait_s', '-'), 4):>12} "
+                    f"{ev.get('nonfinite_skipped', 0)!s:>10} "
+                    f"{_fmt(ev.get('mfu', '-'), 4):>12}{mark}"
+                )
+    verdicts = [e for e in merged.events if e.get("kind") == "podview"]
+    if verdicts:
+        vals = [e.get("skew_frac") for e in verdicts]
+        nums = [v for v in vals if isinstance(v, (int, float))]
+        last = verdicts[-1]
+        thr = last.get("threshold")
+        lines.append("== skew (rank-0 SkewMonitor) ==")
+        lines.append(
+            f"  skew_frac {_sparkline(vals, 0.0, max(nums + [thr or 0.0, 1e-9]))} "
+            f"(epochs {verdicts[0].get('epoch')}..{last.get('epoch')}, "
+            f"threshold {_fmt(thr, 4)})"
+        )
+        lines.append(
+            f"  last: skew_frac={_fmt(last.get('skew_frac'), 4)} "
+            f"slowest_host={last.get('slowest_host')} "
+            f"cause={last.get('cause')}"
+        )
+    return "\n".join(lines)
+
+
 def fault_schema_problems(events: List[dict]) -> List[str]:
     """Schema problems affecting the fault-history subset (what
     ``--faults`` gates on: a fault event that cannot be parsed is
@@ -486,7 +569,12 @@ def main(argv=None) -> int:
     import argparse
 
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
-    p.add_argument("records", nargs="+", help="flight-record .jsonl path(s)")
+    p.add_argument(
+        "records",
+        nargs="+",
+        help="flight-record .jsonl path(s), or run directories of "
+        "per-host shards (flight.jsonl + flight.host<k>.jsonl)",
+    )
     p.add_argument(
         "--validate",
         action="store_true",
@@ -510,6 +598,13 @@ def main(argv=None) -> int:
         "when any fault event fails its schema",
     )
     p.add_argument(
+        "--hosts",
+        action="store_true",
+        help="pod view over merged per-host flight shards: per-host "
+        "epoch table (wall, data-wait, nonfinite skips, MFU) and the "
+        "SkewMonitor skew sparkline; accepts a run directory",
+    )
+    p.add_argument(
         "--heads",
         action="store_true",
         help="multi-task health view: per-head loss/grad-norm/MAE "
@@ -524,6 +619,15 @@ def main(argv=None) -> int:
         # versions): surfaced, never fatal
         for w in flight_record_warnings(events):
             print(f"  WARNING: {w}")
+
+    if args.hosts:
+        for path in args.records:
+            merged = merge_host_flights(path)
+            if len(args.records) > 1:
+                print(f"===== {path} =====")
+            print(render_hosts(merged))
+            _print_warnings(merged.events)
+        return 0
 
     if args.heads:
         for path in args.records:
@@ -556,8 +660,41 @@ def main(argv=None) -> int:
         _print_warnings(b)
         return 0
 
+    import os
+
     rc = 0
     for path in args.records:
+        if args.validate and os.path.isdir(path):
+            # a run directory of per-host shards: the merged timeline
+            # must be schema-valid, but shard-level trouble (torn
+            # tails, missing hosts) is advisory — the surviving hosts'
+            # evidence still merges and must not fail the gate
+            merged = merge_host_flights(path)
+            problems = list(validate_flight_record(merged.events))
+            if args.require_complete:
+                # completeness is per shard: the merged timeline
+                # legitimately interleaves one run_start per host
+                from hydragnn_tpu.obs.podview import list_host_shards
+
+                for h, shard in sorted(list_host_shards(path).items()):
+                    for prob in validate_flight_record(
+                        shard, require_complete=True
+                    ):
+                        problems.append(f"host{h}: {prob}")
+            if problems:
+                rc = 1
+                print(f"{path}: INVALID ({len(problems)} problem(s))")
+                for prob in problems:
+                    print(f"  - {prob}")
+            else:
+                print(
+                    f"{path}: OK ({len(merged.events)} merged events from "
+                    f"{len(merged.hosts)} host shard(s))"
+                )
+            for prob in merged.problems:
+                print(f"  WARNING: {prob}")
+            _print_warnings(merged.events)
+            continue
         events = read_flight_record(path)
         if args.validate:
             problems = validate_flight_record(

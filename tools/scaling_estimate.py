@@ -221,6 +221,35 @@ def width_record(n_dev: int, comp: dict, dcn_slices: int = 1) -> dict:
     return rec
 
 
+def skew_tolerance_block(widths: dict) -> dict:
+    """Model-derived ``step_skew`` trigger defaults (consumed by
+    ``obs/podview.py`` as the default threshold on the cross-host
+    epoch-duration skew gauge). A layout's no-overlap efficiency already
+    concedes ``1 - eff`` of step time to exposed wire; observed skew
+    beyond ~4x that concession cannot be the modeled collectives and
+    indicates a genuine straggler. The threshold is floored at 0.2
+    (host-level noise on shared machines) and capped at 0.5."""
+    per_width = {}
+    worst = 0.0
+    for name, w in sorted(widths.items()):
+        eff = w.get("dp_efficiency_no_overlap")
+        if eff is None:
+            continue
+        thr = round(min(0.5, max(0.2, 4.0 * (1.0 - float(eff)))), 4)
+        per_width[name] = {
+            "dp_efficiency_no_overlap": eff,
+            "skew_frac_threshold": thr,
+        }
+        worst = max(worst, thr)
+    return {
+        "derivation": (
+            "threshold = clamp(4 x (1 - dp_efficiency_no_overlap), 0.2, 0.5)"
+        ),
+        "per_width": per_width,
+        "default_step_skew_threshold": round(worst, 4) if per_width else 0.25,
+    }
+
+
 def main():
     widths = {}
     comp_by_n = {}
@@ -283,6 +312,7 @@ def main():
         "steps_per_epoch_assumed": STEPS_PER_EPOCH,
         "param_bytes_f32": comp_by_n[MESH_SIZES[0]]["param_bytes"],
         "widths": widths,
+        "skew_tolerance": skew_tolerance_block(widths),
         "v4_32_projection": projection,
         "note": (
             "Collective bytes read from compiled SPMD HLO at each width "
