@@ -1167,6 +1167,190 @@ python tools/graftlint.py --artifacts \
     "$POD_DIR"/strag/logs/*/incidents/*/podview_report.json
 rm -rf "$POD_DIR"
 
+echo "== pod-recovery smoke (concurrent 2-host pod under supervise.py --pod: SIGKILL host 1 mid-checkpoint -> host_lost restart from the last COMMIT, losses bit-match the uninterrupted reference; elastic leg re-shards 2->1) =="
+PODREC_DIR="$(mktemp -d)"
+cat > "$PODREC_DIR/child.py" <<'EOF'
+"""One pod host's training run. tools/supervise.py --pod N launches N
+of these CONCURRENTLY (HYDRAGNN_PODVIEW_HOST=k/_HOSTS=N per child);
+run_guard maps TrainingPreempted/PodHostLost onto the supervisor's
+exit-code contract (docs/RESILIENCE.md 'Pod recovery')."""
+import sys
+
+from hydragnn_tpu.resilience import run_guard
+from hydragnn_tpu.api import run_training
+from hydragnn_tpu.data.synthetic import deterministic_graph_data
+from hydragnn_tpu.flagship import flagship_config
+
+cfg = flagship_config(hidden_dim=8, num_conv_layers=2, batch_size=5, num_epoch=3)
+cfg["NeuralNetwork"]["Training"]["checkpoint_every"] = 1
+# Pin one dispatch mode for every run in this smoke: armed HYDRAGNN_INJECT_*
+# vars force the per-step path (scan auto-eligibility), but the supervisor
+# strips them for restarted attempts and the uninterrupted reference never
+# has them — without the pin, the legs would compare scan-epoch losses
+# against per-step losses and the bit-match below would be meaningless.
+cfg["NeuralNetwork"]["Training"]["scan_epoch"] = False
+samples = deterministic_graph_data(
+    number_configurations=20,
+    unit_cell_x_range=(2, 3),
+    unit_cell_y_range=(2, 3),
+    unit_cell_z_range=(2, 3),
+    seed=0,
+)
+with run_guard():
+    run_training(cfg, samples=samples, log_dir=sys.argv[1] + "/logs/")
+EOF
+cat > "$PODREC_DIR/check_leg.py" <<'EOF'
+"""One recovery leg's evidence chain: supervisor flight (host_lost ->
+prompt restart), host 0's merged training flight (preempted segment +
+pod_resume lineage), the on-disk commit protocol, and the bit-match
+against the uninterrupted reference."""
+import glob
+import os
+import sys
+
+from hydragnn_tpu.obs.flight import read_flight_record
+from hydragnn_tpu.resilience.podckpt import latest_commit_info
+from hydragnn_tpu.utils.checkpoint import load_train_meta
+
+base, leg = sys.argv[1], sys.argv[2]
+want_width, want_gen = int(sys.argv[3]), int(sys.argv[4])
+
+# supervisor flight: exactly ONE host_lost (host 1, signal-dead) and
+# one host_lost-class restart — prompt (no backoff) at the expected
+# pod width (2 fixed, 1 elastic)
+sup = read_flight_record(os.path.join(base, f"sup{leg}.jsonl"))
+lost = [e for e in sup if e.get("kind") == "host_lost"]
+assert len(lost) == 1 and lost[0]["host"] == 1, lost
+assert int(lost[0]["exit_code"]) < 0, lost[0]
+restarts = [e for e in sup if e.get("kind") == "restart"]
+assert len(restarts) == 1 and restarts[0]["cause"] == "host_lost", restarts
+assert restarts[0]["delay_s"] == 0, restarts[0]
+assert int(restarts[0]["hosts"]) == want_width, restarts[0]
+assert [e["status"] for e in sup if e.get("kind") == "run_end"] == ["completed"]
+
+# host 0's merged training flight: the survivor cut its boundary and
+# exited preempted inside the grace window; the restarted segment rose
+# from committed gen 1 (gen 2's manifest never landed) and completed
+flight_path = glob.glob(
+    os.path.join(base, f"pod{leg}", "logs", "*", "flight.jsonl")
+)[0]
+run_dir = os.path.dirname(flight_path)
+ev = read_flight_record(flight_path)
+ends = [e["status"] for e in ev if e.get("kind") == "run_end"]
+assert ends == ["preempted", "completed"], ends
+assert sum(1 for e in ev if e.get("kind") == "resumed") == 1
+pre = [e for e in ev if e.get("kind") == "preempt"]
+assert pre and pre[0]["signal"] == 15, pre
+fails = [
+    e
+    for e in ev
+    if e.get("kind") == "error" and e.get("error_type") == "PodCommitFailed"
+]
+assert fails, "the torn generation left no PodCommitFailed evidence"
+resumes = [e for e in ev if e.get("kind") == "pod_resume"]
+assert len(resumes) == 1, resumes
+assert resumes[0]["gen"] == 1 and resumes[0]["prior_hosts"] == 2, resumes[0]
+assert not resumes[0].get("fallbacks"), resumes[0]
+starts = [e for e in ev if e.get("kind") == "run_start"]
+lineage = (starts[-1].get("manifest") or {}).get("pod_resume")
+assert lineage and lineage["resumed_from_gen"] == 1, lineage
+assert lineage["prior_hosts"] == 2, lineage
+
+# on-disk protocol ground truth: the newest COMMIT marker names the
+# expected generation (3 after a full-width recovery; still 1 after
+# the elastic leg, whose single-host continuation leaves pod cutting
+# off) and the meta sidecar describes the completed run
+commit = latest_commit_info(run_dir)
+assert commit is not None and int(commit["gen"]) == want_gen, commit
+assert int(commit["hosts"]) == 2, commit
+meta = load_train_meta(os.path.basename(run_dir), os.path.dirname(run_dir))
+assert meta is not None and int(meta["epoch"]) == 3, meta
+assert int(meta.get("format_version", 1)) == 2, meta
+
+# recovery correctness: every epoch's final losses equal the
+# uninterrupted single-process reference's EXACTLY (the restored
+# generation is byte-identical state, the replayed epochs deterministic)
+ref_flight = glob.glob(os.path.join(base, "ref", "logs", "*", "flight.jsonl"))[0]
+ref = {
+    e["epoch"]: e
+    for e in read_flight_record(ref_flight)
+    if e.get("kind") == "epoch"
+}
+got = {e["epoch"]: e for e in ev if e.get("kind") == "epoch"}
+assert sorted(got) == sorted(ref) == [0, 1, 2], (sorted(got), sorted(ref))
+for ep in sorted(ref):
+    for k in ("train_loss", "val_loss", "test_loss"):
+        assert got[ep][k] == ref[ep][k], (ep, k, got[ep][k], ref[ep][k])
+print(
+    f"pod-recovery leg {leg}: OK (host_lost -> prompt restart at width "
+    f"{want_width}, resumed from committed gen 1, last commit gen "
+    f"{want_gen}, losses bit-match the reference)"
+)
+EOF
+# the uninterrupted reference: same config, single process, no pod.
+# Also warms the shared exec cache so every pod host below starts
+# compile-free — the bounded commit waits then measure the protocol,
+# not cross-host compile skew.
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
+    HYDRAGNN_EXEC_CACHE="$PODREC_DIR/exec_cache" \
+    python "$PODREC_DIR/child.py" "$PODREC_DIR/ref"
+# --- fixed-width leg: host 1 is SIGKILLed inside its gen-2 shard write
+#     (shard bytes land, the manifest never does -> gen 2 can never
+#     commit). The supervisor classifies the signal death host_lost,
+#     SIGTERMs the survivor (it cuts its boundary and exits 75 inside
+#     the grace window), and restarts the full pod promptly with the
+#     injection stripped; both hosts resume from committed gen 1.
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
+    HYDRAGNN_EXEC_CACHE="$PODREC_DIR/exec_cache" \
+    HYDRAGNN_INJECT_POD_KILL_HOST=1:2 \
+    HYDRAGNN_POD_COMMIT_TIMEOUT_S=10 \
+    python tools/supervise.py --pod 2 --pod-grace 90 --run-id podrecA \
+    --flight "$PODREC_DIR/supA.jsonl" -- \
+    python "$PODREC_DIR/child.py" "$PODREC_DIR/podA"
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
+    python "$PODREC_DIR/check_leg.py" "$PODREC_DIR" A 2 3
+PODREC_RUN_A="$(dirname "$(ls "$PODREC_DIR"/podA/logs/*/flight.jsonl)")"
+# the reporter surfaces the protocol state and the resume lineage, the
+# fault timeline narrates the loss and the rise, and every flight
+# artifact (host shards + the supervisor's) passes the lint gate
+python tools/obs_report.py --validate "$PODREC_RUN_A" \
+    | tee "$PODREC_DIR/validateA.out"
+grep -q "podckpt: last committed gen 3" "$PODREC_DIR/validateA.out" || {
+    echo "FAIL: --validate did not surface the committed generation"; exit 1; }
+grep -q "pod_resume: from gen 1 (prior_hosts=2" "$PODREC_DIR/validateA.out" || {
+    echo "FAIL: --validate did not surface the pod resume lineage"; exit 1; }
+python tools/obs_report.py --faults "$PODREC_DIR/supA.jsonl" \
+    | tee "$PODREC_DIR/faultsA.out"
+grep -q "host 1 declared lost" "$PODREC_DIR/faultsA.out" || {
+    echo "FAIL: --faults did not narrate the lost host"; exit 1; }
+python tools/obs_report.py --faults "$PODREC_RUN_A/flight.jsonl" \
+    | tee "$PODREC_DIR/faultsA_train.out"
+grep -q "resumed from committed gen 1" "$PODREC_DIR/faultsA_train.out" || {
+    echo "FAIL: --faults did not narrate the pod resume"; exit 1; }
+python tools/graftlint.py --artifacts \
+    "$PODREC_RUN_A/flight.jsonl" "$PODREC_RUN_A/flight.host1.jsonl" \
+    "$PODREC_DIR/supA.jsonl"
+# --- elastic leg: same loss, --pod-elastic restarts the pod at width 1;
+#     the single-host continuation restores the 2-host generation
+#     re-sharded onto itself and completes with the same losses
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
+    HYDRAGNN_EXEC_CACHE="$PODREC_DIR/exec_cache" \
+    HYDRAGNN_INJECT_POD_KILL_HOST=1:2 \
+    HYDRAGNN_POD_COMMIT_TIMEOUT_S=10 \
+    python tools/supervise.py --pod 2 --pod-elastic --pod-grace 90 \
+    --run-id podrecB --flight "$PODREC_DIR/supB.jsonl" -- \
+    python "$PODREC_DIR/child.py" "$PODREC_DIR/podB"
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
+    python "$PODREC_DIR/check_leg.py" "$PODREC_DIR" B 1 1
+PODREC_RUN_B="$(dirname "$(ls "$PODREC_DIR"/podB/logs/*/flight.jsonl)")"
+python tools/obs_report.py --validate "$PODREC_RUN_B" \
+    | tee "$PODREC_DIR/validateB.out"
+grep -q "podckpt: last committed gen 1" "$PODREC_DIR/validateB.out" || {
+    echo "FAIL: --validate did not surface the elastic leg's commit"; exit 1; }
+python tools/graftlint.py --artifacts \
+    "$PODREC_RUN_B/flight.jsonl" "$PODREC_DIR/supB.jsonl"
+rm -rf "$PODREC_DIR"
+
 echo "== exec-cache smoke (train once; two server starts vs one cache dir; corrupt entry -> loud eviction) =="
 EXEC_DIR="$(mktemp -d)"
 cat > "$EXEC_DIR/serve_once.py" <<'EOF'

@@ -256,6 +256,7 @@ _INCIDENT_RULE_KINDS = (
     "pilot_stuck",
     "step_skew",
     "host_stall",
+    "host_lost",
 )
 
 
@@ -379,6 +380,43 @@ def _check_spool_manifest(data: Any) -> List[str]:
     return problems
 
 
+def _check_pod_shard_manifest(data: Any) -> List[str]:
+    """Per-host pod checkpoint shard manifest
+    (resilience/podckpt.py:save_pod_shard) — the restore side trusts
+    exactly these fields to reassemble leaves across layouts, so the
+    linter holds them to the same bar as committed artifacts."""
+    problems = _require(
+        data,
+        {"format_version": (int,), "gen": (int,), "host": (int,),
+         "hosts": (int,), "shard": (str,), "sha256": (str,),
+         "leaves": (list,)},
+    )
+    if problems:
+        return problems
+    if not (0 <= data["host"] < data["hosts"]):
+        problems.append(
+            f"host {data['host']} outside [0, hosts={data['hosts']})"
+        )
+    for i, leaf in enumerate(data["leaves"]):
+        problems += [
+            f"leaves[{i}].{p}" for p in _require(
+                leaf, {"path": (str,), "key": (str,), "shape": (list,),
+                       "dtype": (str,)},
+            )
+        ]
+    return problems
+
+
+def _check_pod_commit(data: Any) -> List[str]:
+    """Generation COMMIT marker (resilience/podckpt.py) — written LAST
+    by rank 0; a reader treats its presence as "this generation is
+    complete", so its few fields must always be whole."""
+    return _require(
+        data,
+        {"format_version": (int,), "gen": (int,), "hosts": (int,)},
+    )
+
+
 #: runtime-artifact kinds: produced by RUNS (never committed at the
 #: repo root), so they dispatch by name for explicit paths but are
 #: exempt from the zero-committed-matches scan above.
@@ -394,6 +432,12 @@ RUNTIME_SCHEMAS: Dict[str, Tuple[str, Callable[[Any], List[str]]]] = {
     ),
     "podview_report.json": (
         "podview skew report", _check_podview_report,
+    ),
+    "ckpt.gen*.host*.manifest.json": (
+        "pod checkpoint shard manifest", _check_pod_shard_manifest,
+    ),
+    "gen*.COMMIT": (
+        "pod checkpoint generation commit marker", _check_pod_commit,
     ),
 }
 

@@ -116,6 +116,12 @@ _REQUIRED: Dict[str, tuple] = {
     # verdict over all hosts' summaries
     "host_epoch": ("epoch", "host", "run_id", "epoch_s"),
     "podview": ("epoch", "skew_frac", "slowest_host"),
+    # pod fault tolerance (resilience/podckpt.py, docs/RESILIENCE.md
+    # "Pod recovery"): a peer host declared lost from the heartbeat
+    # view (exactly one event per lost host per run), and the lineage
+    # stamp of a run restored from a committed pod generation
+    "host_lost": ("host",),
+    "pod_resume": ("gen",),
 }
 
 # the fault-history subset tools/obs_report.py --faults narrates
@@ -137,6 +143,8 @@ FAULT_KINDS = (
     "fleet_scale",
     "fleet_reload",
     "pilot",
+    "host_lost",
+    "pod_resume",
 )
 
 _MANIFEST_REQUIRED = ("jax_version", "backend", "num_processes")

@@ -90,6 +90,7 @@ RULE_KINDS = (
     "pilot_stuck",
     "step_skew",
     "host_stall",
+    "host_lost",
 )
 
 #: which rule kinds read a registry metric (vs an observed series)
@@ -103,6 +104,7 @@ _REGISTRY_KINDS = (
     "nonfinite_burst",
     "step_skew",
     "host_stall",
+    "host_lost",
 )
 
 #: drift kinds read a DriftMonitor-published gauge (obs/drift.py); the
@@ -233,7 +235,9 @@ class TriggerEngine:
                     rule.threshold, now, detail={"count": snap.get("count")},
                 )
             return None
-        if rule.kind in ("queue_depth", "queue_age", "step_skew", "host_stall"):
+        if rule.kind in (
+            "queue_depth", "queue_age", "step_skew", "host_stall", "host_lost"
+        ):
             g = self.registry.get(rule.metric)
             if g is None or not hasattr(g, "value"):
                 return None
@@ -245,6 +249,11 @@ class TriggerEngine:
                     sg = self.registry.get("podview.slowest_host")
                     if sg is not None and hasattr(sg, "value"):
                         detail["slowest_host"] = int(sg.value)
+                if rule.kind == "host_lost":
+                    # evidence: which host the liveness view declared lost
+                    lg = self.registry.get("podview.lost_host")
+                    if lg is not None and hasattr(lg, "value"):
+                        detail["lost_host"] = int(lg.value)
                 return TriggerVerdict(
                     rule.name, rule.kind, rule.metric, round(v, 6),
                     rule.threshold, now, detail=detail,

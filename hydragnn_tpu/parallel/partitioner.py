@@ -594,6 +594,29 @@ class Partitioner:
             "bytes_per_device": int(per_dev),
         }
 
+    def layout_fingerprint(self) -> Dict[str, Any]:
+        """Compact, JSON-stable identity of the committed layout — what
+        the pod checkpoint protocol (resilience/podckpt.py) stamps into
+        every shard manifest and COMMIT marker so a restore can tell
+        "same layout, place shards directly" from "different layout,
+        reassemble leaves elastically", and lineage events can name the
+        PRIOR layout a resumed run came from."""
+        c = self.config
+        fp: Dict[str, Any] = {
+            "data": int(c.data),
+            "fsdp": int(c.fsdp),
+            "edge": int(c.edge),
+            "zero1": bool(c.zero1),
+            "devices": None if self.mesh is None else int(self.mesh.size),
+        }
+        try:
+            from hydragnn_tpu.obs.podview import host_identity
+
+            _, fp["hosts"] = host_identity()
+        except Exception:
+            fp["hosts"] = 1
+        return fp
+
     def manifest(self, state=None, variables=None) -> Dict[str, Any]:
         """The flight-record ``parallel`` block: mesh shape and axis
         names, axis widths, and (given a ``state`` or served
@@ -627,6 +650,7 @@ class Partitioner:
             info["process_index"], info["process_count"] = host_identity()
         except Exception:
             pass
+        info["layout"] = self.layout_fingerprint()
         if state is not None:
             sh, replicated = self._state_sharding_with_report(state)
             info["params"] = self._section_summary(

@@ -20,7 +20,17 @@ machinery when a run is dying:
     data-wait).
   - :mod:`~hydragnn_tpu.resilience.supervisor` — bounded restart
     supervisor (``tools/supervise.py``): exponential backoff,
-    exit-cause classification, fail-fast on config errors.
+    exit-cause classification, fail-fast on config errors; the
+    pod-level variant (``PodSupervisor``, ``tools/supervise.py
+    --pod N``) supervises N simulated hosts as one unit with
+    ``host_lost`` classed for prompt restart and optional elastic
+    N-1 recovery.
+  - :mod:`~hydragnn_tpu.resilience.podckpt` — sharded pod checkpoints
+    with a generation commit protocol (per-host shard + sha sidecar +
+    manifest, rank-0 ``gen<N>.COMMIT`` written LAST), filesystem
+    heartbeats/preemption coordination (``PodSignaler``), and elastic
+    restore that re-shards a committed generation across a different
+    host count.
   - :mod:`~hydragnn_tpu.resilience.inject` — env-gated deterministic
     fault injection (NaN batch, SIGTERM, SIGKILL mid-checkpoint,
     stalled producer) so every path above is testable, not decorative.
@@ -39,6 +49,7 @@ from hydragnn_tpu.resilience.preempt import (
     EXIT_PREEMPTED,
     EXIT_ROLLBACK_EXHAUSTED,
     NonFiniteRollbackExhausted,
+    PodHostLost,
     PreemptionHandler,
     TrainingPreempted,
     auto_resume_config,
@@ -48,9 +59,11 @@ from hydragnn_tpu.resilience.sentry import NonFiniteSentry
 from hydragnn_tpu.resilience.watchdog import HangWatchdog, dump_thread_stacks
 from hydragnn_tpu.resilience.supervisor import (
     FAIL_FAST_CAUSES,
+    PodSupervisor,
     Supervisor,
     SupervisorPolicy,
     classify_exit,
+    classify_pod_exit,
 )
 from hydragnn_tpu.resilience.hooks import TrainHooks
 
@@ -70,7 +83,10 @@ __all__ = [
     "dump_thread_stacks",
     "Supervisor",
     "SupervisorPolicy",
+    "PodSupervisor",
+    "PodHostLost",
     "FAIL_FAST_CAUSES",
     "classify_exit",
+    "classify_pod_exit",
     "TrainHooks",
 ]

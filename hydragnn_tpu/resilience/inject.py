@@ -87,6 +87,32 @@ weights keep serving" instead of making serving worse:
                                          canary must reject them)
   =====================================  ======================================
 
+Pod faults (resilience/podckpt.py, docs/RESILIENCE.md "Pod recovery")
+— each anchored to a (host, step-like) pair so exactly one simulated
+host misbehaves at exactly one point, and provable both in-process
+(tests/test_podckpt.py) and end-to-end (ci.sh pod-recovery smoke):
+
+  =====================================  ======================================
+  HYDRAGNN_INJECT_POD_KILL_HOST=H:G      host H SIGKILLs itself during the
+                                         generation-G pod checkpoint save,
+                                         AFTER its shard bytes land but BEFORE
+                                         its manifest — generation G can never
+                                         commit (the torn-generation case)
+  HYDRAGNN_INJECT_POD_TORN_SHARD=H:G     host H's generation-G shard is
+                                         written truncated while its sha256
+                                         sidecar carries the good digest —
+                                         restore must reject the shard by
+                                         checksum and fall back a generation
+  HYDRAGNN_INJECT_POD_LOST_HEARTBEAT=    host H stops writing heartbeat files
+  H:E                                    from epoch E on (alive but silent —
+                                         what a wedged host looks like from
+                                         outside; drives host_lost detection)
+  HYDRAGNN_INJECT_POD_BARRIER_STALL=H:S  host H sleeps S seconds before
+                                         entering any pod_barrier (once per
+                                         process) — peers must time out,
+                                         proceed, and record the stall
+  =====================================  ======================================
+
 Step numbers are process-local dispatch counts (0-based, counted by
 ``TrainHooks``), so injections are deterministic regardless of resume
 state.
@@ -301,10 +327,73 @@ def pilot_torn_reload() -> bool:
     return _spec("HYDRAGNN_INJECT_PILOT_TORN_RELOAD") is not None
 
 
+def maybe_pod_kill_host(host: int, gen) -> None:
+    """SIGKILL this process when it is the injected host saving the
+    injected pod-checkpoint generation. Called between the shard write
+    and the manifest write, so the death always leaves a torn
+    (uncommittable) generation behind."""
+    spec = _spec("HYDRAGNN_INJECT_POD_KILL_HOST")
+    if spec is None or gen is None:
+        return
+    h, g = _two_ints(spec, 1)
+    if int(host) == h and int(gen) == g:
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+def maybe_pod_torn_shard(host: int, gen) -> bool:
+    """Whether the injected host must write its injected generation's
+    shard TRUNCATED while the sha256 sidecar keeps the good digest —
+    the checksum-mismatch case restore's generation fallback exists
+    for."""
+    spec = _spec("HYDRAGNN_INJECT_POD_TORN_SHARD")
+    if spec is None or gen is None:
+        return False
+    h, g = _two_ints(spec, 1)
+    return int(host) == h and int(gen) == g
+
+
+def maybe_pod_lost_heartbeat(host: int, epoch) -> bool:
+    """Whether the injected host must SUPPRESS its heartbeat writes
+    (from the injected epoch on). The host keeps training — only its
+    liveness signal dies, so peers must declare it lost on evidence,
+    not on exit codes."""
+    spec = _spec("HYDRAGNN_INJECT_POD_LOST_HEARTBEAT")
+    if spec is None or epoch is None:
+        return False
+    h, e = _two_ints(spec, 0)
+    return int(host) == h and int(epoch) >= e
+
+
+# graftsync: thread-safe=GIL-atomic one-way False->True latch; only the single barrier-entering main thread writes it
+_BARRIER_STALLED = False
+
+
+def maybe_pod_barrier_stall(host: int) -> None:
+    """Sleep the injected host before it enters a pod_barrier (once
+    per process) — its peers must hit the barrier timeout, proceed,
+    and record the missing host rather than hang."""
+    spec = _spec("HYDRAGNN_INJECT_POD_BARRIER_STALL")
+    if spec is None:
+        return
+    h, seconds = _two_ints(spec, 5)
+    global _BARRIER_STALLED
+    if int(host) == h and not _BARRIER_STALLED:
+        _BARRIER_STALLED = True
+        time.sleep(seconds)
+
+
 def strip_injection_env(env: dict) -> dict:
-    """Copy of ``env`` without any ``HYDRAGNN_INJECT_*`` keys — what the
-    restart supervisor hands to restarted children so injected faults
-    fire exactly once."""
+    """Copy of ``env`` without any injection knobs — what the restart
+    supervisor hands to restarted children so injected faults fire
+    exactly once. The removal set is DERIVED from the central knob
+    registry's view of the environment (``knobs.active_injections``)
+    rather than a hand-maintained list here, so every injection family
+    — including ones added after this function — is stripped; the
+    prefix filter backstops names a future build sets but this one's
+    registry predates."""
+    drop = set(knobs.active_injections(env=env))
     return {
-        k: v for k, v in env.items() if not k.startswith(knobs.INJECT_PREFIX)
+        k: v
+        for k, v in env.items()
+        if k not in drop and not k.startswith(knobs.INJECT_PREFIX)
     }

@@ -1,0 +1,738 @@
+"""Pod-scale sharded checkpointing with a generation commit protocol,
+plus the filesystem coordination plane the pod runs on (preemption
+signals, bounded barriers, liveness heartbeats).
+
+The single-host msgpack path (utils/checkpoint.py) funnels the whole
+TrainState through rank 0; on a pod that is both slow (every FSDP/ZeRO
+shard gathered over the wire) and fragile (a host dying mid-save tears
+the only copy). This module gives every host its own atomic shard file
+and makes "which checkpoint is complete?" a one-file question:
+
+  <run_dir>/podckpt/
+    ckpt.gen<N>.host<k>.mp              host k's leaf payload (flax msgpack)
+    ckpt.gen<N>.host<k>.mp.sha256       integrity sidecar (hex digest)
+    ckpt.gen<N>.host<k>.manifest.json   leaf paths, shapes, slices, layout
+    gen<N>.COMMIT                       written by rank 0 LAST, only after
+                                        every expected manifest validates
+
+A generation without its COMMIT marker is torn by definition and is
+never restored; restore walks committed generations newest-first,
+validates every shard sidecar, and falls back a generation (loudly)
+on any mismatch. Because manifests carry per-leaf slice indices, a
+checkpoint cut under one layout restores onto another — fewer hosts,
+different mesh — by reassembling full leaves host-side (elastic
+re-shard; docs/RESILIENCE.md "Pod recovery").
+
+Coordination files live next door:
+
+  <run_dir>/podsync/
+    heartbeat.host<k>.json      periodic liveness beat (t, epoch, step)
+    preempt.host<k>.json        "I was SIGTERMed; cut generation G"
+    barrier.<name>.host<k>      bounded-wait rendezvous markers
+
+The same exchange directory podview's flight shards use — any shared
+filesystem works; on a real pod without one, data/diststore.py's
+sharded TCP store is the drop-in transport (same tiny key/value
+semantics, documented alternative, not wired here).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import warnings
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+from flax import serialization
+
+from hydragnn_tpu.resilience.inject import (
+    maybe_pod_barrier_stall,
+    maybe_pod_kill_host,
+    maybe_pod_lost_heartbeat,
+    maybe_pod_torn_shard,
+)
+from hydragnn_tpu.utils import knobs
+from hydragnn_tpu.utils.checkpoint import (
+    CHECKPOINT_FORMAT_VERSION,
+    CheckpointFormatError,
+    _atomic_write,
+    _sha256_hex,
+)
+
+POD_DIR = "podckpt"
+SYNC_DIR = "podsync"
+
+
+class PodShardError(RuntimeError):
+    """A pod checkpoint generation failed validation (missing/torn/
+    corrupt shard, incomplete leaf coverage). Restore treats it as
+    "fall back one generation", never as fatal on its own."""
+
+
+# -- paths -----------------------------------------------------------------
+
+
+def pod_dir(run_dir: str) -> str:
+    return os.path.join(run_dir, POD_DIR)
+
+
+def sync_dir(run_dir: str) -> str:
+    return os.path.join(run_dir, SYNC_DIR)
+
+
+def _shard_path(run_dir: str, gen: int, host: int) -> str:
+    return os.path.join(pod_dir(run_dir), f"ckpt.gen{gen}.host{host}.mp")
+
+
+def _manifest_path(run_dir: str, gen: int, host: int) -> str:
+    return os.path.join(pod_dir(run_dir), f"ckpt.gen{gen}.host{host}.manifest.json")
+
+
+def _commit_path(run_dir: str, gen: int) -> str:
+    return os.path.join(pod_dir(run_dir), f"gen{gen}.COMMIT")
+
+
+# -- leaf flattening -------------------------------------------------------
+
+
+def flatten_state(state: Any) -> Dict[str, Any]:
+    """The TrainState as a flat ``{"a/b/c": leaf}`` dict (flax
+    state-dict traversal, '/'-joined keys, sorted order). The flat key
+    set is the checkpoint schema both sides of a restore agree on."""
+    nested = serialization.to_state_dict(state)
+    out: Dict[str, Any] = {}
+
+    def _walk(node, prefix):
+        if isinstance(node, dict):
+            for key in sorted(node):
+                _walk(node[key], f"{prefix}/{key}" if prefix else str(key))
+        else:
+            out[prefix] = node
+
+    _walk(nested, "")
+    return out
+
+
+def _slices_of(index, shape) -> List[List[int]]:
+    out = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = int(dim) if sl.stop is None else int(sl.stop)
+        out.append([start, stop])
+    return out
+
+
+# -- save ------------------------------------------------------------------
+
+
+def save_pod_shard(
+    state: Any,
+    run_dir: str,
+    *,
+    gen: int,
+    host: int,
+    hosts: int,
+    step: Optional[int] = None,
+    layout: Optional[dict] = None,
+) -> dict:
+    """Write host ``k``'s shard of generation ``gen``: payload file,
+    sha256 sidecar, then the per-host manifest (in that order — a crash
+    between them leaves a manifest-less shard the commit wait times out
+    on, never a manifest pointing at missing bytes). Returns the
+    manifest. Distributed leaves (jax.Array with non-addressable
+    shards) contribute this host's replica-0 shards with their slice
+    indices; fully-addressable leaves are deal-dealt round-robin over
+    sorted leaf paths so every leaf has exactly one owner."""
+    flat = flatten_state(state)
+    payload: Dict[str, np.ndarray] = {}
+    entries: List[dict] = []
+    for i, path in enumerate(sorted(flat)):
+        leaf = flat[path]
+        if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+            for shard in leaf.addressable_shards:
+                if shard.replica_id != 0:
+                    continue
+                key = str(len(payload))
+                payload[key] = np.asarray(shard.data)
+                entries.append(
+                    {
+                        "path": path,
+                        "key": key,
+                        "shape": [int(d) for d in leaf.shape],
+                        "dtype": str(np.asarray(shard.data).dtype),
+                        "slices": _slices_of(shard.index, leaf.shape),
+                    }
+                )
+        else:
+            if i % hosts != host:
+                continue
+            arr = np.asarray(leaf)
+            key = str(len(payload))
+            payload[key] = arr
+            entries.append(
+                {
+                    "path": path,
+                    "key": key,
+                    "shape": [int(d) for d in arr.shape],
+                    "dtype": str(arr.dtype),
+                    "slices": None,
+                }
+            )
+    os.makedirs(pod_dir(run_dir), exist_ok=True)
+    data = serialization.msgpack_serialize(payload)
+    sha = _sha256_hex(data)
+    if maybe_pod_torn_shard(host, gen):
+        # sidecar carries the GOOD digest, the payload gets torn bytes:
+        # the sha-mismatch restore must reject (torn-shard injection)
+        data = data[: max(len(data) // 2, 1)]
+    shard_path = _shard_path(run_dir, gen, host)
+    _atomic_write(shard_path, data)
+    _atomic_write(shard_path + ".sha256", sha.encode())
+    # SIGKILL-mid-checkpoint injection: shard bytes exist, manifest
+    # never lands -> the generation can never commit (torn gen)
+    maybe_pod_kill_host(host, gen)
+    manifest = {
+        "format_version": CHECKPOINT_FORMAT_VERSION,
+        "gen": int(gen),
+        "step": None if step is None else int(step),
+        "host": int(host),
+        "hosts": int(hosts),
+        "layout": layout,
+        "shard": os.path.basename(shard_path),
+        "sha256": sha,
+        "leaves": entries,
+        "t": time.time(),
+    }
+    _atomic_write(
+        _manifest_path(run_dir, gen, host),
+        json.dumps(manifest, sort_keys=True).encode(),
+    )
+    return manifest
+
+
+def _validate_host_shard(run_dir: str, gen: int, host: int) -> Optional[str]:
+    """None when host ``k``'s shard of ``gen`` is whole, else a short
+    reason naming the bad file."""
+    mp = _manifest_path(run_dir, gen, host)
+    try:
+        with open(mp) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError) as exc:
+        return f"manifest {os.path.basename(mp)} unreadable ({exc})"
+    sp = _shard_path(run_dir, gen, host)
+    try:
+        with open(sp, "rb") as f:
+            data = f.read()
+    except OSError:
+        return f"shard {os.path.basename(sp)} missing"
+    if _sha256_hex(data) != manifest.get("sha256"):
+        return f"shard {os.path.basename(sp)} sha256 mismatch (torn write)"
+    return None
+
+
+def commit_generation(
+    run_dir: str,
+    gen: int,
+    hosts: int,
+    *,
+    timeout_s: Optional[float] = None,
+    poll_s: float = 0.05,
+    signaler: Optional["PodSignaler"] = None,
+    step: Optional[int] = None,
+    layout: Optional[dict] = None,
+) -> dict:
+    """Rank 0's half of the protocol: bounded-wait until every expected
+    host manifest exists and validates, then write ``gen<N>.COMMIT``
+    atomically (LAST). Never raises and never hangs: on timeout, a bad
+    shard, or a peer the heartbeat view declares lost, it returns
+    ``committed=False`` with the evidence and the caller decides
+    (proceed-and-record). Non-zero hosts never call this — they write
+    their shard and move on, so a sequentially-simulated pod (ci.sh
+    runs host 1 to completion before host 0 starts) still commits."""
+    if timeout_s is None:
+        timeout_s = knobs.get_float("HYDRAGNN_POD_COMMIT_TIMEOUT_S", 120.0)
+    deadline = time.monotonic() + float(timeout_s)
+    t0 = time.monotonic()
+    while True:
+        missing = [
+            k for k in range(hosts) if not os.path.exists(_manifest_path(run_dir, gen, k))
+        ]
+        if not missing:
+            break
+        lost = sorted(set(missing) & set(signaler.lost_hosts())) if signaler else []
+        if lost:
+            return {
+                "committed": False,
+                "gen": int(gen),
+                "missing": missing,
+                "lost": lost,
+                "bad": [],
+                "waited_s": round(time.monotonic() - t0, 3),
+            }
+        if time.monotonic() > deadline:
+            return {
+                "committed": False,
+                "gen": int(gen),
+                "missing": missing,
+                "lost": [],
+                "bad": [],
+                "timeout": True,
+                "waited_s": round(time.monotonic() - t0, 3),
+            }
+        time.sleep(poll_s)
+    bad = []
+    for k in range(hosts):
+        reason = _validate_host_shard(run_dir, gen, k)
+        if reason is not None:
+            bad.append(reason)
+    if step is None or layout is None:
+        # the COMMIT record carries the generation's step/layout for
+        # readers that never open a manifest; host 0's manifest is the
+        # authoritative source when the caller did not pass them
+        try:
+            with open(_manifest_path(run_dir, gen, 0)) as f:
+                m0 = json.load(f)
+            step = m0.get("step") if step is None else step
+            layout = m0.get("layout") if layout is None else layout
+        except (OSError, ValueError):
+            pass
+    if bad:
+        return {
+            "committed": False,
+            "gen": int(gen),
+            "missing": [],
+            "lost": [],
+            "bad": bad,
+            "waited_s": round(time.monotonic() - t0, 3),
+        }
+    _atomic_write(
+        _commit_path(run_dir, gen),
+        json.dumps(
+            {
+                "format_version": CHECKPOINT_FORMAT_VERSION,
+                "gen": int(gen),
+                "step": None if step is None else int(step),
+                "hosts": int(hosts),
+                "layout": layout,
+                "t": time.time(),
+            },
+            sort_keys=True,
+        ).encode(),
+    )
+    return {
+        "committed": True,
+        "gen": int(gen),
+        "hosts": int(hosts),
+        "waited_s": round(time.monotonic() - t0, 3),
+    }
+
+
+# -- discovery / restore ---------------------------------------------------
+
+
+def list_committed_generations(run_dir: str) -> List[int]:
+    """Generation numbers with a COMMIT marker, ascending. Shard files
+    without their marker are torn by definition and never listed."""
+    d = pod_dir(run_dir)
+    gens = []
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return []
+    for name in names:
+        if name.startswith("gen") and name.endswith(".COMMIT"):
+            try:
+                gens.append(int(name[len("gen") : -len(".COMMIT")]))
+            except ValueError:
+                continue
+    return sorted(gens)
+
+
+def read_commit(run_dir: str, gen: int) -> dict:
+    """The COMMIT record for ``gen``. Raises :class:`PodShardError` on
+    a missing/unreadable marker and :class:`CheckpointFormatError` on a
+    format_version newer than this build understands — a typed refusal,
+    not a parse crash (docs/RESILIENCE.md "Checkpoint format")."""
+    p = _commit_path(run_dir, gen)
+    try:
+        with open(p) as f:
+            commit = json.load(f)
+    except (OSError, ValueError) as exc:
+        raise PodShardError(
+            f"generation {gen} has no readable COMMIT marker ({exc})"
+        ) from exc
+    fv = commit.get("format_version")
+    if fv is not None and int(fv) > CHECKPOINT_FORMAT_VERSION:
+        raise CheckpointFormatError(
+            f"pod checkpoint generation {gen} was written by format_version "
+            f"{fv}; this build understands <= {CHECKPOINT_FORMAT_VERSION}"
+        )
+    return commit
+
+
+def load_generation(run_dir: str, gen: int) -> Tuple[Dict[str, np.ndarray], dict]:
+    """Reassemble generation ``gen`` into full host-side leaves from
+    every host's shard + manifest — the elastic half of the protocol:
+    the reader needs only the manifests, not the writer's host count or
+    mesh. Raises :class:`PodShardError` naming the first bad shard."""
+    commit = read_commit(run_dir, gen)
+    hosts = int(commit["hosts"])
+    flat: Dict[str, np.ndarray] = {}
+    partial: Dict[str, Tuple[np.ndarray, int]] = {}
+    for k in range(hosts):
+        reason = _validate_host_shard(run_dir, gen, k)
+        if reason is not None:
+            raise PodShardError(f"generation {gen}: {reason}")
+        with open(_manifest_path(run_dir, gen, k)) as f:
+            manifest = json.load(f)
+        with open(_shard_path(run_dir, gen, k), "rb") as f:
+            try:
+                payload = serialization.msgpack_restore(f.read())
+            except Exception as exc:
+                raise PodShardError(
+                    f"generation {gen}: shard ckpt.gen{gen}.host{k}.mp "
+                    f"unparseable ({exc})"
+                ) from exc
+        for entry in manifest.get("leaves", []):
+            arr = np.asarray(payload[entry["key"]])
+            if entry["slices"] is None:
+                flat[entry["path"]] = arr
+                continue
+            shape = tuple(entry["shape"])
+            buf, covered = partial.get(entry["path"], (None, 0))
+            if buf is None:
+                buf = np.zeros(shape, dtype=arr.dtype)
+            idx = tuple(slice(s, e) for s, e in entry["slices"])
+            buf[idx] = arr
+            partial[entry["path"]] = (buf, covered + int(arr.size))
+    for path, (buf, covered) in partial.items():
+        if covered < buf.size:
+            raise PodShardError(
+                f"generation {gen}: leaf {path} has incomplete shard "
+                f"coverage ({covered}/{buf.size} elements)"
+            )
+        flat[path] = buf
+    return flat, commit
+
+
+def _flat_into_state(state: Any, flat: Dict[str, np.ndarray]) -> Any:
+    target = flatten_state(state)
+    missing = sorted(set(target) - set(flat))
+    extra = sorted(set(flat) - set(target))
+    if missing or extra:
+        raise PodShardError(
+            f"leaf schema mismatch: missing={missing[:4]} extra={extra[:4]} "
+            f"(checkpoint and target model disagree)"
+        )
+    # merge the flat leaves into the target's own state-dict template:
+    # empty subtrees (an empty opt_state, no batch stats) have no flat
+    # leaves, and from_state_dict still requires their keys to exist
+    nested = serialization.to_state_dict(state)
+    for path, leaf in flat.items():
+        node = nested
+        keys = path.split("/")
+        for key in keys[:-1]:
+            node = node[key]
+        node[keys[-1]] = leaf
+    restored = serialization.from_state_dict(state, nested)
+
+    # preserve the target's placement, exactly like the msgpack restore
+    # (utils/checkpoint._restore_bytes_into): reassembled host leaves go
+    # back onto whatever sharding the caller's freshly-built state
+    # carries — THIS is the elastic re-shard step
+    def _place(tgt, val):
+        if isinstance(tgt, jax.Array) and hasattr(tgt, "sharding"):
+            return jax.device_put(val, tgt.sharding)
+        return val
+
+    return jax.tree_util.tree_map(_place, state, restored)
+
+
+# graftsync: thread-safe=restore lineage handoff written once by the single restoring thread before the train loop starts, consumed once by it
+_LAST_RESTORE_INFO: Optional[dict] = None
+
+
+def consume_last_restore_info() -> Optional[dict]:
+    """The lineage of the most recent pod restore in this process
+    ({gen, step, hosts, layout, fallbacks}), returned once — the train
+    loop stamps it into the run_start manifest as ``pod_resume``."""
+    global _LAST_RESTORE_INFO
+    info, _LAST_RESTORE_INFO = _LAST_RESTORE_INFO, None
+    return info
+
+
+def restore_pod_checkpoint(state: Any, run_dir: str) -> Tuple[Any, Optional[dict]]:
+    """Restore the newest valid committed generation into ``state``,
+    falling back generation-by-generation on torn/missing/corrupt
+    shards with a loud RuntimeWarning naming the bad shard. Returns
+    ``(state, info)``; ``info=None`` means nothing restorable (caller
+    falls through to the single-host msgpack chain). A future
+    format_version raises :class:`CheckpointFormatError` — upgrade
+    refusals must be typed, never silent fallbacks."""
+    gens = list_committed_generations(run_dir)
+    if not gens:
+        return state, None
+    fallbacks: List[dict] = []
+    for gen in reversed(gens):
+        try:
+            flat, commit = load_generation(run_dir, gen)
+            restored = _flat_into_state(state, flat)
+        except PodShardError as exc:
+            warnings.warn(
+                f"pod checkpoint generation {gen} rejected: {exc}; "
+                f"falling back to the previous committed generation",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+            fallbacks.append({"gen": int(gen), "error": str(exc)})
+            continue
+        info = {
+            "gen": int(gen),
+            "step": commit.get("step"),
+            "hosts": commit.get("hosts"),
+            "layout": commit.get("layout"),
+            "fallbacks": fallbacks,
+        }
+        global _LAST_RESTORE_INFO
+        _LAST_RESTORE_INFO = dict(info)
+        return restored, info
+    warnings.warn(
+        f"all {len(gens)} committed pod generations under {run_dir} failed "
+        f"validation; falling through to the single-host checkpoint chain",
+        RuntimeWarning,
+        stacklevel=2,
+    )
+    return state, None
+
+
+def latest_commit_info(run_dir: str) -> Optional[dict]:
+    """The newest readable COMMIT record, or None — obs_report's
+    ``--validate`` surfaces it next to each run."""
+    for gen in reversed(list_committed_generations(run_dir)):
+        try:
+            return read_commit(run_dir, gen)
+        except (PodShardError, CheckpointFormatError):
+            continue
+    return None
+
+
+def prune_generations(run_dir: str, keep_last: Optional[int] = None) -> None:
+    """Drop committed generations beyond the newest ``keep_last``
+    (COMMIT marker first, then shards — a reader racing the prune sees
+    a missing marker, i.e. an invalid generation, never a committed one
+    with missing bytes). Uncommitted debris newer than the newest
+    commit is left alone: it may be a commit in flight."""
+    if keep_last is None:
+        keep_last = knobs.get_int("HYDRAGNN_POD_KEEP_GENS", 3)
+    gens = list_committed_generations(run_dir)
+    d = pod_dir(run_dir)
+    for gen in gens[: max(0, len(gens) - int(keep_last))]:
+        victims = [_commit_path(run_dir, gen)]
+        for name in os.listdir(d):
+            if name.startswith(f"ckpt.gen{gen}.host"):
+                victims.append(os.path.join(d, name))
+        for victim in victims:
+            try:
+                os.remove(victim)
+            except OSError:
+                pass
+
+
+# -- coordination plane ----------------------------------------------------
+
+
+def pod_barrier(
+    run_dir: str,
+    name: str,
+    host: int,
+    hosts: int,
+    *,
+    timeout_s: Optional[float] = None,
+    poll_s: float = 0.05,
+) -> Tuple[bool, List[int]]:
+    """Bounded-wait rendezvous: write this host's marker, poll for the
+    peers', and after ``timeout_s`` PROCEED anyway, returning
+    ``(False, missing_hosts)`` so the caller can record the partial
+    barrier — a pod must degrade to evidence, never to a hang."""
+    maybe_pod_barrier_stall(host)
+    if timeout_s is None:
+        timeout_s = knobs.get_float("HYDRAGNN_POD_BARRIER_TIMEOUT_S", 60.0)
+    d = sync_dir(run_dir)
+    os.makedirs(d, exist_ok=True)
+    _atomic_write(
+        os.path.join(d, f"barrier.{name}.host{host}"),
+        json.dumps({"t": time.time()}).encode(),
+    )
+    deadline = time.monotonic() + float(timeout_s)
+    while True:
+        missing = [
+            k
+            for k in range(hosts)
+            if not os.path.exists(os.path.join(d, f"barrier.{name}.host{k}"))
+        ]
+        if not missing:
+            return True, []
+        if time.monotonic() > deadline:
+            return False, missing
+        time.sleep(poll_s)
+
+
+class PodSignaler:
+    """Filesystem coordination for one host of a pod: liveness
+    heartbeats, coordinated-preemption signals, and the lost-host view.
+
+    Loss detection is armed only when ``HYDRAGNN_POD_LOST_AFTER_S > 0``
+    (default off): the simulated-host CI mode runs hosts sequentially,
+    where stale beats are normal. When armed, a peer whose newest beat
+    (or, before its first beat, this signaler's own birth) is older
+    than the threshold is lost; ``undeclared_lost()`` hands each lost
+    host out exactly once so the ``host_lost`` flight event fires once
+    per host no matter how many sites poll.
+    """
+
+    # graftsync: thread-safe=mutated only by the owning host's main thread (signal handlers run in the main thread in CPython); peers communicate via atomic file replaces, never shared memory
+
+    def __init__(self, run_dir: str, host: int, hosts: int):
+        self.run_dir = run_dir
+        self.host = int(host)
+        self.hosts = int(hosts)
+        self.heartbeat_s = knobs.get_float("HYDRAGNN_POD_HEARTBEAT_S", 1.0)
+        self.lost_after_s = knobs.get_float("HYDRAGNN_POD_LOST_AFTER_S", 0.0)
+        self._t0 = time.time()
+        self._last_beat = 0.0
+        self._epoch: Optional[int] = None
+        self._declared: set = set()
+        d = sync_dir(run_dir)
+        try:
+            os.makedirs(d, exist_ok=True)
+            # a stale preempt signal from a previous attempt would
+            # instantly re-preempt the restarted run — clear our own
+            os.remove(self._preempt_path(self.host))
+        except OSError:
+            pass
+
+    def _beat_path(self, host: int) -> str:
+        return os.path.join(sync_dir(self.run_dir), f"heartbeat.host{host}.json")
+
+    def _preempt_path(self, host: int) -> str:
+        return os.path.join(sync_dir(self.run_dir), f"preempt.host{host}.json")
+
+    # -- liveness ----------------------------------------------------------
+
+    def heartbeat(
+        self,
+        *,
+        epoch: Optional[int] = None,
+        step: Optional[int] = None,
+        force: bool = False,
+    ) -> None:
+        """Write this host's beat file (rate-limited to one per
+        ``heartbeat_s``). Under the LOST_HEARTBEAT injection the host
+        goes silent from the injected epoch on — alive but beatless,
+        exactly what a wedged host looks like from outside."""
+        if epoch is not None:
+            self._epoch = int(epoch)
+        if maybe_pod_lost_heartbeat(self.host, self._epoch):
+            return
+        now = time.time()
+        if not force and now - self._last_beat < self.heartbeat_s:
+            return
+        self._last_beat = now
+        try:
+            _atomic_write(
+                self._beat_path(self.host),
+                json.dumps(
+                    {
+                        "t": now,
+                        "host": self.host,
+                        "epoch": self._epoch,
+                        "step": None if step is None else int(step),
+                    }
+                ).encode(),
+            )
+        except OSError:
+            pass
+
+    def peer_heartbeats(self) -> Dict[int, dict]:
+        out: Dict[int, dict] = {}
+        for k in range(self.hosts):
+            try:
+                with open(self._beat_path(k)) as f:
+                    out[k] = json.load(f)
+            except (OSError, ValueError):
+                continue
+        return out
+
+    def lost_hosts(self) -> List[int]:
+        """Peers whose liveness lapsed past ``lost_after_s`` (empty
+        when detection is disarmed). Beats older than this signaler's
+        birth count as absent — they are leftovers of a previous
+        attempt, and a freshly-restarted pod must give every peer the
+        full threshold to produce its first live beat."""
+        if self.lost_after_s <= 0:
+            return []
+        now = time.time()
+        beats = self.peer_heartbeats()
+        lost = []
+        for k in range(self.hosts):
+            if k == self.host:
+                continue
+            beat_t = float(beats.get(k, {}).get("t", 0.0))
+            alive_t = beat_t if beat_t >= self._t0 else self._t0
+            if now - alive_t > self.lost_after_s:
+                lost.append(k)
+        return lost
+
+    def undeclared_lost(self) -> List[int]:
+        """Lost hosts not yet handed to a caller — the dedupe that
+        keeps ``host_lost`` at exactly one flight event per host."""
+        return self.mark_declared(self.lost_hosts())
+
+    def mark_declared(self, hosts) -> List[int]:
+        """Filter ``hosts`` down to the not-yet-declared ones and mark
+        them declared. Lets the commit path (which learns about lost
+        peers from ``commit_generation`` rather than its own poll)
+        share the same one-event-per-host dedupe."""
+        fresh = sorted(int(k) for k in set(hosts) if int(k) not in self._declared)
+        self._declared.update(fresh)
+        return fresh
+
+    # -- coordinated preemption --------------------------------------------
+
+    def post_preempt(self, gen: int, signum: int = 15) -> None:
+        """Announce "this host was preempted; everyone cut generation
+        >= gen" to the pod. Called from the SIGTERM handler, so it must
+        never raise."""
+        try:
+            os.makedirs(sync_dir(self.run_dir), exist_ok=True)
+            _atomic_write(
+                self._preempt_path(self.host),
+                json.dumps(
+                    {
+                        "gen": int(gen),
+                        "host": self.host,
+                        "signum": int(signum),
+                        "t": time.time(),
+                    }
+                ).encode(),
+            )
+        except OSError:
+            pass
+
+    def preempt_request(self) -> Optional[dict]:
+        """The pod-wide preemption request, if any: the posting with
+        the HIGHEST requested generation wins, so every host cuts the
+        same (maximal) generation inside the grace window."""
+        best: Optional[dict] = None
+        for k in range(self.hosts):
+            try:
+                with open(self._preempt_path(k)) as f:
+                    req = json.load(f)
+            except (OSError, ValueError):
+                continue
+            if best is None or int(req.get("gen", 0)) > int(best.get("gen", 0)):
+                best = req
+        return best

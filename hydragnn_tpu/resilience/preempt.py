@@ -68,6 +68,25 @@ class NonFiniteRollbackExhausted(RuntimeError):
     exit_code = EXIT_ROLLBACK_EXHAUSTED
 
 
+class PodHostLost(Exception):
+    """A peer host of the pod was declared lost from the heartbeat view
+    (resilience/podckpt.py:PodSignaler) — typically mid-commit, where
+    waiting longer cannot help: the missing host's manifest will never
+    arrive. Exits with the PREEMPTED code: the run is resumable from
+    the last committed generation and the pod supervisor should
+    restart it promptly, not burn the crash backoff budget."""
+
+    exit_code = EXIT_PREEMPTED
+
+    def __init__(self, lost, epoch: int):
+        self.lost = sorted(int(h) for h in lost)
+        self.epoch = int(epoch)
+        super().__init__(
+            f"pod host(s) {self.lost} declared lost at epoch {epoch}; "
+            "restart from the last committed generation"
+        )
+
+
 class PreemptionHandler:
     """Installable SIGTERM/SIGINT -> graceful-stop flag.
 
@@ -100,6 +119,13 @@ class PreemptionHandler:
         self.available = False
         self._signals = tuple(signals)
         self._stop = threading.Event()
+        # pod coordination (resilience/podckpt.py): when the train loop
+        # attaches a PodSignaler + keeps proposed_gen current, the
+        # SIGTERM handler announces the preemption to peer hosts so the
+        # whole pod cuts the SAME generation inside the grace window
+        # graftsync: thread-safe=written by the main thread (loop setup / per-epoch update); read by the main-thread signal handler
+        self.signaler = None
+        self.proposed_gen = 0
         # graftsync: thread-safe=install()/uninstall() run on the owning (main) thread only
         self._old: dict = {}
         # graftsync: thread-safe=written by the main-thread signal handler and uninstall(); CPython delivers signals on the main thread
@@ -131,6 +157,9 @@ class PreemptionHandler:
     def _handle(self, signum, frame) -> None:
         self.signum = signum
         self._stop.set()
+        if self.signaler is not None:
+            # never raises (PodSignaler.post_preempt is exception-safe)
+            self.signaler.post_preempt(self.proposed_gen, signum)
         if self.hard_exit and self._timer is None:
             t = threading.Timer(self.grace_s, self._force_exit)
             t.daemon = True
@@ -182,9 +211,24 @@ def run_guard():
         yield
     except TrainingPreempted as exc:
         raise SystemExit(exc.exit_code)
+    except PodHostLost as exc:
+        print(f"run_guard: {exc}", file=sys.stderr)
+        raise SystemExit(exc.exit_code)
     except NonFiniteRollbackExhausted as exc:
         print(f"run_guard: {exc}", file=sys.stderr)
         raise SystemExit(exc.exit_code)
+    except RuntimeError as exc:
+        from hydragnn_tpu.utils.checkpoint import CheckpointFormatError
+
+        if isinstance(exc, CheckpointFormatError):
+            # an upgrade refusal is deterministic — retrying cannot help
+            traceback.print_exc()
+            print(
+                "run_guard: checkpoint format refusal (fail-fast)",
+                file=sys.stderr,
+            )
+            raise SystemExit(EXIT_CONFIG_ERROR)
+        raise
     except (ValueError, KeyError, TypeError, FileNotFoundError):
         traceback.print_exc()
         print("run_guard: classified as config error (fail-fast)", file=sys.stderr)

@@ -50,6 +50,11 @@ from hydragnn_tpu.resilience.preempt import (
 
 FAIL_FAST_CAUSES = frozenset({"config_error", "rollback_exhausted"})
 
+# pod-level causes that restart PROMPTLY (no crash backoff): eviction
+# and host loss are the expected steady state of preemptible pods, and
+# the run resumes from the last committed generation either way
+PREEMPT_CLASS_CAUSES = frozenset({"preempted", "host_lost"})
+
 
 def wall_clock_runner(
     max_wall_s: float, *, grace_s: float = 5.0, popen=subprocess.Popen
@@ -98,6 +103,54 @@ def classify_exit(returncode: int) -> str:
     if returncode == EXIT_HUNG:
         return "hung"
     return "crash"
+
+
+def classify_pod_exit(returncodes: Dict[int, int]) -> str:
+    """Collapse one pod attempt's per-host exit codes into a single
+    cause, worst-first:
+
+      - any fail-fast code (78 config / 76 rollback) wins — the failure
+        is deterministic and restarting N hosts to fail identically is
+        N times the waste;
+      - else any SIGNAL death (negative returncode — SIGKILL from an
+        evictor, the OOM killer, a dead machine) is ``host_lost``:
+        preempt-class, restart the pod from the last committed
+        generation promptly;
+      - else preempted (75) beats hung (79) beats crash;
+      - all zero = completed.
+    """
+    if not returncodes:
+        raise ValueError("classify_pod_exit: empty returncode map")
+    causes = {classify_exit(rc) for rc in returncodes.values()}
+    if "config_error" in causes:
+        return "config_error"
+    if "rollback_exhausted" in causes:
+        return "rollback_exhausted"
+    if any(rc < 0 for rc in returncodes.values()):
+        return "host_lost"
+    if "preempted" in causes:
+        return "preempted"
+    if "hung" in causes:
+        return "hung"
+    if "crash" in causes:
+        return "crash"
+    return "completed"
+
+
+def _pod_exit_code(returncodes: Dict[int, int], cause: str) -> int:
+    """A representative exit code for a classified pod attempt."""
+    table = {
+        "completed": EXIT_OK,
+        "config_error": EXIT_CONFIG_ERROR,
+        "rollback_exhausted": EXIT_ROLLBACK_EXHAUSTED,
+        "preempted": EXIT_PREEMPTED,
+        "hung": EXIT_HUNG,
+    }
+    if cause in table:
+        return table[cause]
+    if cause == "host_lost":
+        return next(rc for rc in returncodes.values() if rc < 0)
+    return next(rc for rc in returncodes.values() if rc != EXIT_OK)
 
 
 @dataclasses.dataclass
@@ -208,5 +261,216 @@ class Supervisor:
                 attempts=result["attempts"],
                 restarts=crashes,
                 preemptions=preemptions,
+            )
+        return result
+
+
+class PodSupervisor:
+    """Supervise ONE training command as a pod of ``hosts`` concurrent
+    simulated-host processes (docs/RESILIENCE.md "Pod recovery").
+
+    Each attempt launches every host with its podview identity
+    (``HYDRAGNN_PODVIEW_HOST=k`` / ``HYDRAGNN_PODVIEW_HOSTS=N`` and a
+    shared ``HYDRAGNN_PODVIEW_RUN_ID``), then polls. The pod lives and
+    dies together: the first host to exit non-zero gets the rest
+    SIGTERMed (they cut a final generation inside their grace window),
+    then SIGKILLed after ``grace_s``. The attempt's per-host exit codes
+    collapse to one cause via :func:`classify_pod_exit`; ``host_lost``
+    (a signal-dead host) is preempt-class — restart promptly, resume
+    from the last committed generation — not a crash that burns the
+    backoff budget.
+
+    ``elastic=True`` drops the pod to N-1 hosts after a ``host_lost``
+    attempt instead of insisting on the original width: the restarted
+    run re-shards the committed generation across the smaller pod
+    (resilience/podckpt.py restore).
+
+    ``popen`` / ``sleep`` are test seams (tests/test_podckpt.py drives
+    the policy with fake processes).
+    """
+
+    def __init__(
+        self,
+        argv: Sequence[str],
+        hosts: int,
+        policy: Optional[SupervisorPolicy] = None,
+        env: Optional[Dict[str, str]] = None,
+        flight=None,
+        run_id: Optional[str] = None,
+        popen=subprocess.Popen,
+        sleep: Callable[[float], None] = time.sleep,
+        grace_s: float = 30.0,
+        poll_s: float = 0.05,
+        max_wall_s: Optional[float] = None,
+        elastic: bool = False,
+    ):
+        if hosts < 1:
+            raise ValueError(f"hosts must be >= 1, got {hosts}")
+        self.argv = list(argv)
+        self.hosts = int(hosts)
+        self.policy = policy or SupervisorPolicy()
+        self.base_env = dict(env if env is not None else os.environ)
+        self.flight = flight
+        self.run_id = run_id
+        self.popen = popen
+        self.sleep = sleep
+        self.grace_s = float(grace_s)
+        self.poll_s = float(poll_s)
+        self.max_wall_s = max_wall_s
+        self.elastic = bool(elastic)
+        self.history: List[dict] = []
+
+    def _host_env(self, host: int, hosts: int, attempt: int) -> Dict[str, str]:
+        env = dict(self.base_env)
+        if attempt > 0:
+            if self.policy.auto_resume:
+                env["HYDRAGNN_AUTO_RESUME"] = "1"
+            if self.policy.strip_injection:
+                env = strip_injection_env(env)
+        env["HYDRAGNN_PODVIEW_HOST"] = str(host)
+        env["HYDRAGNN_PODVIEW_HOSTS"] = str(hosts)
+        if self.run_id:
+            env["HYDRAGNN_PODVIEW_RUN_ID"] = self.run_id
+        return env
+
+    def _stop_peers(self, procs: dict, rcs: Dict[int, int]) -> None:
+        """SIGTERM every still-running host (graceful generation cut),
+        give them ``grace_s`` collectively, then SIGKILL stragglers."""
+        live = [k for k in procs if k not in rcs]
+        for k in live:
+            try:
+                procs[k].terminate()
+            except OSError:
+                pass
+        deadline = time.monotonic() + self.grace_s
+        for k in live:
+            if k in rcs:
+                continue
+            timeout = max(deadline - time.monotonic(), 0.0)
+            try:
+                rcs[k] = int(procs[k].wait(timeout=timeout))
+            except subprocess.TimeoutExpired:
+                try:
+                    procs[k].kill()
+                except OSError:
+                    pass
+                rcs[k] = int(procs[k].wait())
+
+    def _run_attempt(self, hosts: int, attempt: int) -> Dict[int, int]:
+        procs = {
+            k: self.popen(self.argv, env=self._host_env(k, hosts, attempt))
+            for k in range(hosts)
+        }
+        rcs: Dict[int, int] = {}
+        deadline = (
+            time.monotonic() + self.max_wall_s
+            if self.max_wall_s is not None
+            else None
+        )
+        while len(rcs) < hosts:
+            progressed = False
+            failed = False
+            for k, p in procs.items():
+                if k in rcs:
+                    continue
+                rc = p.poll()
+                if rc is not None:
+                    rcs[k] = int(rc)
+                    progressed = True
+                    if rc != EXIT_OK:
+                        failed = True
+            if failed:
+                self._stop_peers(procs, rcs)
+                break
+            if deadline is not None and time.monotonic() > deadline:
+                # outer-belt wall clock: report the unfinished hosts as
+                # hung/79 (same contract as wall_clock_runner), not as
+                # the signal death the kill itself produced
+                unfinished = [k for k in procs if k not in rcs]
+                self._stop_peers(procs, rcs)
+                for k in unfinished:
+                    rcs[k] = EXIT_HUNG
+                break
+            if not progressed:
+                self.sleep(self.poll_s)
+        return rcs
+
+    def run(self) -> dict:
+        """Supervise the pod to completion or give-up. Same result
+        contract as :meth:`Supervisor.run`, plus per-attempt
+        ``exit_codes`` / ``hosts`` in the history and ``host_lost``
+        counted with preemptions (both are prompt-restart events)."""
+        crashes = 0
+        preemptions = 0
+        attempt = 0
+        hosts = self.hosts
+        while True:
+            rcs = self._run_attempt(hosts, attempt)
+            cause = classify_pod_exit(rcs)
+            rc = _pod_exit_code(rcs, cause)
+            self.history.append(
+                {
+                    "attempt": attempt,
+                    "hosts": hosts,
+                    "exit_codes": {str(k): v for k, v in sorted(rcs.items())},
+                    "cause": cause,
+                }
+            )
+            if cause == "completed":
+                return self._finish("completed", rc, cause, crashes, preemptions, hosts)
+            if cause in FAIL_FAST_CAUSES:
+                return self._finish("failed_fast", rc, cause, crashes, preemptions, hosts)
+            if cause in PREEMPT_CLASS_CAUSES:
+                preemptions += 1
+                if preemptions > self.policy.max_preemptions:
+                    return self._finish("gave_up", rc, cause, crashes, preemptions, hosts)
+                delay = 0.0
+            else:  # crash / hung
+                crashes += 1
+                if crashes > self.policy.max_restarts:
+                    return self._finish("gave_up", rc, cause, crashes, preemptions, hosts)
+                delay = self.policy.backoff(crashes)
+            if cause == "host_lost":
+                if self.flight is not None:
+                    for k, code in sorted(rcs.items()):
+                        if code < 0:
+                            self.flight.record(
+                                "host_lost", host=k, exit_code=code, attempt=attempt
+                            )
+                if self.elastic and hosts > 1:
+                    hosts -= 1
+            attempt += 1
+            if self.flight is not None:
+                self.flight.record(
+                    "restart",
+                    attempt=attempt,
+                    cause=cause,
+                    exit_code=rc,
+                    delay_s=delay,
+                    hosts=hosts,
+                )
+            if delay > 0:
+                self.sleep(delay)
+
+    def _finish(self, status, rc, cause, crashes, preemptions, hosts) -> dict:
+        result = {
+            "status": status,
+            "exit_code": rc,
+            "cause": cause,
+            "attempts": len(self.history),
+            "restarts": crashes,
+            "preemptions": preemptions,
+            "hosts": hosts,
+            "history": list(self.history),
+        }
+        if self.flight is not None:
+            self.flight.end_run(
+                status=status,
+                exit_code=rc,
+                cause=cause,
+                attempts=result["attempts"],
+                restarts=crashes,
+                preemptions=preemptions,
+                hosts=hosts,
             )
         return result

@@ -777,6 +777,20 @@ def train_validate_test(
             run_id=pv_run_id,
             registry=_get_registry(),
         )
+    # Pod fault-tolerance plane (resilience/podckpt.py,
+    # docs/RESILIENCE.md "Pod recovery"): multi-host runs cut sharded
+    # generations with a rank-0 COMMIT marker, exchange heartbeats, and
+    # coordinate preemption cuts so every host checkpoints the SAME
+    # generation. Single-host runs keep the plain msgpack path only.
+    pv_signaler = None
+    pod_ckpt_on = False
+    if pv_on and pv_hosts > 1:
+        from hydragnn_tpu.resilience.podckpt import PodSignaler
+
+        pv_signaler = PodSignaler(
+            os.path.join(log_dir, log_name), host=pv_host, hosts=pv_hosts
+        )
+        pod_ckpt_on = knobs.get_bool("HYDRAGNN_POD_CKPT", True)
     spans = StepSpans() if telemetry_on else StepSpans.disabled()
     cmon = CompileMonitor().start() if telemetry_on else None
     if profiler is not None and getattr(profiler, "on_trace", None) is None:
@@ -849,6 +863,18 @@ def train_validate_test(
                     "host_stall",
                     "podview.stall_age_s",
                     knobs.get_float("HYDRAGNN_PODVIEW_STALL_S", 120.0),
+                )
+            )
+        if pv_signaler is not None and pv_signaler.lost_after_s > 0:
+            # a peer missing HYDRAGNN_POD_LOST_AFTER_S seconds of
+            # heartbeats sets podview.lost_hosts > 0 at the epoch
+            # boundary; the incident bundles the heartbeat view
+            trig_rules.append(
+                TriggerRule(
+                    "podview_host_lost",
+                    "host_lost",
+                    "podview.lost_hosts",
+                    0.5,
                 )
             )
         trig_engine = TriggerEngine(trig_rules, registry=get_registry())
@@ -988,6 +1014,10 @@ def train_validate_test(
     )
     watchdog = HangWatchdog(stall_s, flight=flight).start() if stall_s > 0 else None
     hooks = TrainHooks(preempt=preempt, sentry=sentry, watchdog=watchdog)
+    if preempt is not None and pv_signaler is not None:
+        # SIGTERM on this host announces the cut generation to the pod
+        # (preempt.proposed_gen is kept current at each epoch start)
+        preempt.signaler = pv_signaler
 
     def _abort_telemetry(exc: BaseException, epochs: int) -> None:
         """Record the failure into the flight record before unwinding —
@@ -1109,6 +1139,12 @@ def train_validate_test(
             )
         except Exception:
             pass
+    # lineage left behind by a pod-checkpoint restore earlier in this
+    # process (utils/checkpoint.load_existing_model → podckpt); consumed
+    # once so only the run that actually restored stamps it
+    from hydragnn_tpu.resilience import podckpt as _podckpt
+
+    pod_lineage = _podckpt.consume_last_restore_info()
     flight.start_run(
         {
             "run": log_name,
@@ -1166,6 +1202,23 @@ def train_validate_test(
             # traffic against (obs/drift.py load_reference reads it
             # straight out of this flight record)
             "stats": stats_block,
+            # pod-restore lineage (resilience/podckpt.py): set when this
+            # process's state came out of a sharded pod checkpoint —
+            # which committed generation, the prior pod layout it was
+            # cut under, and any generations skipped as torn
+            **(
+                {
+                    "pod_resume": {
+                        "resumed_from_gen": pod_lineage.get("gen"),
+                        "step": pod_lineage.get("step"),
+                        "prior_hosts": pod_lineage.get("hosts"),
+                        "prior_layout": pod_lineage.get("layout"),
+                        "fallbacks": pod_lineage.get("fallbacks") or [],
+                    }
+                }
+                if pod_lineage is not None
+                else {}
+            ),
             # caller-stamped provenance (e.g. the retrain pilot's
             # fine-tune child marks which serving run + spool window it
             # trained from — pilot/tune.py)
@@ -1177,6 +1230,14 @@ def train_validate_test(
         # story ("one preempted + one resumed") is then readable from
         # the merged flight record alone
         flight.record("resumed", epoch=resumed_from)
+    if pod_lineage is not None:
+        flight.record(
+            "pod_resume",
+            gen=int(pod_lineage.get("gen", -1)),
+            prior_hosts=pod_lineage.get("hosts"),
+            prior_layout=pod_lineage.get("layout"),
+            fallbacks=pod_lineage.get("fallbacks") or [],
+        )
 
     # Persistent AOT executable cache (utils/exec_cache.py): with
     # HYDRAGNN_EXEC_CACHE set — an env var strip_injection_env
@@ -1339,10 +1400,87 @@ def train_validate_test(
             _abort_telemetry(exc, 0)
             raise
 
+    def _declare_lost(lost, epoch_now: int) -> None:
+        """Record each newly-lost peer exactly once: one ``host_lost``
+        flight event per host plus the ``podview.lost_host(s)`` gauges
+        the podview_host_lost trigger rule reads."""
+        fresh = pv_signaler.mark_declared(lost)
+        if not fresh:
+            return
+        from hydragnn_tpu.obs import get_registry
+
+        reg = get_registry()
+        reg.gauge("podview.lost_hosts").set(
+            float(len(set(pv_signaler.lost_hosts()) | set(lost)))
+        )
+        for h in fresh:
+            reg.gauge("podview.lost_host").set(float(h))
+            flight.record(
+                "host_lost",
+                host=int(h),
+                epoch=int(epoch_now),
+                lost_after_s=pv_signaler.lost_after_s,
+            )
+
+    def _pod_checkpoint(ckpt_state, gen: int) -> None:
+        """One sharded generation cut (resilience/podckpt.py): every
+        host writes its shard + sha sidecar + manifest; rank 0
+        bounded-waits for the peers' manifests, validates them, and
+        writes ``gen<N>.COMMIT`` LAST. Runs BEFORE save_train_meta so a
+        commit that dies on a lost peer leaves the meta sidecar
+        describing the last COMMITTED generation, not this torn one."""
+        from hydragnn_tpu.resilience import podckpt
+        from hydragnn_tpu.resilience.preempt import PodHostLost
+
+        run_dir = os.path.join(log_dir, log_name)
+        pv_signaler.heartbeat(epoch=gen, force=True)
+        podckpt.save_pod_shard(
+            ckpt_state,
+            run_dir,
+            gen=gen,
+            host=pv_host,
+            hosts=pv_hosts,
+            step=int(jax.device_get(ckpt_state.step)),
+            layout=(
+                parallel_block.get("layout")
+                if isinstance(parallel_block, dict)
+                else None
+            ),
+        )
+        if pv_host != 0:
+            # only rank 0 waits at the commit point: the simulated-host
+            # CI mode runs hosts sequentially, and a non-zero host
+            # blocking here would deadlock it
+            return
+        commit = podckpt.commit_generation(
+            run_dir, gen, pv_hosts, signaler=pv_signaler
+        )
+        if commit.get("committed"):
+            podckpt.prune_generations(run_dir)
+            return
+        # proceed-and-record: the failed commit is itself flight
+        # evidence; a LOST peer additionally raises the typed exit so
+        # the supervisor restarts from the last committed generation
+        flight.record(
+            "error",
+            error=(
+                f"pod generation {gen} failed to commit: "
+                f"lost={commit.get('lost')} bad={commit.get('bad')} "
+                f"timeout={commit.get('timeout')}"
+            ),
+            error_type="PodCommitFailed",
+        )
+        lost = commit.get("lost") or []
+        if lost:
+            _declare_lost(lost, gen)
+            raise PodHostLost(lost, gen)
+
     def _write_checkpoint(ckpt_state, epoch_next: int, early_stopped: bool) -> None:
         from hydragnn_tpu.utils.checkpoint import save_model, save_train_meta
 
         save_model(ckpt_state, log_name, log_dir, verbosity, keep_last=ckpt_keep_last)
+        if pod_ckpt_on:
+            _pod_checkpoint(ckpt_state, epoch_next)
         save_train_meta(
             {
                 "epoch": epoch_next,
@@ -1364,19 +1502,27 @@ def train_validate_test(
             log_dir,
         )
 
-    def _preempt_exit(ckpt_state, epoch: int):
+    def _preempt_exit(ckpt_state, epoch: int, coordinated_from=None):
         """Graceful preemption: checkpoint + meta pair for this epoch,
         ``preempt`` + ``run_end{status:"preempted"}`` flight events,
         telemetry closed — all inside the grace window the handler's
         hard-exit timer enforces — then the typed exception the driver's
-        run_guard maps to EXIT_PREEMPTED."""
+        run_guard maps to EXIT_PREEMPTED. ``coordinated_from`` marks a
+        cut taken on a PEER's announcement rather than our own signal."""
         signum = preempt.signum if preempt is not None else 0
+        if signum is None:
+            signum = 0
         _write_checkpoint(ckpt_state, epoch, early_stopped=False)
         flight.record(
             "preempt",
             signal=signum,
             epoch=epoch,
             step=int(jax.device_get(ckpt_state.step)),
+            **(
+                {"coordinated_from": int(coordinated_from)}
+                if coordinated_from is not None
+                else {}
+            ),
         )
         if incidents is not None:
             incidents.finalize()
@@ -1446,6 +1592,13 @@ def train_validate_test(
         hooks.epoch_start(epoch)
         if hooks.preempted:
             _preempt_exit(state, epoch)
+        if pv_signaler is not None:
+            # a SIGTERM landing anywhere in this epoch announces the
+            # cut at its END boundary, so every host checkpoints the
+            # same generation (epoch + 1)
+            if preempt is not None:
+                preempt.proposed_gen = epoch + 1
+            pv_signaler.heartbeat(epoch=epoch, force=True)
         for loader in (train_loader, val_loader, test_loader):
             if hasattr(loader, "set_epoch"):
                 loader.set_epoch(epoch)
@@ -1484,9 +1637,12 @@ def train_validate_test(
         # wall time covers every dispatched train step's execution —
         # the denominator of the epoch's achieved-TFLOP/s and MFU
         train_wall_s = time.perf_counter() - t_train0
-        if hooks.preempted:
+        if hooks.preempted and pv_signaler is None:
             # mid-epoch graceful stop: this epoch is incomplete, resume
-            # re-runs it (the meta pair written here says so)
+            # re-runs it (the meta pair written here says so). Pod mode
+            # instead defers to the epoch's END boundary — the
+            # generation the SIGTERM handler announced to the peers —
+            # racing the handler's hard-exit grace timer
             _preempt_exit(state, epoch)
         nonfinite = None
         if sentry is not None:
@@ -1671,6 +1827,19 @@ def train_validate_test(
                     flight.record("podview", **pv_skew)
             pv_overhead_s += time.perf_counter() - _t_pv0
 
+        # pod liveness at the epoch boundary (resilience/podckpt.py):
+        # refresh this host's beat, then declare any peer whose beats
+        # lapsed past HYDRAGNN_POD_LOST_AFTER_S — one host_lost flight
+        # event per host, plus the podview.lost_hosts gauge the
+        # podview_host_lost trigger rule (evaluated just below) reads
+        if pv_signaler is not None:
+            pv_signaler.heartbeat(epoch=epoch + 1, force=True)
+            lost_now = pv_signaler.lost_hosts()
+            if lost_now:
+                # _declare_lost dedupes, so polling every epoch still
+                # yields exactly one event per lost host
+                _declare_lost(lost_now, epoch + 1)
+
         # SLO trigger evaluation at the epoch boundary: feed the rolling
         # series the rules watch, then let at most one verdict open an
         # incident whose profiler capture runs during the NEXT epoch's
@@ -1749,9 +1918,26 @@ def train_validate_test(
             _write_checkpoint(state, epoch + 1, early_stopped=False)
 
         if hooks.preempted:
-            # SIGTERM landed during val/test/plots: this epoch is
-            # complete and recorded, resume continues from the next
+            # SIGTERM landed during val/test/plots (or, pod mode,
+            # anywhere in the epoch): this epoch is complete and
+            # recorded, resume continues from the next
             _preempt_exit(state, epoch + 1)
+
+        if pv_signaler is not None:
+            req = pv_signaler.preempt_request()
+            if (
+                req is not None
+                and int(req.get("host", -1)) != pv_host
+                and epoch + 1 >= int(req.get("gen", 0))
+            ):
+                # a PEER announced preemption: cut the same generation
+                # at this boundary so the pod's shards agree and the
+                # supervisor restarts everyone from one COMMIT
+                _preempt_exit(
+                    state,
+                    epoch + 1,
+                    coordinated_from=int(req.get("host", -1)),
+                )
 
         if stop:
             print_distributed(verbosity, f"Early stopping at epoch {epoch}")

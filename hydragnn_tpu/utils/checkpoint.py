@@ -28,6 +28,22 @@ import jax
 import numpy as np
 from flax import serialization
 
+#: On-disk checkpoint format generation, stamped into the meta sidecar
+#: and every pod-shard manifest/COMMIT (resilience/podckpt.py). History:
+#: 1 = the original UNVERSIONED layout (absent stamp == 1; always
+#: accepted), 2 = adds the stamp itself + the pod sharded-generation
+#: layout. Readers accept <= CURRENT and refuse newer with a TYPED
+#: error — a checkpoint from a future build must fail loudly, not as
+#: an incidental KeyError three frames deep.
+CHECKPOINT_FORMAT_VERSION = 2
+
+
+class CheckpointFormatError(RuntimeError):
+    """The checkpoint on disk was written by a NEWER format_version
+    than this build understands. Typed so supervisors/CLIs can tell an
+    upgrade refusal (fail fast, don't retry) from bit-rot (fall back a
+    version)."""
+
 
 def _checkpoint_path(log_name: str, path: str = "./logs/") -> str:
     return os.path.join(path, log_name, f"{log_name}.mp")
@@ -103,7 +119,12 @@ def validate_checkpoint_file(ckpt_path: str) -> bool:
 
 
 def _atomic_write(final_path: str, data: bytes) -> None:
-    tmp = final_path + ".tmp"
+    # pid-unique tmp: concurrent simulated pod hosts (resilience/
+    # podckpt.py) write the SAME shared targets (latest pointer, meta
+    # sidecar); a fixed tmp name would let writer B's os.replace race
+    # writer A's and raise on the vanished tmp. Unique tmps make the
+    # pair of writes last-writer-wins, each replace still atomic.
+    tmp = f"{final_path}.tmp{os.getpid()}"
     with open(tmp, "wb") as f:
         f.write(data)
     os.replace(tmp, final_path)
@@ -196,7 +217,24 @@ def load_existing_model(
     pointer file is preferred; if it is truncated/corrupt (torn write —
     e.g. SIGKILL mid-checkpoint), the newest valid ``.step<N>.mp``
     version is restored instead, with a loud warning naming what was
-    rejected. Only when every candidate fails does the restore raise."""
+    rejected. Only when every candidate fails does the restore raise.
+
+    Pod-sharded runs (resilience/podckpt.py) are probed FIRST: when the
+    run dir holds committed generations, the newest valid one is
+    reassembled — elastically, onto whatever layout ``state`` carries —
+    and the meta sidecar is reconciled to the committed generation (a
+    host may have written a later meta for a generation that never
+    committed). Only if every pod generation fails does the restore
+    fall through to the msgpack chain below."""
+    _check_meta_format(log_name, path)
+    run_dir = os.path.join(path, log_name)
+    if os.path.isdir(os.path.join(run_dir, "podckpt")):
+        from hydragnn_tpu.resilience import podckpt
+
+        restored, info = podckpt.restore_pod_checkpoint(state, run_dir)
+        if info is not None:
+            reconcile_pod_meta(log_name, path, info)
+            return restored
     orbax_dir = _orbax_dir(log_name, path)
     if os.path.isdir(orbax_dir):
         import orbax.checkpoint as ocp
@@ -250,12 +288,56 @@ def save_train_meta(meta: dict, log_name: str, path: str = "./logs/") -> None:
         return
     import json
 
+    meta = dict(meta)
+    meta.setdefault("format_version", CHECKPOINT_FORMAT_VERSION)
     out_dir = os.path.join(path, log_name)
     os.makedirs(out_dir, exist_ok=True)
-    tmp = os.path.join(out_dir, f"{log_name}.meta.json.tmp")
-    with open(tmp, "w") as f:
-        json.dump(meta, f)
-    os.replace(tmp, os.path.join(out_dir, f"{log_name}.meta.json"))
+    _atomic_write(
+        os.path.join(out_dir, f"{log_name}.meta.json"),
+        json.dumps(meta).encode(),
+    )
+
+
+def _check_meta_format(log_name: str, path: str) -> None:
+    """Refuse (typed) a meta sidecar stamped by a future format_version.
+    An ABSENT stamp is the legacy layout (format 1) and is accepted —
+    old runs must keep resuming under new builds."""
+    meta = load_train_meta(log_name, path)
+    if not meta:
+        return
+    fv = meta.get("format_version")
+    if fv is not None and int(fv) > CHECKPOINT_FORMAT_VERSION:
+        raise CheckpointFormatError(
+            f"checkpoint meta for run {log_name!r} was written by "
+            f"format_version {fv}; this build understands <= "
+            f"{CHECKPOINT_FORMAT_VERSION}"
+        )
+
+
+def reconcile_pod_meta(log_name: str, path: str, info: dict) -> None:
+    """Rewrite the meta sidecar to agree with the pod generation that
+    actually COMMITTED. A host can write meta for epoch N and die
+    before generation N commits (the commit marker is always last); a
+    resume would then skip epoch N with generation N-1's weights.
+    Truth lives in the COMMIT marker, so the sidecar follows it: epoch
+    pinned to the committed gen, history truncated to match, early-stop
+    state cleared (its counters described epochs being re-run)."""
+    gen = int(info["gen"])
+    meta = load_train_meta(log_name, path)
+    if meta is None:
+        meta = {}
+    if int(meta.get("epoch", -1)) == gen and meta.get("early_stopped") is not True:
+        return
+    meta["epoch"] = gen
+    if info.get("step") is not None:
+        meta["step"] = int(info["step"])
+    meta["early_stopped"] = False
+    history = meta.get("history")
+    if isinstance(history, dict):
+        meta["history"] = {
+            k: (v[:gen] if isinstance(v, list) else v) for k, v in history.items()
+        }
+    save_train_meta(meta, log_name, path)
 
 
 def load_train_meta(log_name: str, path: str = "./logs/") -> Optional[dict]:
@@ -281,8 +363,14 @@ def load_existing_model_config(
 
 
 def checkpoint_exists(log_name: str, path: str = "./logs/") -> bool:
-    return (
+    if (
         os.path.exists(_checkpoint_path(log_name, path))
         or os.path.isdir(_orbax_dir(log_name, path))
         or bool(list_versioned_checkpoints(log_name, path))
-    )
+    ):
+        return True
+    if os.path.isdir(os.path.join(path, log_name, "podckpt")):
+        from hydragnn_tpu.resilience import podckpt
+
+        return bool(podckpt.list_committed_generations(os.path.join(path, log_name)))
+    return False

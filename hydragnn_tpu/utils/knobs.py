@@ -166,6 +166,25 @@ KNOBS: Dict[str, Knob] = {
            "N: the pilot's first N fine-tune attempts exit nonzero "
            "before training (N=1 proves retry-with-backoff; N >= the "
            "attempt budget proves the failed-cycle path)."),
+        _K("HYDRAGNN_INJECT_POD_BARRIER_STALL", "spec", None,
+           "resilience/inject.py",
+           "H:S: simulated host H sleeps S seconds before entering any "
+           "pod_barrier (once per process) — peers must time out, "
+           "proceed, and record the missing host."),
+        _K("HYDRAGNN_INJECT_POD_KILL_HOST", "spec", None,
+           "resilience/inject.py",
+           "H:G: host H SIGKILLs itself during the generation-G pod "
+           "checkpoint save, after its shard bytes but before its "
+           "manifest (the torn-generation drill)."),
+        _K("HYDRAGNN_INJECT_POD_LOST_HEARTBEAT", "spec", None,
+           "resilience/inject.py",
+           "H:E: host H stops writing liveness heartbeats from epoch E "
+           "on while continuing to train (drives host_lost detection)."),
+        _K("HYDRAGNN_INJECT_POD_TORN_SHARD", "spec", None,
+           "resilience/inject.py",
+           "H:G: host H writes its generation-G pod shard truncated "
+           "while the sha256 sidecar keeps the good digest (restore "
+           "must reject by checksum and fall back a generation)."),
         _K("HYDRAGNN_INJECT_SERVE_KILL_DISPATCH", "spec", None,
            "resilience/inject.py",
            "K: the K-th dispatched serve batch raises outside request "
@@ -266,6 +285,33 @@ KNOBS: Dict[str, Knob] = {
         _K("HYDRAGNN_PODVIEW_STALL_S", "float", "120", "train/loop.py",
            "host_stall trigger threshold: seconds since the least-recent "
            "host's last flight event before the stall incident fires."),
+        _K("HYDRAGNN_POD_BARRIER_TIMEOUT_S", "float", "60",
+           "resilience/podckpt.py",
+           "Bounded-wait limit for pod_barrier rendezvous; on expiry "
+           "the host PROCEEDS and records the missing peers (a pod "
+           "must degrade to evidence, never to a hang)."),
+        _K("HYDRAGNN_POD_CKPT", "bool", "1", "train/loop.py",
+           "Pod-sharded generation checkpointing (resilience/podckpt.py) "
+           "whenever the run spans more than one podview host; 0 keeps "
+           "only the single-host msgpack path."),
+        _K("HYDRAGNN_POD_COMMIT_TIMEOUT_S", "float", "120",
+           "resilience/podckpt.py",
+           "How long rank 0 waits for every host's shard manifest "
+           "before giving up on committing a generation (the COMMIT "
+           "marker is only ever written after all manifests validate)."),
+        _K("HYDRAGNN_POD_HEARTBEAT_S", "float", "1.0",
+           "resilience/podckpt.py",
+           "Write period of each host's liveness heartbeat file in the "
+           "pod sync dir."),
+        _K("HYDRAGNN_POD_KEEP_GENS", "int", "3", "resilience/podckpt.py",
+           "Committed pod checkpoint generations retained; older ones "
+           "are pruned (marker first, then shards) after each commit."),
+        _K("HYDRAGNN_POD_LOST_AFTER_S", "float", "0",
+           "resilience/podckpt.py",
+           "Declare a peer host lost when its newest heartbeat is older "
+           "than this many seconds (host_lost flight event + trigger). "
+           "0/unset = detection off — required for the sequential "
+           "simulated-host CI mode where stale beats are normal."),
         _K("HYDRAGNN_RESIDENCY_VMEM_MB", "float", "12", "ops/fused_conv.py",
            "VMEM budget the cross-layer resident conv-stack kernel may "
            "claim (a TPU core has ~16 MB; the pipeline needs headroom)."),
@@ -354,14 +400,20 @@ def is_set(name: str) -> bool:
     return bool(raw(name))
 
 
-def active_injections(include_serve: bool = True) -> List[str]:
+def active_injections(
+    include_serve: bool = True, env: Optional[Dict[str, str]] = None
+) -> List[str]:
     """Sorted ``HYDRAGNN_INJECT_*`` names currently set in the
-    environment. ``include_serve=False`` drops the serve-side family —
-    what the scan-epoch eligibility check cares about (train-side
-    injections are step-indexed and need per-step dispatch)."""
+    environment (or in ``env`` when given — the restart supervisor
+    passes a CHILD's environment to derive its strip set from the same
+    registry view everything else uses). ``include_serve=False`` drops
+    the serve-side family — what the scan-epoch eligibility check cares
+    about (train-side injections are step-indexed and need per-step
+    dispatch)."""
+    src = os.environ if env is None else env
     return sorted(
         k
-        for k in os.environ
+        for k in src
         if k.startswith(INJECT_PREFIX)
         and (include_serve or not k.startswith("HYDRAGNN_INJECT_SERVE"))
     )

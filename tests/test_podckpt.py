@@ -1,0 +1,529 @@
+"""Pod-scale fault tolerance (hydragnn_tpu/resilience/podckpt.py +
+PodSupervisor): sharded checkpoints with a generation commit protocol,
+heartbeat-based lost-host detection, coordinated preemption, elastic
+restore, and the pod-level exit classification the supervisor restarts
+from (docs/RESILIENCE.md "Pod recovery"). All CPU; the crash-mid-commit
+end-to-end runs real subprocesses and is slow-marked."""
+
+import glob
+import json
+import os
+import subprocess
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hydragnn_tpu.resilience import podckpt
+from hydragnn_tpu.resilience.podckpt import (
+    PodShardError,
+    PodSignaler,
+    commit_generation,
+    list_committed_generations,
+    pod_barrier,
+    read_commit,
+    restore_pod_checkpoint,
+    save_pod_shard,
+)
+from hydragnn_tpu.resilience.preempt import PodHostLost, PreemptionHandler
+from hydragnn_tpu.resilience.supervisor import (
+    PodSupervisor,
+    SupervisorPolicy,
+    classify_pod_exit,
+)
+from hydragnn_tpu.utils.checkpoint import CheckpointFormatError
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _fake_state(step, value):
+    from hydragnn_tpu.train.state import TrainState
+
+    return TrainState(
+        step=jnp.asarray(step, jnp.int32),
+        params={
+            "w": jnp.full((6, 3), float(value)),
+            "b": jnp.full((4,), float(value) * 2.0),
+        },
+        batch_stats={"mean": jnp.full((3,), float(value) / 2.0)},
+        opt_state=(),
+        rng=jax.random.PRNGKey(0),
+    )
+
+
+def _save_generation(run_dir, state, gen, hosts=2, step=None):
+    """Every simulated host writes its shard, then rank 0 commits."""
+    for h in range(hosts):
+        save_pod_shard(
+            state, run_dir, gen=gen, host=h, hosts=hosts,
+            step=step if step is not None else int(state.step),
+        )
+    return commit_generation(run_dir, gen, hosts, timeout_s=5.0)
+
+
+def _assert_states_equal(a, b):
+    for la, lb in zip(
+        jax.tree_util.tree_leaves(jax.device_get(a)),
+        jax.tree_util.tree_leaves(jax.device_get(b)),
+    ):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+# ---------------------------------------------------------------------------
+# commit protocol + elastic restore
+
+
+def pytest_pod_roundtrip_and_elastic_restore(tmp_path):
+    run_dir = str(tmp_path)
+    state = _fake_state(7, 3.5)
+    commit = _save_generation(run_dir, state, gen=1, hosts=2)
+    assert commit["committed"] and commit["gen"] == 1
+    assert list_committed_generations(run_dir) == [1]
+    # the COMMIT record inherits step from host 0's manifest
+    assert read_commit(run_dir, 1)["step"] == 7
+
+    # restore into a DIFFERENT (single-host) process: the leaves are
+    # reassembled from both hosts' shards and placed on this topology —
+    # the 2-host -> 1-host elastic leg
+    restored, info = restore_pod_checkpoint(_fake_state(0, 0.0), run_dir)
+    assert info is not None and info["gen"] == 1 and info["hosts"] == 2
+    assert info["fallbacks"] == []
+    assert int(restored.step) == 7
+    _assert_states_equal(restored, state)
+    # the lineage latch hands the info to the train loop exactly once
+    assert podckpt.consume_last_restore_info() == info
+    assert podckpt.consume_last_restore_info() is None
+
+
+def pytest_newest_commit_wins_and_prune_keeps_last(tmp_path):
+    run_dir = str(tmp_path)
+    for gen in (1, 2, 3, 4):
+        assert _save_generation(
+            run_dir, _fake_state(gen, float(gen)), gen=gen
+        )["committed"]
+    restored, info = restore_pod_checkpoint(_fake_state(0, 0.0), run_dir)
+    assert info["gen"] == 4 and int(restored.step) == 4
+    podckpt.prune_generations(run_dir, keep_last=2)
+    assert list_committed_generations(run_dir) == [3, 4]
+
+
+def pytest_torn_sidecar_falls_back_a_generation(tmp_path):
+    run_dir = str(tmp_path)
+    good = _fake_state(1, 1.0)
+    assert _save_generation(run_dir, good, gen=1)["committed"]
+    assert _save_generation(run_dir, _fake_state(2, 2.0), gen=2)["committed"]
+    # corrupt gen 2's host-1 shard AFTER commit (bit rot / torn disk)
+    shard = os.path.join(run_dir, "podckpt", "ckpt.gen2.host1.mp")
+    with open(shard, "rb") as f:
+        data = f.read()
+    with open(shard, "wb") as f:
+        f.write(data[: len(data) // 2])
+    # restore falls back to gen 1 LOUDLY, naming the bad shard
+    with pytest.warns(RuntimeWarning, match="gen2"):
+        restored, info = restore_pod_checkpoint(_fake_state(0, 0.0), run_dir)
+    assert info["gen"] == 1
+    assert info["fallbacks"] and "2" in str(info["fallbacks"][0]["gen"])
+    _assert_states_equal(restored, good)
+
+
+def pytest_missing_commit_marker_is_never_valid(tmp_path):
+    run_dir = str(tmp_path)
+    assert _save_generation(run_dir, _fake_state(1, 1.0), gen=1)["committed"]
+    # gen 2: every shard + manifest present, but the process died before
+    # rank 0 wrote the COMMIT marker — the generation does not exist
+    state2 = _fake_state(2, 2.0)
+    for h in range(2):
+        save_pod_shard(state2, run_dir, gen=2, host=h, hosts=2)
+    assert list_committed_generations(run_dir) == [1]
+    restored, info = restore_pod_checkpoint(_fake_state(0, 0.0), run_dir)
+    assert info["gen"] == 1 and int(restored.step) == 1
+
+
+def pytest_commit_bounded_wait_timeout_and_lost(tmp_path, monkeypatch):
+    run_dir = str(tmp_path)
+    state = _fake_state(3, 1.0)
+    # only host 0 of 2 wrote its shard: bounded wait, then a recorded
+    # non-commit — never a hang, never an exception
+    save_pod_shard(state, run_dir, gen=1, host=0, hosts=2)
+    commit = commit_generation(run_dir, 1, 2, timeout_s=0.3, poll_s=0.02)
+    assert not commit["committed"] and commit.get("timeout")
+    assert commit["missing"] == [1]
+    assert list_committed_generations(run_dir) == []
+
+    # with a signaler that has declared host 1 lost, the wait bails out
+    # early and reports WHO was lost
+    monkeypatch.setenv("HYDRAGNN_POD_LOST_AFTER_S", "0.05")
+    sig = PodSignaler(run_dir, host=0, hosts=2)
+    time.sleep(0.15)  # host 1 never beats after sig's birth
+    commit = commit_generation(
+        run_dir, 1, 2, timeout_s=5.0, poll_s=0.02, signaler=sig
+    )
+    assert not commit["committed"] and commit["lost"] == [1]
+
+
+def pytest_pod_barrier_bounded_wait(tmp_path):
+    run_dir = str(tmp_path)
+    ok, missing = pod_barrier(run_dir, "setup", 0, 2, timeout_s=0.3, poll_s=0.02)
+    assert not ok and missing == [1]
+    # once the peer arrives the same barrier completes
+    ok, missing = pod_barrier(run_dir, "setup", 1, 2, timeout_s=2.0, poll_s=0.02)
+    assert ok and missing == []
+
+
+# ---------------------------------------------------------------------------
+# heartbeats, lost detection, coordinated preemption
+
+
+def pytest_signaler_lost_detection_dedupe_and_stale_beats(tmp_path, monkeypatch):
+    run_dir = str(tmp_path)
+    monkeypatch.setenv("HYDRAGNN_POD_HEARTBEAT_S", "0.01")
+    monkeypatch.setenv("HYDRAGNN_POD_LOST_AFTER_S", "0.2")
+    # host 1 beats, then "dies"; host 0's signaler is created AFTER, so
+    # the stale beat must NOT count as liveness — but host 1 still gets
+    # the full threshold from host 0's birth before being declared
+    sig1 = PodSignaler(run_dir, host=1, hosts=2)
+    sig1.heartbeat(epoch=0, force=True)
+    time.sleep(0.05)
+    sig0 = PodSignaler(run_dir, host=0, hosts=2)
+    assert sig0.lost_hosts() == []  # within the grace from birth
+    time.sleep(0.3)
+    assert sig0.lost_hosts() == [1]
+    # exactly-once declaration no matter how many sites poll
+    assert sig0.undeclared_lost() == [1]
+    assert sig0.undeclared_lost() == []
+    assert sig0.mark_declared([1]) == []
+    # a fresh beat revives the peer (lost_hosts is a live view)
+    sig1.heartbeat(epoch=1, force=True)
+    assert sig0.lost_hosts() == []
+
+
+def pytest_signaler_disarmed_by_default(tmp_path, monkeypatch):
+    monkeypatch.delenv("HYDRAGNN_POD_LOST_AFTER_S", raising=False)
+    sig = PodSignaler(str(tmp_path), host=0, hosts=4)
+    assert sig.lost_after_s == 0.0
+    assert sig.lost_hosts() == []  # sequential CI hosts are never "lost"
+
+
+def pytest_coordinated_preempt_posting_and_max_gen(tmp_path):
+    run_dir = str(tmp_path)
+    sig0 = PodSignaler(run_dir, host=0, hosts=2)
+    sig1 = PodSignaler(run_dir, host=1, hosts=2)
+    # the SIGTERM handler announces through the attached signaler
+    handler = PreemptionHandler(hard_exit=False)
+    handler.signaler = sig1
+    handler.proposed_gen = 3
+    handler._handle(15, None)
+    req = sig0.preempt_request()
+    assert req["gen"] == 3 and req["host"] == 1 and req["signum"] == 15
+    # the posting with the HIGHEST generation wins pod-wide
+    sig0.post_preempt(5, signum=15)
+    assert sig1.preempt_request()["gen"] == 5
+    # a restarted host clears ITS OWN stale posting at init
+    PodSignaler(run_dir, host=0, hosts=2)
+    assert sig1.preempt_request()["gen"] == 3  # host 1's survives
+
+
+def pytest_pod_injection_spec_parsers(monkeypatch):
+    from hydragnn_tpu.resilience.inject import (
+        maybe_pod_lost_heartbeat,
+        maybe_pod_torn_shard,
+    )
+
+    monkeypatch.setenv("HYDRAGNN_INJECT_POD_TORN_SHARD", "1:2")
+    assert maybe_pod_torn_shard(1, 2)
+    assert not maybe_pod_torn_shard(0, 2)
+    assert not maybe_pod_torn_shard(1, 1)
+    monkeypatch.setenv("HYDRAGNN_INJECT_POD_LOST_HEARTBEAT", "1:3")
+    assert maybe_pod_lost_heartbeat(1, 3)
+    assert maybe_pod_lost_heartbeat(1, 5)  # epoch >= E stays silent
+    assert not maybe_pod_lost_heartbeat(1, 2)
+    assert not maybe_pod_lost_heartbeat(0, 3)
+    assert not maybe_pod_lost_heartbeat(1, None)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint format versioning (satellite: forward-compat refusal)
+
+
+def pytest_format_version_stamped_and_future_rejected(tmp_path):
+    from hydragnn_tpu.utils.checkpoint import (
+        CHECKPOINT_FORMAT_VERSION,
+        load_existing_model,
+        load_train_meta,
+        save_model,
+        save_train_meta,
+    )
+
+    log_dir = str(tmp_path)
+    save_model(_fake_state(1, 1.0), "run", log_dir)
+    save_train_meta({"epoch": 1, "step": 1}, "run", log_dir)
+    meta = load_train_meta("run", log_dir)
+    assert meta["format_version"] == CHECKPOINT_FORMAT_VERSION
+
+    # legacy (pre-versioning) sidecar: no stamp, accepted unchanged
+    meta_path = os.path.join(log_dir, "run", "run.meta.json")
+    legacy = dict(meta)
+    legacy.pop("format_version")
+    with open(meta_path, "w") as f:
+        json.dump(legacy, f)
+    restored = load_existing_model(_fake_state(0, 0.0), "run", log_dir)
+    assert int(restored.step) == 1
+
+    # a FUTURE format refuses loudly with the typed error (the restart
+    # supervisor fail-fasts on it instead of retrying)
+    future = dict(legacy, format_version=CHECKPOINT_FORMAT_VERSION + 1)
+    with open(meta_path, "w") as f:
+        json.dump(future, f)
+    with pytest.raises(CheckpointFormatError):
+        load_existing_model(_fake_state(0, 0.0), "run", log_dir)
+
+
+def pytest_future_commit_record_rejected(tmp_path):
+    run_dir = str(tmp_path)
+    assert _save_generation(run_dir, _fake_state(1, 1.0), gen=1)["committed"]
+    commit_path = os.path.join(run_dir, "podckpt", "gen1.COMMIT")
+    with open(commit_path) as f:
+        rec = json.load(f)
+    rec["format_version"] = rec["format_version"] + 1
+    with open(commit_path, "w") as f:
+        json.dump(rec, f)
+    with pytest.raises(CheckpointFormatError):
+        read_commit(run_dir, 1)
+    # the refusal PROPAGATES out of restore — an upgrade refusal must
+    # never silently fall back to an older generation
+    with pytest.raises(CheckpointFormatError):
+        restore_pod_checkpoint(_fake_state(0, 0.0), run_dir)
+
+
+# ---------------------------------------------------------------------------
+# pod-level exit classification + PodSupervisor policy (fake processes)
+
+
+def pytest_classify_pod_exit_contract():
+    assert classify_pod_exit({0: 0, 1: 0}) == "completed"
+    assert classify_pod_exit({0: 75, 1: -9}) == "host_lost"  # signal death wins
+    assert classify_pod_exit({0: 0, 1: -15}) == "host_lost"
+    assert classify_pod_exit({0: 75, 1: 0}) == "preempted"
+    assert classify_pod_exit({0: 79, 1: 75}) == "preempted"
+    assert classify_pod_exit({0: 79, 1: 0}) == "hung"
+    assert classify_pod_exit({0: 1, 1: 0}) == "crash"
+    # fail-fast beats everything, including a lost host
+    assert classify_pod_exit({0: 78, 1: -9}) == "config_error"
+    assert classify_pod_exit({0: 76, 1: 75}) == "rollback_exhausted"
+    with pytest.raises(ValueError):
+        classify_pod_exit({})
+
+
+class _FakeProc:
+    """Scripted child: ``rc=None`` means still running; terminate()
+    resolves to ``on_terminate`` (a graceful generation cut -> 75)."""
+
+    def __init__(self, rc=None, on_terminate=75):
+        self.rc = rc
+        self.on_terminate = on_terminate
+
+    def poll(self):
+        return self.rc
+
+    def terminate(self):
+        if self.rc is None:
+            self.rc = self.on_terminate
+
+    def kill(self):
+        self.rc = -9
+
+    def wait(self, timeout=None):
+        if self.rc is None:
+            raise subprocess.TimeoutExpired("cmd", timeout)
+        return self.rc
+
+
+def pytest_pod_supervisor_host_lost_restarts_promptly(tmp_path):
+    from hydragnn_tpu.obs.flight import FlightRecorder, read_flight_record
+
+    # attempt 0: host 1 SIGKILLed mid-run, host 0 still alive (it gets
+    # SIGTERMed and cuts a generation -> 75); attempt 1: both complete
+    script = [[_FakeProc(rc=None), _FakeProc(rc=-9)],
+              [_FakeProc(rc=0), _FakeProc(rc=0)]]
+    launches = []
+
+    def fake_popen(argv, env=None):
+        attempt = len(launches) // 2
+        host = len(launches) % 2
+        launches.append({"argv": list(argv), "env": dict(env or {})})
+        return script[attempt][host]
+
+    delays = []
+    path = str(tmp_path / "flight.jsonl")
+    with FlightRecorder(path) as fl:
+        fl.start_run({"supervisor": True})
+        sup = PodSupervisor(
+            ["cmd"],
+            hosts=2,
+            policy=SupervisorPolicy(max_restarts=0),  # loss is NOT a crash
+            env={"HYDRAGNN_INJECT_POD_KILL_HOST": "1:2", "KEEP": "1"},
+            flight=fl,
+            run_id="podrun",
+            popen=fake_popen,
+            sleep=delays.append,
+        )
+        result = sup.run()
+    assert result["status"] == "completed"
+    assert result["preemptions"] == 1 and result["restarts"] == 0
+    assert delays == []  # prompt restart, no crash backoff
+    assert [h["cause"] for h in result["history"]] == ["host_lost", "completed"]
+    assert result["history"][0]["exit_codes"] == {"0": 75, "1": -9}
+
+    # per-host identity env on every child; restarted children resume
+    # with the injection stripped so the fault fires exactly once
+    for i, launch in enumerate(launches):
+        env = launch["env"]
+        assert env["HYDRAGNN_PODVIEW_HOST"] == str(i % 2)
+        assert env["HYDRAGNN_PODVIEW_HOSTS"] == "2"
+        assert env["HYDRAGNN_PODVIEW_RUN_ID"] == "podrun"
+        assert env["KEEP"] == "1"
+    assert "HYDRAGNN_INJECT_POD_KILL_HOST" in launches[0]["env"]
+    for launch in launches[2:]:
+        assert "HYDRAGNN_INJECT_POD_KILL_HOST" not in launch["env"]
+        assert launch["env"]["HYDRAGNN_AUTO_RESUME"] == "1"
+
+    events = read_flight_record(path)
+    (lost,) = [e for e in events if e.get("kind") == "host_lost"]
+    assert lost["host"] == 1 and lost["exit_code"] == -9
+    (restart,) = [e for e in events if e.get("kind") == "restart"]
+    assert restart["cause"] == "host_lost" and restart["delay_s"] == 0.0
+
+
+def pytest_pod_supervisor_elastic_drops_a_host():
+    script = [[_FakeProc(rc=None), _FakeProc(rc=None), _FakeProc(rc=-9)],
+              [_FakeProc(rc=0), _FakeProc(rc=0)]]
+    launches = []
+
+    def fake_popen(argv, env=None):
+        procs = script[0] if len(launches) < 3 else script[1]
+        proc = procs[len(launches) if len(launches) < 3 else len(launches) - 3]
+        launches.append(dict(env or {}))
+        return proc
+
+    sup = PodSupervisor(
+        ["cmd"], hosts=3, env={}, popen=fake_popen,
+        sleep=lambda s: None, elastic=True,
+    )
+    result = sup.run()
+    assert result["status"] == "completed"
+    assert result["hosts"] == 2  # restarted at N-1 after the loss
+    assert [h["hosts"] for h in result["history"]] == [3, 2]
+    assert launches[3]["HYDRAGNN_PODVIEW_HOSTS"] == "2"
+    assert len(launches) == 5
+
+
+def pytest_pod_supervisor_fail_fast_kills_peers():
+    # one host exits 78: the pod fail-fasts — no restart, peers stopped
+    procs = [_FakeProc(rc=None), _FakeProc(rc=78)]
+    sup = PodSupervisor(
+        ["cmd"], hosts=2, env={},
+        popen=lambda argv, env=None: procs.pop(0),
+        sleep=lambda s: None,
+    )
+    result = sup.run()
+    assert result["status"] == "failed_fast"
+    assert result["cause"] == "config_error"
+    assert result["attempts"] == 1
+
+
+# ---------------------------------------------------------------------------
+# crash-mid-commit end to end (real subprocesses)
+
+_CHILD = r"""
+import sys
+sys.path.insert(0, {repo!r})
+from __graft_entry__ import _load_platform_module
+_load_platform_module().pin_virtual_cpu_mesh(1)
+
+from hydragnn_tpu.resilience import run_guard
+from hydragnn_tpu.api import run_training
+from hydragnn_tpu.data.synthetic import deterministic_graph_data
+from hydragnn_tpu.flagship import flagship_config
+
+cfg = flagship_config(hidden_dim=8, num_conv_layers=2, batch_size=5, num_epoch=3)
+cfg["NeuralNetwork"]["Training"].update({training!r})
+samples = deterministic_graph_data(
+    number_configurations=20, unit_cell_x_range=(2, 3), unit_cell_y_range=(2, 3),
+    unit_cell_z_range=(2, 3), seed=0)
+with run_guard():
+    run_training(cfg, samples=samples, log_dir=sys.argv[1] + "/logs/")
+print("CHILD-COMPLETED")
+"""
+
+
+def _run_pod_host(tmp_path, host, hosts, env_extra, timeout=240):
+    script = tmp_path / "child.py"
+    script.write_text(
+        _CHILD.format(repo=_REPO, training={"checkpoint_every": 1})
+    )
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        HYDRAGNN_PODVIEW_HOST=str(host),
+        HYDRAGNN_PODVIEW_HOSTS=str(hosts),
+        HYDRAGNN_PODVIEW_RUN_ID="podgen",
+        **env_extra,
+    )
+    env.pop("XLA_FLAGS", None)
+    return subprocess.run(
+        [sys.executable, str(script), str(tmp_path)],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        timeout=timeout,
+    )
+
+
+@pytest.mark.slow
+def pytest_crash_mid_commit_leaves_only_committed_generations(tmp_path):
+    # host 1 is SIGKILLed INSIDE its gen-2 shard write (after the shard
+    # + sidecar, before the manifest — the worst torn point); host 0
+    # then runs all 3 epochs, its gen-1 commit succeeds, gens 2..3 fail
+    # the bounded wait and are recorded, never committed
+    proc = _run_pod_host(
+        tmp_path, host=1, hosts=2,
+        env_extra={"HYDRAGNN_INJECT_POD_KILL_HOST": "1:2"},
+    )
+    assert proc.returncode == -9, proc.stdout
+    proc = _run_pod_host(
+        tmp_path, host=0, hosts=2,
+        env_extra={"HYDRAGNN_POD_COMMIT_TIMEOUT_S": "1.5"},
+    )
+    assert proc.returncode == 0, proc.stdout
+
+    (run_dir,) = glob.glob(str(tmp_path / "logs" / "*/"))
+    run_dir = run_dir.rstrip("/")
+    assert list_committed_generations(run_dir) == [1]
+    # the torn gen-2 has host 1's shard but no manifest and no COMMIT
+    assert os.path.exists(
+        os.path.join(run_dir, "podckpt", "ckpt.gen2.host1.mp")
+    )
+    assert not os.path.exists(
+        os.path.join(run_dir, "podckpt", "ckpt.gen2.host1.manifest.json")
+    )
+    # host 0's flight carries the PodCommitFailed evidence
+    from hydragnn_tpu.obs.flight import read_flight_record
+
+    events = read_flight_record(os.path.join(run_dir, "flight.jsonl"))
+    fails = [
+        e for e in events
+        if e.get("kind") == "error" and e.get("error_type") == "PodCommitFailed"
+    ]
+    # gen 2 once, gen 3 twice (the cadence write and the final post-
+    # recalibration write both cut gen 3) — all recorded, none committed
+    assert len(fails) == 3
+    assert {
+        int(str(e["error"]).split("generation ")[1].split(" ")[0]) for e in fails
+    } == {2, 3}
+    # a restart would rise from the only committed generation
+    commit = podckpt.latest_commit_info(run_dir)
+    assert commit["gen"] == 1 and commit["hosts"] == 2

@@ -515,6 +515,32 @@ def pytest_stalled_loader_trips_watchdog_with_stacks(tmp_path):
     assert not validate_flight_record(events)
 
 
+def pytest_strip_injection_env_derives_from_knob_registry():
+    """strip_injection_env must drop EVERY registered HYDRAGNN_INJECT_*
+    knob — derived from knobs.active_injections(), not a hand-kept list
+    that silently rots when a new injection is added — plus any
+    unregistered INJECT-prefixed stragglers, while preserving
+    everything else (including HYDRAGNN_AUTO_RESUME / exec-cache env)."""
+    from hydragnn_tpu.resilience.inject import strip_injection_env
+    from hydragnn_tpu.utils import knobs
+
+    registered = [
+        k for k in knobs.KNOBS if k.startswith(knobs.INJECT_PREFIX)
+    ]
+    assert "HYDRAGNN_INJECT_POD_KILL_HOST" in registered  # pod faults too
+    assert "HYDRAGNN_INJECT_STRAGGLER" in registered
+    env = {k: "1" for k in registered}
+    env["HYDRAGNN_INJECT_FUTURE_UNREGISTERED"] = "1"  # prefix backstop
+    env["HYDRAGNN_AUTO_RESUME"] = "1"
+    env["HYDRAGNN_EXEC_CACHE"] = "/tmp/cache"
+    env["KEEP"] = "x"
+    out = strip_injection_env(env)
+    assert not any(k.startswith(knobs.INJECT_PREFIX) for k in out)
+    assert out["HYDRAGNN_AUTO_RESUME"] == "1"
+    assert out["HYDRAGNN_EXEC_CACHE"] == "/tmp/cache"
+    assert out["KEEP"] == "x"
+
+
 # ---------------------------------------------------------------------------
 # obs_report --faults view
 

@@ -313,6 +313,8 @@ def render_faults(events: List[dict]) -> str:
         "rollbacks": sum(1 for e in events if e.get("kind") == "rollback"),
         "watchdog": sum(1 for e in events if e.get("kind") == "watchdog"),
         "restarts": sum(1 for e in events if e.get("kind") == "restart"),
+        "host_lost": sum(1 for e in events if e.get("kind") == "host_lost"),
+        "pod_resumes": sum(1 for e in events if e.get("kind") == "pod_resume"),
         "errors": sum(1 for e in events if e.get("kind") == "error"),
         "quarantined": sum(1 for e in events if e.get("kind") == "quarantine"),
         "dispatch_restarts": sum(
@@ -367,6 +369,31 @@ def render_faults(events: List[dict]) -> str:
             detail = (
                 f"attempt={e.get('attempt')} cause={e.get('cause')} "
                 f"exit_code={e.get('exit_code')} delay_s={e.get('delay_s')}"
+            )
+        elif kind == "host_lost":
+            # a pod peer's heartbeats lapsed (or the supervisor saw its
+            # signal death): the run restarts from the last committed
+            # generation (docs/RESILIENCE.md 'Pod recovery')
+            extras = [
+                f"{k}={e[k]}"
+                for k in ("epoch", "lost_after_s", "exit_code", "attempt")
+                if e.get(k) is not None
+            ]
+            detail = f"host {e.get('host')} declared lost" + (
+                " (" + " ".join(extras) + ")" if extras else ""
+            )
+        elif kind == "pod_resume":
+            # the restarted run says which committed generation it rose
+            # from and the pod layout that generation was cut under
+            detail = (
+                f"resumed from committed gen {e.get('gen')} "
+                f"(prior_hosts={e.get('prior_hosts')}"
+                + (
+                    f", fallbacks={e.get('fallbacks')}"
+                    if e.get("fallbacks")
+                    else ""
+                )
+                + ")"
             )
         elif kind == "quarantine":
             detail = (
@@ -691,6 +718,29 @@ def main(argv=None) -> int:
                     f"{path}: OK ({len(merged.events)} merged events from "
                     f"{len(merged.hosts)} host shard(s))"
                 )
+                # pod-checkpoint posture: the newest committed
+                # generation a restart would rise from, and — when a
+                # run in this record DID rise from one — its lineage
+                from hydragnn_tpu.resilience.podckpt import latest_commit_info
+
+                commit = latest_commit_info(path)
+                if commit is not None:
+                    print(
+                        f"  podckpt: last committed gen {commit.get('gen')}"
+                        f" (step={commit.get('step')}"
+                        f" hosts={commit.get('hosts')})"
+                    )
+                for e in merged.events:
+                    if e.get("kind") != "run_start":
+                        continue
+                    lineage = (e.get("manifest") or {}).get("pod_resume")
+                    if lineage:
+                        print(
+                            "  pod_resume: from gen "
+                            f"{lineage.get('resumed_from_gen')} "
+                            f"(prior_hosts={lineage.get('prior_hosts')}, "
+                            f"prior_layout={lineage.get('prior_layout')})"
+                        )
             for prob in merged.problems:
                 print(f"  WARNING: {prob}")
             _print_warnings(merged.events)
@@ -720,6 +770,14 @@ def main(argv=None) -> int:
                 ecache = _exec_cache_summary(events)
                 if ecache:
                     print(f"  exec_cache: {ecache}")
+                lineage = ((start or {}).get("manifest") or {}).get("pod_resume")
+                if lineage:
+                    print(
+                        "  pod_resume: from gen "
+                        f"{lineage.get('resumed_from_gen')} "
+                        f"(prior_hosts={lineage.get('prior_hosts')}, "
+                        f"prior_layout={lineage.get('prior_layout')})"
+                    )
                 # drift-observability posture: was the spool/drift plane
                 # armed for the serve run(s) this record holds? (a serve
                 # bench artifact with drift off is a monitoring gap, not

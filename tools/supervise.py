@@ -21,6 +21,15 @@ Restarted children get ``HYDRAGNN_AUTO_RESUME=1`` and (by default) the
 the supervisor's own flight record (one ``restart`` event per
 re-invocation + a terminal ``run_end``) next to the run's.
 
+``--pod N`` supervises the command as a pod of N concurrent simulated
+hosts instead of one process: each child gets its podview identity
+(``HYDRAGNN_PODVIEW_HOST=k`` / ``_HOSTS=N``), the pod lives and dies as
+one unit, and a SIGNAL-dead host (SIGKILL, OOM, dead machine) is
+classified ``host_lost`` — preempt-class, restarted promptly from the
+last committed pod-checkpoint generation. ``--pod-elastic`` restarts
+with N-1 hosts after a loss (the restore re-shards the committed
+generation across the smaller pod).
+
 The supervisor's own exit code is the FINAL child exit code (0 when the
 run completed), so wrapping scripts compose.
 """
@@ -37,6 +46,7 @@ if _REPO not in sys.path:  # runnable as `python tools/supervise.py`
 
 from hydragnn_tpu.obs.flight import FlightRecorder  # noqa: E402
 from hydragnn_tpu.resilience.supervisor import (  # noqa: E402
+    PodSupervisor,
     Supervisor,
     SupervisorPolicy,
     wall_clock_runner,
@@ -88,6 +98,37 @@ def main(argv=None) -> int:
         help="write the supervisor's flight record (restart events + "
         "final summary) to this JSONL path",
     )
+    p.add_argument(
+        "--pod",
+        type=int,
+        default=None,
+        metavar="N",
+        help="supervise the command as a pod of N concurrent simulated "
+        "hosts (HYDRAGNN_PODVIEW_HOST=k/_HOSTS=N per child); the pod "
+        "lives and dies as one unit, a signal-dead host classifies as "
+        "host_lost and restarts promptly from the last committed "
+        "generation (docs/RESILIENCE.md 'Pod recovery')",
+    )
+    p.add_argument(
+        "--pod-elastic",
+        action="store_true",
+        help="after a host_lost attempt, restart the pod with N-1 hosts "
+        "instead of the original width (the restore re-shards the "
+        "committed generation)",
+    )
+    p.add_argument(
+        "--pod-grace",
+        type=float,
+        default=30.0,
+        help="seconds surviving hosts get after SIGTERM to cut their "
+        "final generation before SIGKILL (pod mode only)",
+    )
+    p.add_argument(
+        "--run-id",
+        default=None,
+        help="shared HYDRAGNN_PODVIEW_RUN_ID for all pod hosts (pod "
+        "mode only; defaults to the children deriving it from the run)",
+    )
     args = p.parse_args(opts)
 
     policy = SupervisorPolicy(
@@ -113,15 +154,28 @@ def main(argv=None) -> int:
             "graftcheck": contract_block(None),
         }
     )
-    runner = (
-        wall_clock_runner(args.max_wall_s)
-        if args.max_wall_s is not None
-        else None
-    )
-    sup = Supervisor(
-        child, policy=policy, env=dict(os.environ), flight=flight,
-        runner=runner,
-    )
+    if args.pod is not None:
+        sup = PodSupervisor(
+            child,
+            hosts=args.pod,
+            policy=policy,
+            env=dict(os.environ),
+            flight=flight,
+            run_id=args.run_id,
+            grace_s=args.pod_grace,
+            max_wall_s=args.max_wall_s,
+            elastic=args.pod_elastic,
+        )
+    else:
+        runner = (
+            wall_clock_runner(args.max_wall_s)
+            if args.max_wall_s is not None
+            else None
+        )
+        sup = Supervisor(
+            child, policy=policy, env=dict(os.environ), flight=flight,
+            runner=runner,
+        )
     result = sup.run()
     flight.close()
     print(
